@@ -1,0 +1,14 @@
+"""distributed_tensorflow_tpu — a TPU-native distributed training framework.
+
+A ground-up rebuild of the capability surface of the
+``gctian/distributed-tensorflow`` parameter-server/worker harness (see
+SURVEY.md for the structural analysis), designed TPU-first: one SPMD program
+over a named device mesh, XLA collectives on ICI/DCN in place of the PS/gRPC
+data plane, a jit-compiled train step in place of the SyncReplicasOptimizer
+accumulator/token protocol, and a host-side callback loop with async
+multi-host checkpointing in place of MonitoredTrainingSession and its hooks.
+"""
+
+__version__ = "0.1.0"
+
+from . import parallel  # noqa: F401

@@ -1,0 +1,31 @@
+"""Parallelism core: mesh topology, cluster bootstrap, collectives, sharding.
+
+Replaces the reference's L1/L2 layers (SURVEY.md §1): ClusterSpec/Server/
+gRPC runtime and replica_device_setter placement.
+"""
+
+from .mesh import (  # noqa: F401
+    AXIS_NAMES,
+    BATCH_AXES,
+    DATA,
+    EXPERT,
+    FSDP,
+    MODEL,
+    PIPE,
+    SEQ,
+    MeshSpec,
+    build_mesh,
+    describe,
+    mesh_axis_size,
+    single_device_mesh,
+)
+from .cluster import (  # noqa: F401
+    ClusterConfig,
+    initialize,
+    is_chief,
+    process_count,
+    process_index,
+    sync_hosts,
+)
+from . import collectives  # noqa: F401
+from . import sharding  # noqa: F401

@@ -1,0 +1,94 @@
+"""Multi-host cluster bootstrap — replaces ClusterSpec/Server/TF_CONFIG.
+
+Reference mechanism (SURVEY.md §3.1, substrate $TF/python/training/
+server_lib.py:96,243): every process parses ``--job_name/--task_index``,
+builds a ClusterSpec naming every peer, and starts an in-process gRPC server;
+PS processes then block in ``server.join()`` forever.
+
+TPU-native shape: every host runs the *same* program. ``jax.distributed
+.initialize`` stands up the coordination service (the control plane the
+reference got from gRPC + TF_CONFIG), after which ``jax.devices()`` is global
+and XLA owns the data plane (ICI within a slice, DCN between slices). There
+are no roles — no PS, no "chief session" — only process 0 conventionally
+doing singleton host work (logging, checkpoint metadata), mirroring how the
+reference's chief ran init and the sync token queue (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """Topology flags. All default to single-process (the common TPU-VM case,
+    where the TPU runtime discovers peers itself and none of these are
+    needed — the analog of how TPUClusterResolver replaced hand-written
+    ClusterSpecs, $TF/python/distribute/cluster_resolver/tpu/
+    tpu_cluster_resolver.py:95).
+    """
+
+    coordinator_address: str | None = None  # "host:port" of process 0
+    num_processes: int | None = None
+    process_id: int | None = None
+    local_device_ids: tuple[int, ...] | None = None
+
+
+def initialize(config: ClusterConfig | None = None) -> None:
+    """Idempotent multi-host init. Safe to call in single-process runs.
+
+    Replaces the per-role bootstrap of SURVEY.md §3.1 (ClusterSpec → Server →
+    ps? join : build graph). Call once at program start, before any
+    device-touching JAX call.
+    """
+    global _initialized
+    if _initialized:
+        return
+    config = config or ClusterConfig()
+    explicit = config.coordinator_address is not None
+    env = "COORDINATOR_ADDRESS" in os.environ
+    if explicit or env:
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+            local_device_ids=config.local_device_ids,
+        )
+        logger.info(
+            "jax.distributed initialized: process %d/%d, %d local / %d global devices",
+            jax.process_index(), jax.process_count(),
+            jax.local_device_count(), jax.device_count(),
+        )
+    _initialized = True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_chief() -> bool:
+    """Process 0 — the singleton-host-work role. Unlike the reference's chief
+    (ChiefSessionCreator, $TF monitored_session.py:623) it holds no special
+    graph state: any process could take over after a restart."""
+    return jax.process_index() == 0
+
+
+def sync_hosts(name: str = "sync") -> None:
+    """Host-level barrier across processes (the reference's analog was the
+    token queue + wait_for_session, SURVEY.md §3.1). No-op single-process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

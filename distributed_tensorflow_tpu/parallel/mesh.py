@@ -1,0 +1,148 @@
+"""Device mesh & named-axis abstraction — the topology core of the framework.
+
+This replaces the reference harness's cluster-topology layer
+(``tf.train.ClusterSpec`` / ``tf.train.Server`` / ``replica_device_setter`` —
+see SURVEY.md §2b rows 1-3; substrate: $TF/python/training/server_lib.py:243,
+device_setter.py:129). Where the reference mapped *ops* onto *processes*
+(variables round-robin onto PS tasks, compute onto the local worker, gRPC
+inserted at every job boundary), a TPU-native design maps one SPMD program onto
+a named device mesh and lets XLA compile collectives onto ICI/DCN
+(SURVEY.md §2d, §5.8).
+
+Axis vocabulary (all six are always present; unused axes have size 1 so that
+every PartitionSpec in the codebase is valid on every mesh):
+
+- ``pipe``   — pipeline-parallel stages (1F1B schedule, parallel/pipeline.py)
+- ``data``   — pure data parallelism (gradient psum rides this axis)
+- ``fsdp``   — data parallelism with parameter/optimizer-state sharding
+               (ZeRO-style weight-update sharding, arXiv:2004.13336)
+- ``seq``    — sequence/context parallelism (ring attention / Ulysses,
+               parallel/ring_attention.py, SURVEY.md §5.7)
+- ``expert`` — expert parallelism for MoE token dispatch (mesh-axis stub per
+               SURVEY.md §2c; full MoE is out of baseline scope)
+- ``model``  — tensor parallelism (megatron-style column/row sharding)
+
+Axis order puts ``model`` innermost: ``mesh_utils.create_device_mesh`` assigns
+innermost axes to physically adjacent chips, so the highest-traffic collectives
+(TP all-gather / reduce-scatter every layer) ride the shortest ICI hops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Canonical axis order, outermost → innermost.
+AXIS_NAMES: tuple[str, ...] = ("pipe", "data", "fsdp", "seq", "expert", "model")
+
+PIPE, DATA, FSDP, SEQ, EXPERT, MODEL = AXIS_NAMES
+
+#: Axes over which a batch is split. Gradients are summed over these axes
+#: (explicitly under shard_map; implicitly by GSPMD under jit).
+BATCH_AXES: tuple[str, ...] = (DATA, FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. ``-1`` on at most one axis means "absorb the rest".
+
+    The TPU-native analog of the reference's ``--ps_hosts/--worker_hosts``
+    flags (SURVEY.md §5.6): instead of listing host:port endpoints, the user
+    names how many ways each *meaning* of parallelism is applied, and the
+    device mesh is derived from the physical topology.
+    """
+
+    pipe: int = 1
+    data: int = -1  # default: all remaining devices do data parallelism
+    fsdp: int = 1
+    seq: int = 1
+    expert: int = 1
+    model: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in AXIS_NAMES}
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        """Fill in the single -1 axis so the product equals ``n_devices``."""
+        sizes = self.sizes()
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"At most one axis may be -1, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product "
+                    f"{fixed} ({sizes})"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"Mesh {sizes} needs {fixed} devices but {n_devices} are "
+                f"available"
+            )
+        return MeshSpec(**sizes)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, int]) -> "MeshSpec":
+        unknown = set(d) - set(AXIS_NAMES)
+        if unknown:
+            raise ValueError(f"Unknown mesh axes {unknown}; valid: {AXIS_NAMES}")
+        return cls(**dict(d))
+
+
+def build_mesh(
+    spec: MeshSpec | Mapping[str, int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` with the canonical six named axes.
+
+    Replaces ``ClusterSpec`` + ``Server`` bootstrap (SURVEY.md §3.1 frames
+    1-3): there is no per-process server to start — the runtime owns
+    transport, and this mesh is the only topology object the user touches.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec()
+    if not isinstance(spec, MeshSpec):
+        spec = MeshSpec.from_dict(spec)
+    spec = spec.resolve(len(devices))
+    shape = tuple(spec.sizes()[name] for name in AXIS_NAMES)
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=np.asarray(devices, dtype=object)
+        )
+    except (ValueError, AssertionError, NotImplementedError):
+        # Fallback for topologies mesh_utils cannot optimize (e.g. CPU fake
+        # devices or single-chip): plain row-major reshape. Collective
+        # placement is still correct, just not hop-optimal.
+        dev_array = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(dev_array, AXIS_NAMES)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """A 1×1×1×1×1×1 mesh: lets every sharded code path run on one chip."""
+    if device is None:
+        device = jax.devices()[0]
+    return build_mesh(MeshSpec(data=1), [device])
+
+
+def mesh_axis_size(mesh: Mesh, axes: str | Sequence[str]) -> int:
+    """Product of the named axis sizes (e.g. total batch shards)."""
+    if isinstance(axes, str):
+        axes = (axes,)
+    return math.prod(mesh.shape[a] for a in axes)
+
+
+def describe(mesh: Mesh) -> str:
+    """Human-readable one-liner, e.g. 'pipe=1 data=4 fsdp=1 seq=1 expert=1 model=2 (8 devices, cpu)'."""
+    parts = " ".join(f"{a}={mesh.shape[a]}" for a in AXIS_NAMES)
+    plat = mesh.devices.flat[0].platform
+    return f"{parts} ({mesh.size} devices, {plat})"

@@ -1,0 +1,170 @@
+"""Sharding rules: logical axis names → mesh axes → NamedShardings.
+
+This is the placement layer — the TPU-native replacement for
+``replica_device_setter`` (SURVEY.md §2b; $TF/python/training/
+device_setter.py:129, round-robin chooser :92-125). The reference decided
+*which PS process owns each variable*; here we decide *how each array is laid
+out over the mesh*, and XLA materializes the movement. Three pieces:
+
+1. **Logical axis rules** — model code annotates each parameter dimension
+   with a logical name ("embed", "mlp", "heads", "vocab", …); a rule table
+   maps logical names to mesh axes. Swapping parallelism strategy = swapping
+   the table, not the model (the flax `logical axis` idiom, generalized).
+2. **Path rules** — regex over the parameter path → PartitionSpec, for
+   models that don't carry logical annotations.
+3. **Tree utilities** — build NamedShardings for whole pytrees, shard/assert
+   helpers, batch sharding over the (data, fsdp) axes.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+# ---------------------------------------------------------------------------
+# Logical axis rules
+# ---------------------------------------------------------------------------
+
+#: logical dimension name → mesh axis (or tuple of mesh axes, or None).
+LogicalRules = Mapping[str, str | tuple[str, ...] | None]
+
+#: Default rule table: pure data parallelism; params fully replicated.
+DP_RULES: LogicalRules = {
+    "batch": (mesh_lib.DATA, mesh_lib.FSDP),
+}
+
+#: Megatron-style tensor parallelism + batch over data/fsdp.
+TP_RULES: LogicalRules = {
+    "batch": (mesh_lib.DATA, mesh_lib.FSDP),
+    "vocab": mesh_lib.MODEL,
+    "embed": None,           # residual-stream dim stays replicated
+    "mlp": mesh_lib.MODEL,   # FFN hidden dim: column-parallel in, row-parallel out
+    "heads": mesh_lib.MODEL,  # attention heads
+    "kv": None,
+    "seq": mesh_lib.SEQ,
+    "expert": mesh_lib.EXPERT,
+}
+
+#: FSDP/ZeRO: additionally shard params' largest dim over fsdp axis.
+FSDP_RULES: LogicalRules = {
+    **TP_RULES,
+    "embed": mesh_lib.FSDP,
+}
+
+
+def spec_from_logical(
+    logical: Sequence[str | None], rules: LogicalRules
+) -> P:
+    """Map per-dimension logical names to a PartitionSpec under ``rules``."""
+    return P(*(rules.get(name) if name is not None else None for name in logical))
+
+
+# ---------------------------------------------------------------------------
+# Path-regex rules (for un-annotated models)
+# ---------------------------------------------------------------------------
+
+PathRules = Sequence[tuple[str, P]]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def specs_from_path_rules(tree: Any, rules: PathRules) -> Any:
+    """First-match-wins regex rules over parameter paths → PartitionSpec tree.
+
+    The descendant of the reference's round-robin variable chooser
+    (device_setter.py:113-121) — except placement is by *meaning* (matched
+    name), not by arrival order."""
+
+    def assign(path, leaf):
+        name = _path_str(path)
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        return P()  # replicated
+
+    return jax.tree_util.tree_map_with_path(assign, tree)
+
+
+# ---------------------------------------------------------------------------
+# NamedSharding / tree utilities
+# ---------------------------------------------------------------------------
+
+
+def named_sharding(mesh: Mesh, spec: P | None = None) -> NamedSharding:
+    return NamedSharding(mesh, spec if spec is not None else P())
+
+
+def tree_shardings(mesh: Mesh, spec_tree: Any) -> Any:
+    """PartitionSpec tree → NamedSharding tree."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_spec(ndim: int = 1) -> P:
+    """Shard dim 0 over (data, fsdp); replicate the rest. How every input
+    batch enters the mesh — replacing per-worker `tf.data.Dataset.shard`
+    by task_index (SURVEY.md §2a 'Input pipeline' row)."""
+    return P(mesh_lib.BATCH_AXES, *([None] * (ndim - 1)))
+
+
+def batch_shardings(mesh: Mesh, batch: Any) -> Any:
+    return jax.tree.map(
+        lambda x: NamedSharding(mesh, batch_spec(jnp.ndim(x))), batch
+    )
+
+
+def shard_tree(tree: Any, mesh: Mesh, spec_tree: Any) -> Any:
+    """Device_put a pytree with the given PartitionSpec tree."""
+    shardings = tree_shardings(mesh, spec_tree)
+    return jax.device_put(tree, shardings)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    return jax.device_put(
+        tree, jax.tree.map(lambda _: NamedSharding(mesh, P()), tree)
+    )
+
+
+def auto_fsdp_specs(params: Any, mesh: Mesh, *, min_size: int = 2**14) -> Any:
+    """ZeRO-style automatic weight sharding (arXiv:2004.13336, PAPERS.md):
+    shard each parameter's largest divisible dimension over the fsdp axis;
+    leave small params replicated. Used for optimizer state and (under pure
+    FSDP) the params themselves."""
+    n = mesh.shape[mesh_lib.FSDP]
+
+    def assign(x):
+        if n == 1 or x.size < min_size:
+            return P()
+        dims = list(x.shape)
+        # largest dim divisible by the fsdp axis size
+        best = max(
+            (d for d in range(len(dims)) if dims[d] % n == 0),
+            key=lambda d: dims[d],
+            default=None,
+        )
+        if best is None:
+            return P()
+        spec = [None] * len(dims)
+        spec[best] = mesh_lib.FSDP
+        return P(*spec)
+
+    return jax.tree.map(assign, params)

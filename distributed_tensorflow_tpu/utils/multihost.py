@@ -1,0 +1,65 @@
+"""Cross-host divergence detection — the distributed analog of a race
+detector (SURVEY.md §5.2).
+
+The SPMD model eliminates parameter data races by construction (the
+reference's race surface was async PS updates, documented as 'stale
+gradients' at $TF sync_replicas_optimizer.py:48-55, plus Coordinator thread
+lifecycle). What can still go wrong on TPU is *cross-host divergence*: hosts
+disagreeing on step count, RNG keys, compiled program, or data order —
+which deadlocks or silently corrupts collectives. Debug-mode asserts here
+catch it early; enable via ``DebugConfig.check_divergence`` or the
+``DTF_TPU_CHECK_DIVERGENCE`` env var.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def divergence_checks_enabled() -> bool:
+    return os.environ.get("DTF_TPU_CHECK_DIVERGENCE", "0") not in ("0", "", "false")
+
+
+def _fingerprint(tree: Any) -> np.ndarray:
+    """Stable 64-bit host-side fingerprint of a small pytree."""
+    leaves = jax.tree.leaves(tree)
+    h = hashlib.blake2b(digest_size=8)
+    for leaf in leaves:
+        h.update(np.asarray(leaf).tobytes())
+    return np.frombuffer(h.digest(), dtype=np.int64)
+
+
+def assert_same_across_hosts(tree: Any, name: str = "value") -> None:
+    """Raise if any host disagrees on ``tree`` (step counters, RNG keys,
+    loss scalars — cheap things, not parameters). No-op single-process.
+
+    The reference's closest mechanism was nothing at harness level; TF's
+    modern substrate grew coordination-service health checks. This is the
+    SPMD-native version: fingerprint + process_allgather + compare.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    fp = _fingerprint(tree)
+    all_fps = multihost_utils.process_allgather(fp)
+    if not np.all(all_fps == all_fps[0]):
+        raise AssertionError(
+            f"Cross-host divergence on '{name}': fingerprints "
+            f"{all_fps.ravel().tolist()} differ across processes"
+        )
+
+
+def broadcast_from_chief(tree: Any) -> Any:
+    """Make every host adopt process 0's value (config resolution, run ids).
+    No-op single-process."""
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(tree)

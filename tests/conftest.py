@@ -1,0 +1,48 @@
+"""Test rig: 8 fake CPU devices in one process (SURVEY.md §4.2).
+
+The analog of TF's `create_in_process_cluster` ($TF/python/distribute/
+multi_worker_test_base.py:123): every collective/sharding test runs on CI
+hardware with no TPU. The environment may pre-import jax and pre-set
+JAX_PLATFORMS (e.g. a TPU tunnel platform), so we force the CPU backend via
+jax.config before any device is touched — backends initialize lazily, so
+this is safe as long as conftest runs before the first jax.devices() call.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, (
+        f"test rig expects >=8 fake devices, got {len(devs)}; "
+        "was a jax backend initialized before conftest?"
+    )
+    return devs
+
+
+@pytest.fixture()
+def mesh8(devices):
+    from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(data=8), devices[:8])
+
+
+@pytest.fixture()
+def mesh_dp4_tp2(devices):
+    from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(data=4, model=2), devices[:8])

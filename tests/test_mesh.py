@@ -1,0 +1,60 @@
+import jax
+import pytest
+
+from distributed_tensorflow_tpu.parallel import (
+    AXIS_NAMES,
+    MeshSpec,
+    build_mesh,
+    describe,
+    mesh_axis_size,
+    single_device_mesh,
+)
+
+
+def test_axis_names_order():
+    assert AXIS_NAMES == ("pipe", "data", "fsdp", "seq", "expert", "model")
+
+
+def test_resolve_wildcard():
+    spec = MeshSpec(data=-1, model=2).resolve(8)
+    assert spec.data == 4 and spec.model == 2
+
+
+def test_resolve_exact():
+    spec = MeshSpec(pipe=2, data=2, model=2).resolve(8)
+    assert spec.data == 2
+
+
+def test_resolve_errors():
+    with pytest.raises(ValueError):
+        MeshSpec(data=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, model=3).resolve(8)
+
+
+def test_from_dict_rejects_unknown():
+    with pytest.raises(ValueError):
+        MeshSpec.from_dict({"tensor": 2})
+
+
+def test_build_mesh_shape(mesh_dp4_tp2):
+    assert mesh_dp4_tp2.shape["data"] == 4
+    assert mesh_dp4_tp2.shape["model"] == 2
+    assert mesh_dp4_tp2.size == 8
+    assert mesh_axis_size(mesh_dp4_tp2, ("data", "fsdp")) == 4
+
+
+def test_single_device_mesh():
+    m = single_device_mesh()
+    assert m.size == 1
+    assert set(m.shape.keys()) == set(AXIS_NAMES)
+
+
+def test_describe(mesh8):
+    s = describe(mesh8)
+    assert "data=8" in s and "8 devices" in s
+
+
+def test_all_devices_used(mesh_dp4_tp2):
+    ids = sorted(d.id for d in mesh_dp4_tp2.devices.flat)
+    assert ids == sorted(d.id for d in jax.devices()[:8])

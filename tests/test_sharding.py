@@ -1,0 +1,58 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import sharding as sh
+from distributed_tensorflow_tpu.parallel import DATA, FSDP, MODEL
+
+
+def test_spec_from_logical():
+    spec = sh.spec_from_logical(["batch", "embed"], sh.TP_RULES)
+    assert spec == P(("data", "fsdp"), None)
+    spec = sh.spec_from_logical(["embed", "mlp"], sh.TP_RULES)
+    assert spec == P(None, "model")
+
+
+def test_path_rules_first_match_wins():
+    tree = {"dense1": {"kernel": jnp.zeros((4, 4)), "bias": jnp.zeros((4,))}}
+    rules = [
+        (r"kernel", P(None, MODEL)),
+        (r".*", P()),
+    ]
+    specs = sh.specs_from_path_rules(tree, rules)
+    assert specs["dense1"]["kernel"] == P(None, MODEL)
+    assert specs["dense1"]["bias"] == P()
+
+
+def test_shard_tree_and_batch(mesh_dp4_tp2):
+    x = jnp.zeros((8, 16))
+    sharded = jax.device_put(
+        x, sh.named_sharding(mesh_dp4_tp2, sh.batch_spec(2))
+    )
+    # batch dim split over data*fsdp = 4 shards
+    assert sharded.sharding.spec == P(("data", "fsdp"), None)
+    shard_shapes = {s.data.shape for s in sharded.addressable_shards}
+    assert shard_shapes == {(2, 16)}
+
+
+def test_auto_fsdp_specs():
+    devices = jax.devices()[:8]
+    from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(data=2, fsdp=4), devices)
+    params = {
+        "big": jnp.zeros((128, 256)),
+        "small": jnp.zeros((4,)),
+        "odd": jnp.zeros((33333,)),  # not divisible by 4
+    }
+    specs = sh.auto_fsdp_specs(params, mesh, min_size=16)
+    assert specs["big"] == P(None, FSDP)
+    assert specs["small"] == P()
+    assert specs["odd"] == P()
+
+
+def test_replicate(mesh8):
+    tree = {"w": jnp.ones((4, 4))}
+    rep = sh.replicate(tree, mesh8)
+    assert rep["w"].sharding.spec == P()
