@@ -1,0 +1,28 @@
+"""Training engine: jit step, optimizers, host loop, callbacks, checkpoint.
+
+Replaces the reference's L3 harness layer (SURVEY.md §1):
+SyncReplicasOptimizer → step.py; MonitoredTrainingSession + hooks →
+loop.py + callbacks.py; Saver/Scaffold → checkpoint.py; optimizer zoo →
+optimizers.py.
+"""
+
+from .step import (  # noqa: F401
+    StepOptions,
+    TrainState,
+    init_train_state,
+    jit_train_step,
+    make_eval_step,
+    make_train_step,
+    opt_state_specs,
+    state_specs,
+)
+from .optimizers import OptimizerConfig, make_optimizer, make_schedule  # noqa: F401
+from .loop import Trainer  # noqa: F401
+from . import callbacks  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointConfig,
+    Checkpointer,
+    PreemptionSaved,
+    PreemptionWatcher,
+    init_or_restore,
+)

@@ -1,0 +1,163 @@
+"""Host-loop callbacks — one per reference session hook (SURVEY.md §2b
+'Session hooks' row; $TF/python/training/basic_session_run_hooks.py).
+
+Hooks decorated Session.run with extra fetches; callbacks observe the
+*already-computed* per-step metrics dict the jit step returns. Metrics are
+device arrays and fetching blocks on the step — so callbacks that read
+values do it on a cadence (every_n), keeping the steady-state loop fully
+async (host dispatches step N+1 while N executes).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..parallel import cluster
+from ..utils import flops as flops_lib
+
+logger = logging.getLogger(__name__)
+
+
+class Callback:
+    def on_train_start(self, trainer) -> None: ...
+    def on_step_end(self, trainer, step: int, metrics: dict[str, Any]) -> None: ...
+    def on_train_end(self, trainer) -> None: ...
+
+
+class StopAtStep(Callback):
+    """$TF basic_session_run_hooks.py:393 StopAtStepHook."""
+
+    def __init__(self, last_step: int):
+        self.last_step = last_step
+
+    def on_step_end(self, trainer, step, metrics):
+        if step >= self.last_step:
+            trainer.request_stop(f"reached last_step={self.last_step}")
+
+
+class MetricsLogger(Callback):
+    """StepCounterHook + LoggingTensorHook (:674, :169): steps/sec,
+    examples/sec, MFU, and the metric dict, every N steps. Only the chief
+    logs (matching the reference's chief-only summaries), but every process
+    *fetches* — keeping hosts in lockstep."""
+
+    def __init__(self, every_n: int = 100, batch_size: int | None = None,
+                 model_flops_per_step: float | None = None,
+                 history: bool = False):
+        self.every_n = every_n
+        self.batch_size = batch_size
+        self.model_flops = model_flops_per_step
+        self._t0: float | None = None
+        self._step0 = 0
+        self.history: list[dict] = [] if history else None
+        self.last: dict[str, float] = {}
+
+    def on_train_start(self, trainer):
+        self._t0 = None
+
+    def on_step_end(self, trainer, step, metrics):
+        if step % self.every_n != 0:
+            return
+        fetched = {k: float(np.asarray(v)) for k, v in metrics.items()}
+        now = time.perf_counter()
+        if self._t0 is not None:
+            dt = now - self._t0
+            steps_per_sec = (step - self._step0) / max(dt, 1e-9)
+            fetched["steps_per_sec"] = steps_per_sec
+            if self.batch_size:
+                fetched["examples_per_sec"] = steps_per_sec * self.batch_size
+            if self.model_flops:
+                fetched["mfu"] = flops_lib.mfu(
+                    self.model_flops, steps_per_sec, jax.device_count()
+                )
+        self._t0, self._step0 = now, step
+        self.last = fetched
+        if self.history is not None:
+            self.history.append({"step": step, **fetched})
+        if cluster.is_chief():
+            msg = " ".join(
+                f"{k}={v:.6g}" for k, v in sorted(fetched.items())
+            )
+            logger.info("step %d: %s", step, msg)
+
+
+class NaNGuard(Callback):
+    """NanTensorHook (:761): stop (or raise) when the step reports non-finite
+    loss/grads. Reads the on-device `grads_finite`/`loss` signals the step
+    engine piggybacks on its output (SURVEY.md §5.5)."""
+
+    def __init__(self, every_n: int = 10, fail_fast: bool = True):
+        self.every_n = every_n
+        self.fail_fast = fail_fast
+
+    def on_step_end(self, trainer, step, metrics):
+        if step % self.every_n != 0:
+            return
+        bad = False
+        if "grads_finite" in metrics:
+            bad |= float(np.asarray(metrics["grads_finite"])) == 0.0
+        if "loss" in metrics:
+            bad |= not np.isfinite(np.asarray(metrics["loss"]))
+        if bad:
+            msg = f"non-finite loss/gradients at step {step}"
+            if self.fail_fast:
+                raise FloatingPointError(msg)
+            trainer.request_stop(msg)
+
+
+class Profiler(Callback):
+    """ProfilerHook (:1013) → jax.profiler traces (same XPlane/TensorBoard
+    wire format as TF's, SURVEY.md §5.1)."""
+
+    def __init__(self, logdir: str, start_step: int = 10, num_steps: int = 5):
+        self.logdir = logdir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+
+    def on_step_end(self, trainer, step, metrics):
+        if step == self.start_step and not self._active:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif step >= self.stop_step and self._active:
+            jax.tree.map(
+                lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x,
+                metrics,
+            )
+            jax.profiler.stop_trace()
+            self._active = False
+            if cluster.is_chief():
+                logger.info("profile written to %s", self.logdir)
+
+    def on_train_end(self, trainer):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+class CheckpointCallback(Callback):
+    """CheckpointSaverHook (:524): delegates cadence + retention to the
+    checkpoint manager (train/checkpoint.py); also saves on clean train end
+    and on preemption (SURVEY.md §5.3/5.4). Named distinctly from the
+    train.checkpoint.Checkpointer manager it wraps."""
+
+    def __init__(self, manager):
+        self.manager = manager
+
+    def on_step_end(self, trainer, step, metrics):
+        self.manager.maybe_save(step, trainer.state)
+
+    def on_train_end(self, trainer):
+        if trainer.failed:
+            # Aborting on an error (e.g. NaNGuard): the in-memory state may
+            # be poisoned — never let it become the latest checkpoint.
+            logger.warning("skipping final checkpoint: training failed")
+            self.manager.wait()
+            return
+        self.manager.save(int(trainer.state.step), trainer.state, force=True)
+        self.manager.wait()

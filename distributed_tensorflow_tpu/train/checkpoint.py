@@ -1,0 +1,196 @@
+"""Checkpoint/resume: async multi-host save, retention, preemption.
+
+Replaces the reference's Saver stack (SURVEY.md §3.4, §5.4): `Saver.save`
+($TF saver.py:642) driven by CheckpointSaverHook cadence, chief-only writes,
+`CheckpointManager` retention/GC (checkpoint_management.py:519), restore via
+ChiefSessionCreator/Scaffold, and the modern PreemptionCheckpointHandler
+($TF failure_handling.py:337).
+
+TPU-native differences: every host writes its own parameter shards in
+parallel (orbax multi-host layout) instead of the chief serializing
+everything through one process; saves are async (a host thread overlaps the
+next training steps); restore is sharding-aware (each host reads only its
+shards); preemption (SIGTERM / maintenance event) triggers one final
+coordinated save and a clean exit, and recovery is restart-and-resume
+rather than the reference's in-session _RecoverableSession retry loop
+(monitored_session.py:1302) — TPU slices fail whole, so elasticity is
+checkpoint-restart (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import threading
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel import cluster
+from ..utils import config as config_lib
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    directory: str = ""
+    save_interval_steps: int = 1000
+    max_to_keep: int = 3
+    async_save: bool = True
+    save_on_preemption: bool = True
+
+
+class PreemptionWatcher:
+    """SIGTERM/SIGINT-aware flag — the TerminationConfig/
+    PreemptionCheckpointHandler analog ($TF failure_handling.py:75,337).
+    GCE maintenance events arrive as SIGTERM on TPU VMs."""
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._event = threading.Event()
+        self._prev = {}
+        if threading.current_thread() is threading.main_thread():
+            for sig in signals:
+                self._prev[sig] = signal.signal(sig, self._handler)
+
+    def _handler(self, signum, frame):
+        logger.warning("preemption signal %s received", signum)
+        self._event.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+
+class Checkpointer:
+    """Save/restore + retention + preemption, over an orbax
+    CheckpointManager. One instance per run; also usable standalone for
+    eval-side restore (SURVEY.md §3.5 pattern)."""
+
+    def __init__(self, cfg: CheckpointConfig, mesh: Mesh, spec_tree: Any = None):
+        if not cfg.directory:
+            raise ValueError("CheckpointConfig.directory is required")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.spec_tree = spec_tree
+        self.watcher = PreemptionWatcher() if cfg.save_on_preemption else None
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=cfg.max_to_keep,
+            save_interval_steps=cfg.save_interval_steps,
+            enable_async_checkpointing=cfg.async_save,
+        )
+        self.manager = ocp.CheckpointManager(
+            os.path.abspath(os.path.expanduser(cfg.directory)), options=options
+        )
+
+    # -- save -------------------------------------------------------------
+    def maybe_save(self, step: int, state: Any) -> bool:
+        """Cadence save; also fires unconditionally on observed preemption
+        (then asks the caller loop to stop via the returned flag +
+        PreemptionError)."""
+        if self.watcher is not None and self.watcher.preempted:
+            self.save(step, state, force=True)
+            self.wait()
+            raise PreemptionSaved(step)
+        return self.save(step, state)
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        if step in self.manager.all_steps():
+            return False  # already saved (e.g. cadence save + final save)
+        saved = self.manager.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+        if saved and cluster.is_chief():
+            logger.info("checkpoint saved at step %d", step)
+        return saved
+
+    def save_config(self, cfg_obj: Any) -> None:
+        """Serialize the run config next to checkpoints (SURVEY.md §5.6
+        reproducibility rule). Chief-only host file."""
+        if cluster.is_chief():
+            path = os.path.join(
+                os.path.abspath(os.path.expanduser(self.cfg.directory)),
+                "config.json",
+            )
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                f.write(config_lib.to_json(cfg_obj))
+
+    def wait(self) -> None:
+        self.manager.wait_until_finished()
+
+    # -- restore ----------------------------------------------------------
+    def latest_step(self) -> int | None:
+        """latest_checkpoint analog ($TF checkpoint_management.py:329)."""
+        return self.manager.latest_step()
+
+    def restore(self, abstract_state: Any, step: int | None = None) -> Any:
+        """Sharding-aware restore: each host reads only its shards.
+
+        ``abstract_state``: pytree of jax.ShapeDtypeStruct (e.g. from
+        jax.eval_shape over the init fn) — combined with spec_tree it tells
+        orbax the target sharding. Returns None if no checkpoint exists
+        (caller falls back to fresh init — the Scaffold init-or-restore
+        decision, $TF monitored_session.py:52, without a chief)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        if self.spec_tree is not None:
+            target = jax.tree.map(
+                lambda s, spec: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=NamedSharding(self.mesh, spec)
+                ),
+                abstract_state,
+                self.spec_tree,
+                is_leaf=lambda x: isinstance(x, (jax.ShapeDtypeStruct, P)),
+            )
+        else:
+            target = abstract_state
+        state = self.manager.restore(step, args=ocp.args.StandardRestore(target))
+        if cluster.is_chief():
+            logger.info("restored checkpoint at step %d", step)
+        return state
+
+    def close(self) -> None:
+        self.manager.close()
+
+
+class PreemptionSaved(RuntimeError):
+    """Raised after a successful preemption-triggered save; the run loop
+    should exit cleanly so the scheduler can restart-and-resume."""
+
+    def __init__(self, step: int):
+        super().__init__(f"preempted; checkpoint saved at step {step}")
+        self.step = step
+
+
+def init_or_restore(
+    checkpointer: Checkpointer,
+    init_fn,
+    tx,
+    mesh: Mesh,
+    rng: jax.Array,
+    **init_kwargs,
+):
+    """The one-call init-or-restore every train script uses. Builds the
+    sharded fresh state (train/step.init_train_state), then overwrites from
+    the latest checkpoint if one exists. Returns (state, spec_tree,
+    restored_bool)."""
+    from . import step as step_lib
+
+    state, specs = step_lib.init_train_state(
+        init_fn, tx, mesh, rng, **init_kwargs
+    )
+    checkpointer.spec_tree = specs
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+    )
+    restored = checkpointer.restore(abstract)
+    if restored is not None:
+        return restored, specs, True
+    return state, specs, False

@@ -1,0 +1,116 @@
+"""Host run loop — replaces MonitoredTrainingSession (SURVEY.md §2b, §3.1).
+
+The reference's loop was `while not mon_sess.should_stop():
+mon_sess.run(train_op)` behind four session wrappers (_RecoverableSession /
+_CoordinatedSession / _HookedSession, $TF monitored_session.py:1238-1447).
+Here the loop is plain Python driving one jit-ed SPMD step: the
+chief-vs-worker split, session recovery, and graph-side hook fetches have no
+TPU equivalent — recovery is checkpoint-restart (train/checkpoint.py) and
+hooks are host callbacks over the step's returned metrics.
+
+The loop stays *async*: the host dispatches step N+1 while N executes on
+device; only cadence'd callbacks (logging every N) synchronize.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterable, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from ..parallel import sharding as sh
+from . import step as step_lib
+from .callbacks import Callback
+
+logger = logging.getLogger(__name__)
+
+
+class Trainer:
+    """Owns: the compiled step, the state, the data feed, the callbacks.
+
+    Replaces the MonitoredTrainingSession factory (monitored_session.py:428)
+    plus the Supervisor legacy path (supervisor.py:40): one class, no roles.
+    """
+
+    def __init__(
+        self,
+        train_step: Callable,
+        state: step_lib.TrainState,
+        mesh: Mesh,
+        spec_tree: step_lib.TrainState,
+        callbacks: Sequence[Callback] = (),
+        donate: bool = True,
+    ):
+        self.mesh = mesh
+        self.spec_tree = spec_tree
+        self.state = state
+        self.callbacks = list(callbacks)
+        self._stop_reason: str | None = None
+        self.failed = False  # set when fit() aborts on an exception
+        if donate:
+            self.step_fn = step_lib.jit_train_step(train_step, mesh, spec_tree)
+        else:
+            self.step_fn = jax.jit(train_step)
+
+    # -- control ----------------------------------------------------------
+    def request_stop(self, reason: str = "") -> None:
+        """Cooperative stop — the Coordinator.request_stop analog
+        ($TF coordinator.py:28)."""
+        if self._stop_reason is None:
+            self._stop_reason = reason or "requested"
+
+    @property
+    def should_stop(self) -> bool:
+        return self._stop_reason is not None
+
+    # -- data -------------------------------------------------------------
+    def put_batch(self, batch: Any) -> Any:
+        """Host batch (this process's shard of the global batch) → sharded
+        global device array over (data, fsdp). Replaces per-worker
+        Dataset.shard-by-task_index feeding (SURVEY.md §2a)."""
+        shardings = jax.tree.map(
+            lambda x: NamedSharding(self.mesh, sh.batch_spec(x.ndim)), batch
+        )
+        return jax.tree.map(
+            lambda x, s: jax.make_array_from_process_local_data(s, x),
+            batch, shardings,
+        )
+
+    # -- loop -------------------------------------------------------------
+    def fit(
+        self,
+        data: Iterable[Any],
+        num_steps: int | None = None,
+    ) -> step_lib.TrainState:
+        for cb in self.callbacks:
+            cb.on_train_start(self)
+        data_iter = iter(data)
+        # Host-side step mirror: reading state.step would sync the device
+        # every iteration and serialize dispatch with execution.
+        step_now = int(self.state.step)
+        try:
+            while not self.should_stop:
+                if num_steps is not None and step_now >= num_steps:
+                    self.request_stop(f"num_steps={num_steps}")
+                    break
+                try:
+                    batch = next(data_iter)
+                except StopIteration:
+                    self.request_stop("data exhausted")
+                    break
+                batch = self.put_batch(batch)
+                self.state, metrics = self.step_fn(self.state, batch)
+                step_now += 1
+                for cb in self.callbacks:
+                    cb.on_step_end(self, step_now, metrics)
+        except BaseException:
+            self.failed = True
+            raise
+        finally:
+            for cb in self.callbacks:
+                cb.on_train_end(self)
+        if self._stop_reason:
+            logger.info("training stopped: %s", self._stop_reason)
+        return self.state

@@ -1,0 +1,130 @@
+"""Optimizer zoo + LR schedules — the reference's trainer menu, via optax.
+
+Covers every optimizer the reference's substrate shipped under
+$TF/python/training/ (gradient_descent.py, momentum.py, adam.py, adagrad.py,
+ftrl.py, rmsprop.py — SURVEY.md §2b 'Optimizer zoo' row) plus the modern
+ones the workloads expect (adamw for BERT, lamb for large-batch pretraining).
+``CrossShardOptimizer`` ($TF/python/tpu/tpu_optimizer.py) has no equivalent
+here by design: gradient cross-replica aggregation is the step engine's job
+(GSPMD psum), not an optimizer wrapper's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    name: str = "sgd"  # sgd|momentum|adam|adamw|adagrad|ftrl|rmsprop|lamb|adafactor
+    learning_rate: float = 0.01
+    # schedule: constant|cosine|warmup_cosine|exponential|linear
+    schedule: str = "constant"
+    warmup_steps: int = 0
+    total_steps: int = 0  # required by cosine/linear decays
+    end_lr_factor: float = 0.0  # final lr = learning_rate * factor
+    decay_rate: float = 0.96  # exponential
+    decay_steps: int = 1000  # exponential
+    momentum: float = 0.9
+    nesterov: bool = False
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    # ftrl
+    lr_power: float = -0.5
+    l1: float = 0.0
+    l2: float = 0.0
+    clip_grad_norm: float = 0.0  # 0 = off; applied as optax.clip_by_global_norm
+
+
+def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
+    lr = cfg.learning_rate
+    if cfg.schedule == "constant":
+        base = optax.constant_schedule(lr)
+    elif cfg.schedule == "cosine":
+        base = optax.cosine_decay_schedule(
+            lr, max(cfg.total_steps - cfg.warmup_steps, 1), alpha=cfg.end_lr_factor
+        )
+    elif cfg.schedule == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            0.0, lr, cfg.warmup_steps, max(cfg.total_steps, 1),
+            end_value=lr * cfg.end_lr_factor,
+        )
+    elif cfg.schedule == "exponential":
+        base = optax.exponential_decay(lr, cfg.decay_steps, cfg.decay_rate)
+    elif cfg.schedule == "linear":
+        base = optax.linear_schedule(
+            lr, lr * cfg.end_lr_factor, max(cfg.total_steps - cfg.warmup_steps, 1)
+        )
+    else:
+        raise ValueError(f"Unknown schedule '{cfg.schedule}'")
+    if cfg.warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, lr, cfg.warmup_steps)
+        return optax.join_schedules([warmup, base], [cfg.warmup_steps])
+    return base
+
+
+def _l1_subgradient(l1: float) -> optax.GradientTransformation:
+    """Add l1·sign(w) to the gradient — subgradient L1, standing in for
+    FTRL's proximal L1 shrinkage (close for dense TPU updates; the exact
+    proximal form matters mainly in the sparse PS regime)."""
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("l1 regularization requires params")
+        import jax
+        import jax.numpy as jnp
+
+        updates = jax.tree.map(
+            lambda g, p: g + l1 * jnp.sign(p), updates, params
+        )
+        return updates, state
+
+    return optax.GradientTransformation(init, update)
+
+
+def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    sched = make_schedule(cfg)
+    name = cfg.name.lower()
+    if name == "sgd":
+        tx = optax.sgd(sched)
+    elif name == "momentum":
+        tx = optax.sgd(sched, momentum=cfg.momentum, nesterov=cfg.nesterov)
+    elif name == "adam":
+        tx = optax.adam(sched, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
+    elif name == "adamw":
+        tx = optax.adamw(
+            sched, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps, weight_decay=cfg.weight_decay
+        )
+    elif name == "adagrad":
+        tx = optax.adagrad(sched, eps=cfg.eps)
+    elif name == "ftrl":
+        # optax has no ftrl; compose adagrad (FTRL's per-coordinate adaptive
+        # lr) + L1 subgradient + L2 decay. The reference used FTRL for the
+        # sparse/PS Wide&Deep regime; on TPU updates are dense.
+        parts = []
+        if cfg.l1:
+            parts.append(_l1_subgradient(cfg.l1))
+        if cfg.l2:
+            parts.append(optax.add_decayed_weights(cfg.l2))
+        tx = optax.chain(*parts, optax.adagrad(sched, eps=cfg.eps))
+    elif name == "rmsprop":
+        tx = optax.rmsprop(sched, momentum=cfg.momentum, eps=cfg.eps)
+    elif name == "lamb":
+        tx = optax.lamb(
+            sched, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps, weight_decay=cfg.weight_decay
+        )
+    elif name == "adafactor":
+        tx = optax.adafactor(sched)
+    else:
+        raise ValueError(f"Unknown optimizer '{cfg.name}'")
+    if cfg.clip_grad_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.clip_grad_norm), tx)
+    return tx

@@ -1,0 +1,80 @@
+import dataclasses
+
+import pytest
+
+from distributed_tensorflow_tpu.utils import config as cfg_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class Inner:
+    lr: float = 0.1
+    steps: int = 100
+    name: str = "sgd"
+    flag: bool = False
+    dims: tuple = (1, 2)
+
+
+@dataclasses.dataclass(frozen=True)
+class Outer:
+    inner: Inner = dataclasses.field(default_factory=Inner)
+    seed: int = 0
+
+
+def test_apply_overrides_nested():
+    cfg = cfg_lib.apply_overrides(
+        Outer(), ["inner.lr=0.5", "inner.steps=7", "seed=42"]
+    )
+    assert cfg.inner.lr == 0.5
+    assert cfg.inner.steps == 7
+    assert cfg.seed == 42
+
+
+def test_override_types():
+    cfg = cfg_lib.apply_overrides(
+        Outer(), ["inner.flag=true", "inner.name=adam", "inner.dims=[3,4]"]
+    )
+    assert cfg.inner.flag is True
+    assert cfg.inner.name == "adam"
+    assert cfg.inner.dims == (3, 4)
+
+
+def test_override_unknown_key():
+    with pytest.raises(ValueError, match="Unknown config key"):
+        cfg_lib.apply_overrides(Outer(), ["inner.nope=1"])
+
+
+def test_roundtrip_json():
+    cfg = Outer(inner=Inner(lr=0.3, dims=(5, 6)), seed=9)
+    d = cfg_lib.to_dict(cfg)
+    back = cfg_lib.from_dict(Outer, d)
+    assert back == cfg
+
+
+def test_parse_argv_ignores_positional():
+    cfg = cfg_lib.parse_argv(Outer(), ["prog", "--seed=5", "positional"])
+    assert cfg.seed == 5
+
+
+def test_overrides_on_future_annotations_config():
+    """Package configs use `from __future__ import annotations`; overrides
+    must resolve their string type annotations (regression: NameError on
+    'float' when builtins were blanked)."""
+    from distributed_tensorflow_tpu.train import OptimizerConfig
+
+    cfg = cfg_lib.apply_overrides(
+        OptimizerConfig(), ["learning_rate=0.5", "warmup_steps=3", "nesterov=true"]
+    )
+    assert cfg.learning_rate == 0.5
+    assert cfg.warmup_steps == 3
+    assert cfg.nesterov is True
+
+
+def test_optional_none_override():
+    @dataclasses.dataclass(frozen=True)
+    class C:
+        limit: int | None = 5
+
+    cfg = cfg_lib.apply_overrides(C(), ["limit=none"])
+    assert cfg.limit is None
+    cfg = cfg_lib.apply_overrides(C(), ["limit=7"])
+    assert cfg.limit == 7

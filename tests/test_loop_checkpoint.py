@@ -1,0 +1,203 @@
+"""Trainer loop + callbacks + checkpoint/resume tests (SURVEY.md §4.3/§5.4:
+save, kill, resume must reproduce the uninterrupted run)."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.train import (
+    CheckpointConfig,
+    Checkpointer,
+    OptimizerConfig,
+    Trainer,
+    init_or_restore,
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+    callbacks as cb,
+)
+
+from test_step import linear_init, linear_loss, make_batch
+
+
+def batches(n, size=16):
+    for i in range(n):
+        yield make_batch(size, seed=i)
+
+
+def build_trainer(mesh, tx=None, callbacks=(), state=None, specs=None):
+    tx = tx or optax.sgd(0.1)
+    if state is None:
+        state, specs = init_train_state(
+            linear_init, tx, mesh, jax.random.PRNGKey(0)
+        )
+    step = make_train_step(linear_loss, tx)
+    return Trainer(step, state, mesh, specs, callbacks=callbacks)
+
+
+def test_fit_runs_and_stops_at_num_steps(mesh8):
+    trainer = build_trainer(mesh8)
+    state = trainer.fit(batches(100), num_steps=5)
+    assert int(state.step) == 5
+
+
+def test_stop_at_step_callback(mesh8):
+    trainer = build_trainer(mesh8, callbacks=[cb.StopAtStep(3)])
+    state = trainer.fit(batches(100))
+    assert int(state.step) == 3
+
+
+def test_metrics_logger(mesh8, caplog):
+    logger_cb = cb.MetricsLogger(every_n=2, batch_size=16, history=True)
+    trainer = build_trainer(mesh8, callbacks=[logger_cb, cb.StopAtStep(6)])
+    with caplog.at_level(logging.INFO):
+        trainer.fit(batches(100))
+    assert logger_cb.history, "logger recorded nothing"
+    assert "loss" in logger_cb.history[-1]
+    assert "steps_per_sec" in logger_cb.history[-1]
+
+
+def test_nan_guard_raises(mesh8):
+    def nan_loss(params, model_state, batch, rng):
+        loss = jnp.sum(params["w"]) * jnp.nan
+        return loss, (model_state, {})
+
+    tx = optax.sgd(0.1)
+    state, specs = init_train_state(linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    trainer = Trainer(
+        make_train_step(nan_loss, tx), state, mesh8, specs,
+        callbacks=[cb.NaNGuard(every_n=1)],
+    )
+    with pytest.raises(FloatingPointError):
+        trainer.fit(batches(10), num_steps=5)
+
+
+def test_optimizer_zoo_smoke(mesh8):
+    for name in ["sgd", "momentum", "adam", "adamw", "adagrad", "rmsprop",
+                 "lamb", "ftrl", "adafactor"]:
+        tx = make_optimizer(OptimizerConfig(name=name, learning_rate=1e-2))
+        trainer = build_trainer(mesh8, tx=tx)
+        state = trainer.fit(batches(3), num_steps=2)
+        assert int(state.step) == 2, name
+
+
+def test_schedules_smoke():
+    from distributed_tensorflow_tpu.train import make_schedule
+
+    for sched in ["constant", "cosine", "warmup_cosine", "exponential", "linear"]:
+        fn = make_schedule(OptimizerConfig(
+            schedule=sched, learning_rate=0.1, warmup_steps=5, total_steps=50
+        ))
+        vals = [float(fn(i)) for i in [0, 10, 49]]
+        assert all(np.isfinite(vals)), sched
+
+
+def test_checkpoint_save_restore_resume(mesh8, tmp_path):
+    """The §5.4 oracle: train 6 steps straight == train 3, 'crash', resume 3."""
+    tx = optax.adam(1e-2)
+
+    # straight run, 6 steps
+    state, specs = init_train_state(linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    trainer = Trainer(make_train_step(linear_loss, tx), state, mesh8, specs)
+    straight = trainer.fit(batches(6), num_steps=6)
+
+    # interrupted run: 3 steps, save, fresh process simulation, resume 3
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=ckpt_dir, save_interval_steps=1,
+                         async_save=False, save_on_preemption=False),
+        mesh8,
+    )
+    state, specs, restored = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    assert not restored
+    trainer = Trainer(
+        make_train_step(linear_loss, tx), state, mesh8, specs,
+        callbacks=[cb.CheckpointCallback(ckpt)],
+    )
+    trainer.fit(batches(3), num_steps=3)
+    ckpt.wait()
+    assert ckpt.latest_step() == 3
+    ckpt.close()
+
+    ckpt2 = Checkpointer(
+        CheckpointConfig(directory=ckpt_dir, save_interval_steps=1,
+                         async_save=False, save_on_preemption=False),
+        mesh8,
+    )
+    state2, specs2, restored2 = init_or_restore(
+        ckpt2, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    assert restored2
+    assert int(state2.step) == 3
+    trainer2 = Trainer(make_train_step(linear_loss, tx), state2, mesh8, specs2)
+    # feed the same batches 4..6 the straight run saw
+    resumed = trainer2.fit(
+        (make_batch(16, seed=i) for i in range(3, 6)), num_steps=6
+    )
+    assert int(resumed.step) == 6
+    for a, b in zip(jax.tree.leaves(straight.params), jax.tree.leaves(resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+    ckpt2.close()
+
+
+def test_failed_run_never_checkpoints_poisoned_state(mesh8, tmp_path):
+    """NaN abort must not overwrite the latest checkpoint with bad state."""
+    def nan_loss(params, model_state, batch, rng):
+        return jnp.sum(params["w"]) * jnp.nan, (model_state, {})
+
+    tx = optax.sgd(0.1)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "nan"), save_interval_steps=100,
+                         async_save=False, save_on_preemption=False),
+        mesh8,
+    )
+    state, specs, _ = init_or_restore(ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    trainer = Trainer(
+        make_train_step(nan_loss, tx), state, mesh8, specs,
+        callbacks=[cb.NaNGuard(every_n=1), cb.CheckpointCallback(ckpt)],
+    )
+    with pytest.raises(FloatingPointError):
+        trainer.fit(batches(10), num_steps=5)
+    assert trainer.failed
+    assert ckpt.latest_step() is None  # nothing poisoned was written
+    ckpt.close()
+
+
+def test_optimizer_clip_grad_norm_wired(mesh8):
+    """clip_grad_norm on OptimizerConfig must actually clip."""
+    big = make_batch(16)
+    big["y"] = big["y"] * 1e6  # huge grads
+    tx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=1.0,
+                                        clip_grad_norm=1e-3))
+    state, specs = init_train_state(linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    trainer = Trainer(make_train_step(linear_loss, tx), state, mesh8, specs)
+    before = np.asarray(jax.tree.leaves(state.params)[0]).copy()
+    state2 = trainer.fit([big], num_steps=1)
+    after = np.asarray(jax.tree.leaves(state2.params)[0])
+    # update magnitude bounded by lr * clip_norm
+    assert np.abs(after - before).max() <= 1e-3 + 1e-6
+
+
+def test_ftrl_l1_applies():
+    tx = make_optimizer(OptimizerConfig(name="ftrl", learning_rate=0.1, l1=0.5))
+    params = {"w": jnp.ones((4,))}
+    grads = {"w": jnp.zeros((4,))}
+    opt_state = tx.init(params)
+    updates, _ = tx.update(grads, opt_state, params)
+    # zero grads + positive weights + l1 → negative (shrinking) update
+    assert float(jnp.max(updates["w"])) < 0
+
+
+def test_restore_none_when_empty(mesh8, tmp_path):
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "empty"), async_save=False),
+        mesh8,
+    )
+    assert ckpt.latest_step() is None
+    ckpt.close()
