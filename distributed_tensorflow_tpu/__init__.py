@@ -11,6 +11,9 @@ multi-host checkpointing in place of MonitoredTrainingSession and its hooks.
 
 __version__ = "0.1.0"
 
+from . import data  # noqa: F401
+from . import models  # noqa: F401
 from . import parallel  # noqa: F401
 from . import train  # noqa: F401
 from . import utils  # noqa: F401
+from . import workloads  # noqa: F401

@@ -1,0 +1,8 @@
+from .pipeline import (  # noqa: F401
+    DataConfig,
+    NpzDataset,
+    Prefetcher,
+    SyntheticClassification,
+    local_batch_size,
+    make_dataset,
+)

@@ -1,0 +1,185 @@
+"""Input pipeline: per-host sharded batch streams + host-side prefetch.
+
+Reference mechanism (SURVEY.md §2a 'Input pipeline'): feed_dict or tf.data
+with `Dataset.shard(num_workers, task_index)` so each worker reads a
+disjoint slice. TPU-native shape: each *host* produces its
+``global_batch / process_count`` slice (deterministically disjoint via
+per-host seeding), `Trainer.put_batch` assembles the global sharded array
+(jax.make_array_from_process_local_data), and a background thread keeps
+batches ready so the device never waits on the host (SURVEY.md §7 ranks
+input-pipeline starvation the #1 hard part).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    dataset: str = "synthetic"  # synthetic | npz:<path>
+    global_batch_size: int = 128
+    image_size: int = 28
+    channels: int = 1
+    num_classes: int = 10
+    seed: int = 0
+    flat: bool = False  # emit (N, H*W*C) instead of (N, H, W, C)
+
+
+def local_batch_size(global_batch_size: int) -> int:
+    n = jax.process_count()
+    if global_batch_size % n != 0:
+        raise ValueError(
+            f"global_batch_size={global_batch_size} not divisible by "
+            f"process_count={n}"
+        )
+    return global_batch_size // n
+
+
+class SyntheticClassification:
+    """Deterministic, learnable synthetic data: a fixed random linear
+    teacher labels gaussian inputs, so loss/accuracy curves are meaningful
+    (convergence tests, SURVEY.md §4.5) without dataset files. Per-host
+    disjoint by folding process_index into the per-batch seed."""
+
+    def __init__(self, cfg: DataConfig, num_batches: int | None = None,
+                 index_offset: int = 0):
+        """``index_offset`` shifts the batch stream (same teacher, fresh
+        inputs) — how an eval split is produced without changing the task."""
+        self.cfg = cfg
+        self.num_batches = num_batches
+        self.index_offset = index_offset
+        self.local_bs = local_batch_size(cfg.global_batch_size)
+        rng = np.random.RandomState(cfg.seed)
+        dim = cfg.image_size * cfg.image_size * cfg.channels
+        self.teacher = rng.randn(dim, cfg.num_classes).astype(np.float32)
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        index += self.index_offset
+        seed = (self.cfg.seed * 1_000_003 + index) * 97 + jax.process_index()
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        cfg = self.cfg
+        shape = (
+            (self.local_bs, cfg.image_size * cfg.image_size * cfg.channels)
+            if cfg.flat
+            else (self.local_bs, cfg.image_size, cfg.image_size, cfg.channels)
+        )
+        x = rng.randn(*shape).astype(np.float32)
+        flat = x.reshape(self.local_bs, -1)
+        label = np.argmax(flat @ self.teacher, axis=-1).astype(np.int32)
+        return {"image": x, "label": label}
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        i = 0
+        while self.num_batches is None or i < self.num_batches:
+            yield self.batch(i)
+            i += 1
+
+
+class NpzDataset:
+    """Epoch-shuffled stream over an .npz with arrays ``image``/``label`` —
+    the hook for real MNIST/CIFAR files when present on the host.
+
+    ``num_batches`` bounds the stream; ``index_offset`` fast-forwards past
+    already-consumed batches (checkpoint resume). For a true held-out eval
+    split, point at a separate eval .npz — an offset stream still draws
+    from the same examples."""
+
+    def __init__(self, path: str, cfg: DataConfig, shuffle: bool = True,
+                 num_batches: int | None = None, index_offset: int = 0):
+        data = np.load(path)
+        self.images = data["image"]
+        self.labels = data["label"]
+        self.cfg = cfg
+        self.shuffle = shuffle
+        self.num_batches = num_batches
+        self.index_offset = index_offset
+        self.local_bs = local_batch_size(cfg.global_batch_size)
+
+    def _batches_per_epoch(self) -> int:
+        n = len(self.images) // jax.process_count()
+        return max(n // self.local_bs, 1)
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        bpe = self._batches_per_epoch()
+        epoch, pos = divmod(index, bpe)
+        order = np.arange(len(self.images))
+        if self.shuffle:
+            # identical shuffle on every host, disjoint strided slices
+            np.random.RandomState(self.cfg.seed + epoch).shuffle(order)
+        order = order[jax.process_index():: jax.process_count()]
+        idx = order[pos * self.local_bs : (pos + 1) * self.local_bs]
+        return {"image": self.images[idx], "label": self.labels[idx]}
+
+    def __iter__(self):
+        i = 0
+        while self.num_batches is None or i < self.num_batches:
+            yield self.batch(i + self.index_offset)
+            i += 1
+
+
+def make_dataset(cfg: DataConfig, num_batches: int | None = None,
+                 index_offset: int = 0) -> Iterable:
+    if cfg.dataset == "synthetic":
+        return SyntheticClassification(cfg, num_batches, index_offset)
+    if cfg.dataset.startswith("npz:"):
+        return NpzDataset(cfg.dataset[4:], cfg, num_batches=num_batches,
+                          index_offset=index_offset)
+    raise ValueError(f"Unknown dataset '{cfg.dataset}'")
+
+
+class Prefetcher:
+    """Background-thread prefetch: keeps up to ``depth`` host batches ready.
+    The Python tier of the input pipeline; the native (C++) loader in
+    runtime/ plugs in beneath it for decode-heavy workloads."""
+
+    _DONE = object()
+
+    def __init__(self, source: Iterable, depth: int = 2,
+                 transform: Callable[[Any], Any] | None = None):
+        self.source = source
+        self.depth = depth
+        self.transform = transform
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        error: list[BaseException] = []
+
+        def worker():
+            try:
+                for item in self.source:
+                    if stop.is_set():
+                        return
+                    if self.transform is not None:
+                        item = self.transform(item)
+                    q.put(item)
+            except BaseException as e:  # surface in consumer thread
+                error.append(e)
+            finally:
+                q.put(self._DONE)
+
+        t = threading.Thread(target=worker, daemon=True, name="prefetcher")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    if error:
+                        raise error[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+            # drain so the worker's blocked put() can observe stop
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass

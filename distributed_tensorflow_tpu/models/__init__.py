@@ -1,0 +1,9 @@
+"""Model zoo — the five BASELINE.json workload families, flax-native.
+
+Reference analog: per-script raw-TF model fns (SURVEY.md §2a). Each module
+ships the flax Module, a config dataclass, and analytic FLOPs for MFU
+accounting (utils/flops.py)."""
+
+from . import common  # noqa: F401
+from .mlp import MLP, MLPConfig  # noqa: F401
+from .cnn import CNN, CNNConfig  # noqa: F401

@@ -1,0 +1,60 @@
+"""CIFAR-10 CNN — BASELINE.json:8 workload 2 (sync data-parallel ×8).
+
+The reference ran this as the canonical SyncReplicasOptimizer demo; here
+the same capability is the GSPMD data axis. Architecture: simple
+conv-bn-relu stack (BN becomes cross-replica BN for free under GSPMD —
+models/common.py note)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class CNNConfig:
+    channels: tuple = (32, 64, 128)
+    dense_size: int = 256
+    num_classes: int = 10
+    dropout_rate: float = 0.0
+    use_batchnorm: bool = True
+    dtype: str = "float32"
+
+
+class CNN(nn.Module):
+    cfg: CNNConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        dtype = jnp.dtype(self.cfg.dtype)
+        x = x.astype(dtype)
+        for i, ch in enumerate(self.cfg.channels):
+            x = nn.Conv(ch, (3, 3), dtype=dtype, name=f"conv_{i}")(x)
+            if self.cfg.use_batchnorm:
+                x = nn.BatchNorm(
+                    use_running_average=not train, dtype=dtype,
+                    momentum=0.9, name=f"bn_{i}",
+                )(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(self.cfg.dense_size, dtype=dtype, name="dense")(x)
+        x = nn.relu(x)
+        if self.cfg.dropout_rate > 0:
+            x = nn.Dropout(self.cfg.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.cfg.num_classes, dtype=dtype, name="head")(x)
+
+
+def flops_per_example(cfg: CNNConfig, image_size: int = 32) -> float:
+    fwd = 0.0
+    h = image_size
+    in_c = 3
+    for ch in cfg.channels:
+        fwd += 2.0 * h * h * ch * in_c * 9  # 3x3 conv at same resolution
+        h //= 2
+        in_c = ch
+    fwd += 2.0 * (h * h * in_c) * cfg.dense_size
+    fwd += 2.0 * cfg.dense_size * cfg.num_classes
+    return 3.0 * fwd

@@ -1,0 +1,94 @@
+"""Bridges flax modules to the train engine's loss-fn contract.
+
+The reference's model fns were raw-TF builder functions wired into the
+harness by `replica_device_setter` scope (SURVEY.md §2a 'Model fns' row);
+here a model is a flax Module plus a loss adapter, and placement is the
+sharding rules' job, fully decoupled from model code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def classification_loss_fn(
+    model, *, weight_decay: float = 0.0, label_smoothing: float = 0.0
+) -> Callable:
+    """loss_fn(params, model_state, batch, rng) for models whose apply
+    returns logits. Handles mutable collections (BatchNorm batch_stats —
+    which under GSPMD jit become cross-replica-synced BN for free, since
+    the batch-axis mean is computed over the sharded global batch) and
+    dropout rngs. Batch: {"image"|"x": ..., "label": int}."""
+
+    def loss_fn(params, model_state, batch, rng):
+        x = batch.get("image", batch.get("x"))
+        labels = batch["label"]
+        variables = {"params": params, **model_state}
+        mutable = list(model_state.keys())
+        out = model.apply(
+            variables, x, train=True,
+            mutable=mutable if mutable else False,
+            rngs={"dropout": rng},
+        )
+        logits, new_model_state = out if mutable else (out, model_state)
+        if label_smoothing > 0:
+            num_classes = logits.shape[-1]
+            onehot = optax.smooth_labels(
+                jax.nn.one_hot(labels, num_classes), label_smoothing
+            )
+            loss = optax.softmax_cross_entropy(logits.astype(jnp.float32), onehot).mean()
+        else:
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels
+            ).mean()
+        if weight_decay > 0:
+            l2 = sum(
+                jnp.sum(p.astype(jnp.float32) ** 2)
+                for p in jax.tree.leaves(params)
+                if p.ndim > 1  # kernels only, not biases/scales
+            )
+            loss = loss + weight_decay * 0.5 * l2
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return loss, (new_model_state, {"accuracy": acc})
+
+    return loss_fn
+
+
+def classification_eval_fn(model) -> Callable:
+    """eval_fn(params, model_state, batch) -> summed correct/count/loss —
+    summed (not averaged) so sharded eval shards aggregate exactly."""
+
+    def eval_fn(params, model_state, batch):
+        x = batch.get("image", batch.get("x"))
+        labels = batch["label"]
+        variables = {"params": params, **model_state}
+        logits = model.apply(variables, x, train=False, mutable=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        ).sum()
+        correct = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        count = jnp.asarray(labels.shape[0], jnp.float32)
+        return {"loss_sum": loss, "correct": correct, "count": count}
+
+    return eval_fn
+
+
+def make_init_fn(model, input_shape, dtype=jnp.float32) -> Callable:
+    """init_fn(rng) -> (params, model_state) for init_train_state."""
+
+    def init_fn(rng):
+        dummy = jnp.zeros((1, *input_shape), dtype)
+        variables = model.init({"params": rng, "dropout": rng}, dummy, train=False)
+        variables = dict(variables)
+        params = variables.pop("params")
+        return params, variables
+
+    return init_fn
+
+
+def param_count(params: Any) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

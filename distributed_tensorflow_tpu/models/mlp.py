@@ -1,0 +1,40 @@
+"""MNIST MLP — BASELINE.json:7 workload 1 (reference: raw-TF dense layers
+under replica_device_setter scope, SURVEY.md §2a). bf16-friendly: matmuls in
+``dtype``, params in f32."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    hidden_sizes: tuple = (512, 512)
+    num_classes: int = 10
+    dropout_rate: float = 0.0
+    dtype: str = "float32"  # compute dtype; params stay float32
+
+
+class MLP(nn.Module):
+    cfg: MLPConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        dtype = jnp.dtype(self.cfg.dtype)
+        x = x.reshape(x.shape[0], -1).astype(dtype)
+        for i, h in enumerate(self.cfg.hidden_sizes):
+            x = nn.Dense(h, dtype=dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+            if self.cfg.dropout_rate > 0:
+                x = nn.Dropout(self.cfg.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.cfg.num_classes, dtype=dtype, name="head")(x)
+
+
+def flops_per_example(cfg: MLPConfig, input_dim: int = 784) -> float:
+    dims = [input_dim, *cfg.hidden_sizes, cfg.num_classes]
+    fwd = sum(2.0 * a * b for a, b in zip(dims, dims[1:]))
+    return 3.0 * fwd  # fwd + bwd

@@ -52,6 +52,12 @@ def initialize(config: ClusterConfig | None = None) -> None:
     global _initialized
     if _initialized:
         return
+    # Honor JAX_PLATFORMS explicitly: plugin registration hooks (e.g. a
+    # tunneled-TPU site module) may have overridden the config default at
+    # import time, which would silently ignore the user's env var.
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
     config = config or ClusterConfig()
     explicit = config.coordinator_address is not None
     env = "COORDINATOR_ADDRESS" in os.environ

@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding
 from ..parallel import sharding as sh
 from . import step as step_lib
 from .callbacks import Callback
+from .checkpoint import PreemptionSaved
 
 logger = logging.getLogger(__name__)
 
@@ -105,6 +106,10 @@ class Trainer:
                 step_now += 1
                 for cb in self.callbacks:
                     cb.on_step_end(self, step_now, metrics)
+        except PreemptionSaved as e:
+            # Clean preemption exit (SURVEY.md §5.3): state is safely on
+            # disk; stop so the scheduler can restart-and-resume.
+            self.request_stop(str(e))
         except BaseException:
             self.failed = True
             raise

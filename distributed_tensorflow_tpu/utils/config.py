@@ -71,7 +71,7 @@ def _resolve_type(ftype, owner_cls):
 
 
 def _parse_value(raw: str, ftype) -> Any:
-    ftype = _resolve_type(ftype, type(None)) if not isinstance(ftype, str) else ftype
+    # callers pass an already-resolved ftype (see _replace_path)
     if ftype is bool or (isinstance(ftype, type) and issubclass(ftype, bool)):
         if raw.lower() in ("1", "true", "yes"):
             return True
@@ -92,8 +92,6 @@ def _parse_value(raw: str, ftype) -> Any:
     if is_tuple or get_origin(ftype) is list or ftype is list or raw[:1] in "[({":
         val = json.loads(raw)
         return tuple(val) if is_tuple else val
-    if raw.lower() == "none":
-        return None
     # fall back on literal parse, then raw string
     try:
         return json.loads(raw)

@@ -1,0 +1,48 @@
+"""Workload registry — the five BASELINE.json configs as presets.
+
+Each workload module exposes ``default_config() -> RunConfig`` and
+``build(cfg) -> WorkloadParts``; the shared runner (runner.py) does the
+rest. Registered lazily so importing the registry doesn't pull every model.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from .runner import RunConfig, RunResult, TrainSection, WorkloadParts, evaluate, run
+
+_REGISTRY: dict[str, str] = {
+    # name -> module (BASELINE.json:7-11 order)
+    "mnist_mlp": ".mnist_mlp",
+    "cifar10_cnn": ".cifar10_cnn",
+    "resnet50_imagenet": ".resnet50_imagenet",
+    "bert_pretrain": ".bert_pretrain",
+    "wide_deep": ".wide_deep",
+}
+
+
+def available() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def get(name: str):
+    """Returns the workload module (default_config, build)."""
+    if name not in _REGISTRY:
+        raise ValueError(f"Unknown workload '{name}'; available: {available()}")
+    try:
+        return importlib.import_module(_REGISTRY[name], __package__)
+    except ModuleNotFoundError as e:
+        raise ValueError(
+            f"Workload '{name}' is registered but not implemented yet ({e})"
+        ) from e
+
+
+def run_workload(name: str, overrides: list[str] | None = None,
+                 **run_kwargs) -> RunResult:
+    from ..utils import config as config_lib
+
+    mod = get(name)
+    cfg = mod.default_config()
+    if overrides:
+        cfg = config_lib.apply_overrides(cfg, overrides)
+    return run(cfg, mod.build, **run_kwargs)

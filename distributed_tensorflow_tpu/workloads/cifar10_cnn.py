@@ -1,0 +1,47 @@
+"""Workload 2 — CIFAR-10 CNN, sync data-parallel ×8 (BASELINE.json:8).
+
+The reference's SyncReplicasOptimizer showcase (accumulator + token-queue
+protocol, SURVEY.md §3.1); here the same semantics are one psum on the data
+axis."""
+
+from __future__ import annotations
+
+from ..data import DataConfig, make_dataset
+from ..models import CNN, CNNConfig, common
+from ..parallel import MeshSpec
+from ..train import OptimizerConfig
+from .runner import RunConfig, TrainSection, WorkloadParts
+
+
+def default_config() -> RunConfig:
+    return RunConfig(
+        workload="cifar10_cnn",
+        model=CNNConfig(channels=(32, 64, 128), num_classes=10),
+        mesh=MeshSpec(data=8),
+        data=DataConfig(
+            dataset="synthetic", global_batch_size=256,
+            image_size=32, channels=3, num_classes=10,
+        ),
+        optimizer=OptimizerConfig(
+            name="momentum", learning_rate=0.05, momentum=0.9,
+            schedule="cosine", total_steps=2000,
+        ),
+        train=TrainSection(num_steps=2000, log_every=100),
+    )
+
+
+def build(cfg: RunConfig) -> WorkloadParts:
+    model = CNN(cfg.model)
+    input_shape = (cfg.data.image_size, cfg.data.image_size, cfg.data.channels)
+    from ..models.cnn import flops_per_example
+
+    return WorkloadParts(
+        init_fn=common.make_init_fn(model, input_shape),
+        loss_fn=common.classification_loss_fn(model),
+        eval_fn=common.classification_eval_fn(model),
+        dataset_fn=lambda start: make_dataset(cfg.data, index_offset=start),
+        eval_dataset_fn=lambda n: make_dataset(cfg.data, n, index_offset=10**6),
+        flops_per_step=flops_per_example(cfg.model, cfg.data.image_size)
+        * cfg.data.global_batch_size,
+        batch_size=cfg.data.global_batch_size,
+    )

@@ -1,0 +1,45 @@
+"""Workload 1 — MNIST MLP, single-worker sync SGD (BASELINE.json:7).
+
+The reference's smallest harness script: MLP under replica_device_setter,
+plain sync SGD (SURVEY.md §2a). The TPU-native minimum end-to-end slice
+(SURVEY.md §7 M6)."""
+
+from __future__ import annotations
+
+from ..data import DataConfig, make_dataset
+from ..models import MLP, MLPConfig, common
+from ..parallel import MeshSpec
+from ..train import OptimizerConfig
+from .runner import RunConfig, TrainSection, WorkloadParts
+
+
+def default_config() -> RunConfig:
+    return RunConfig(
+        workload="mnist_mlp",
+        model=MLPConfig(hidden_sizes=(512, 512), num_classes=10),
+        mesh=MeshSpec(data=-1),
+        data=DataConfig(
+            dataset="synthetic", global_batch_size=128,
+            image_size=28, channels=1, num_classes=10,
+        ),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1),
+        train=TrainSection(num_steps=500, log_every=50),
+    )
+
+
+def build(cfg: RunConfig) -> WorkloadParts:
+    model = MLP(cfg.model)
+    input_shape = (cfg.data.image_size, cfg.data.image_size, cfg.data.channels)
+    input_dim = cfg.data.image_size**2 * cfg.data.channels
+    from ..models.mlp import flops_per_example
+
+    return WorkloadParts(
+        init_fn=common.make_init_fn(model, input_shape),
+        loss_fn=common.classification_loss_fn(model),
+        eval_fn=common.classification_eval_fn(model),
+        dataset_fn=lambda start: make_dataset(cfg.data, index_offset=start),
+        eval_dataset_fn=lambda n: make_dataset(cfg.data, n, index_offset=10**6),
+        flops_per_step=flops_per_example(cfg.model, input_dim)
+        * cfg.data.global_batch_size,
+        batch_size=cfg.data.global_batch_size,
+    )

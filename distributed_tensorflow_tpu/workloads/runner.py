@@ -1,0 +1,185 @@
+"""Workload runner — the L4 'train script' layer (SURVEY.md §1), one
+implementation for all workloads.
+
+A reference train script did: parse flags → ClusterSpec/Server → device
+placement scope → model fn → SyncReplicasOptimizer → MonitoredTrainingSession
+loop (SURVEY.md §3.1). `run()` is that whole stack TPU-native: config →
+mesh → sharded init-or-restore → jit step → callback loop. Each workload
+module contributes a preset config and a builder; everything else is shared.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Iterable
+
+import jax
+import numpy as np
+
+from ..data import DataConfig, Prefetcher
+from ..parallel import MeshSpec, build_mesh, cluster, describe
+from ..train import (
+    CheckpointConfig,
+    Checkpointer,
+    OptimizerConfig,
+    StepOptions,
+    Trainer,
+    callbacks as cb,
+    init_or_restore,
+    init_train_state,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+)
+from ..utils import config as config_lib
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainSection:
+    num_steps: int = 1000
+    log_every: int = 100
+    grad_accum_steps: int = 1
+    seed: int = 0
+    eval_every: int = 0  # 0 = no mid-train eval
+    eval_batches: int = 16
+    profile: bool = False
+    profile_dir: str = "/tmp/dtf_tpu_profile"
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    workload: str = "mnist_mlp"
+    model: Any = None  # workload-specific config dataclass, set by preset
+    mesh: MeshSpec = MeshSpec()
+    data: DataConfig = DataConfig()
+    optimizer: OptimizerConfig = OptimizerConfig()
+    train: TrainSection = TrainSection()
+    checkpoint: CheckpointConfig = CheckpointConfig()
+
+
+@dataclasses.dataclass
+class WorkloadParts:
+    """What a workload module's build() returns."""
+
+    init_fn: Callable  # rng -> (params, model_state)
+    loss_fn: Callable  # engine LossFn
+    # start_step -> host-batch iterable; the runner calls it with the
+    # restored step so resume continues the data stream, not batch 0.
+    dataset_fn: Callable[[int], Iterable] = None
+    eval_fn: Callable | None = None
+    eval_dataset_fn: Callable[[int], Iterable] | None = None
+    flops_per_step: float | None = None  # analytic, for MFU
+    param_rules: Any = None  # sharding path rules
+    fsdp: bool = False
+    batch_size: int | None = None  # examples/step for throughput logs
+    _jit_eval: Callable | None = dataclasses.field(default=None, repr=False)
+
+
+@dataclasses.dataclass
+class RunResult:
+    state: Any
+    history: list[dict]
+    eval_metrics: dict | None
+    mesh: Any
+
+
+def run(cfg: RunConfig, build: Callable[[RunConfig], WorkloadParts],
+        extra_callbacks: Iterable[cb.Callback] = ()) -> RunResult:
+    cluster.initialize()
+    mesh = build_mesh(cfg.mesh)
+    if cluster.is_chief():
+        logger.info("mesh: %s", describe(mesh))
+        logger.info("config:\n%s", config_lib.to_json(cfg))
+
+    parts = build(cfg)
+    tx = make_optimizer(cfg.optimizer)
+    rng = jax.random.PRNGKey(cfg.train.seed)
+
+    ckpt = None
+    if cfg.checkpoint.directory:
+        ckpt = Checkpointer(cfg.checkpoint, mesh)
+        state, specs, restored = init_or_restore(
+            ckpt, parts.init_fn, tx, mesh, rng,
+            param_rules=parts.param_rules, fsdp=parts.fsdp,
+        )
+        ckpt.save_config(cfg)
+    else:
+        state, specs = init_train_state(
+            parts.init_fn, tx, mesh, rng,
+            param_rules=parts.param_rules, fsdp=parts.fsdp,
+        )
+
+    metrics_logger = cb.MetricsLogger(
+        every_n=cfg.train.log_every,
+        batch_size=parts.batch_size or cfg.data.global_batch_size,
+        model_flops_per_step=parts.flops_per_step,
+        history=True,
+    )
+    callbacks: list[cb.Callback] = [metrics_logger, cb.NaNGuard()]
+    if ckpt is not None:
+        callbacks.append(cb.CheckpointCallback(ckpt))
+    if cfg.train.profile:
+        callbacks.append(cb.Profiler(cfg.train.profile_dir))
+    callbacks.extend(extra_callbacks)
+
+    step_fn = make_train_step(
+        parts.loss_fn, tx,
+        StepOptions(grad_accum_steps=cfg.train.grad_accum_steps),
+    )
+    trainer = Trainer(step_fn, state, mesh, specs, callbacks=callbacks)
+
+    if cfg.train.eval_every > 0 and parts.eval_fn is not None:
+        trainer.callbacks.append(_EvalCallback(cfg, parts))
+
+    start_step = int(state.step)
+    data = Prefetcher(parts.dataset_fn(start_step), depth=2)
+    state = trainer.fit(data, num_steps=cfg.train.num_steps)
+
+    eval_metrics = None
+    if parts.eval_fn is not None and parts.eval_dataset_fn is not None:
+        eval_metrics = evaluate(
+            trainer, parts, cfg.train.eval_batches
+        )
+        if cluster.is_chief():
+            logger.info("final eval: %s", eval_metrics)
+    if ckpt is not None:
+        ckpt.wait()
+        ckpt.close()
+    return RunResult(state, metrics_logger.history, eval_metrics, mesh)
+
+
+def evaluate(trainer: Trainer, parts: WorkloadParts, num_batches: int) -> dict:
+    """Eval from current state — the reference ran eval single-process from
+    the latest checkpoint (SURVEY.md §3.5); here it shares the mesh and
+    runs sharded. The jitted eval step is cached on parts so repeated
+    mid-train evals don't retrace."""
+    if parts._jit_eval is None:
+        parts._jit_eval = jax.jit(make_eval_step(parts.eval_fn))
+    eval_step = parts._jit_eval
+    totals: dict[str, float] = {}
+    import itertools
+
+    for batch in itertools.islice(parts.eval_dataset_fn(num_batches), num_batches):
+        out = eval_step(trainer.state, trainer.put_batch(batch))
+        for k, v in out.items():
+            totals[k] = totals.get(k, 0.0) + float(np.asarray(v))
+    result = dict(totals)
+    if "correct" in totals and totals.get("count"):
+        result["accuracy"] = totals["correct"] / totals["count"]
+    if "loss_sum" in totals and totals.get("count"):
+        result["loss"] = totals["loss_sum"] / totals["count"]
+    return result
+
+
+class _EvalCallback(cb.Callback):
+    def __init__(self, cfg, parts):
+        self.cfg, self.parts = cfg, parts
+
+    def on_step_end(self, trainer, step, metrics):
+        if step % self.cfg.train.eval_every == 0:
+            m = evaluate(trainer, self.parts, self.cfg.train.eval_batches)
+            if cluster.is_chief():
+                logger.info("eval @ step %d: %s", step, m)

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Train-script entry point — the user-facing analog of the reference's
+per-workload scripts (SURVEY.md §2a flag layer).
+
+Usage:
+    python examples/train.py mnist_mlp --train.num_steps=500
+    python examples/train.py cifar10_cnn --mesh.data=8 --optimizer.learning_rate=0.1
+    python examples/train.py resnet50_imagenet --checkpoint.directory=/tmp/ck
+
+Where the reference took ``--job_name/--task_index/--ps_hosts/--worker_hosts``
+per process, here every host runs the same command; topology is
+``--mesh.<axis>=<size>`` and multi-host bootstrap is automatic (or via
+COORDINATOR_ADDRESS for manual clusters).
+"""
+
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from distributed_tensorflow_tpu import workloads
+
+
+def main(argv: list[str]) -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s: %(message)s",
+        datefmt="%H:%M:%S",
+        force=True,  # imported libs (absl/orbax) may have claimed root already
+    )
+    if not argv or argv[0].startswith("-"):
+        print(f"usage: train.py <workload> [--section.key=value ...]\n"
+              f"workloads: {', '.join(workloads.available())}")
+        raise SystemExit(2)
+    name, overrides = argv[0], [a for a in argv[1:] if a.startswith("--")]
+    result = workloads.run_workload(name, overrides)
+    final = result.history[-1] if result.history else {}
+    print(f"done: step={int(result.state.step)} last_metrics={final} "
+          f"eval={result.eval_metrics}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

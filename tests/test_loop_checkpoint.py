@@ -194,6 +194,37 @@ def test_ftrl_l1_applies():
     assert float(jnp.max(updates["w"])) < 0
 
 
+def test_preemption_saved_is_clean_stop(mesh8, tmp_path):
+    """PreemptionSaved must stop the loop cleanly (failed=False) with the
+    state on disk — the restart-and-resume contract (SURVEY.md §5.3)."""
+    from distributed_tensorflow_tpu.train.checkpoint import PreemptionSaved
+
+    tx = optax.sgd(0.1)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "pre"), save_interval_steps=100,
+                         async_save=False, save_on_preemption=False),
+        mesh8,
+    )
+    state, specs, _ = init_or_restore(ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+
+    class FakePreempt(cb.Callback):
+        def on_step_end(self, trainer, step, metrics):
+            if step == 2:
+                ckpt.save(step, trainer.state, force=True)
+                ckpt.wait()
+                raise PreemptionSaved(step)
+
+    trainer = Trainer(
+        make_train_step(linear_loss, tx), state, mesh8, specs,
+        callbacks=[FakePreempt(), cb.CheckpointCallback(ckpt)],
+    )
+    final = trainer.fit(batches(10), num_steps=10)  # must not raise
+    assert not trainer.failed
+    assert int(final.step) == 2
+    assert ckpt.latest_step() == 2
+    ckpt.close()
+
+
 def test_restore_none_when_empty(mesh8, tmp_path):
     ckpt = Checkpointer(
         CheckpointConfig(directory=str(tmp_path / "empty"), async_save=False),
