@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 training throughput on the available chip(s).
+
+Prints exactly ONE JSON line to stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Metric: ResNet-50 images/sec/chip (the BASELINE.json:2 primary metric),
+steady-state window excluding compilation (BASELINE.md reporting rules).
+``vs_baseline``: measured MFU / 0.50 — the north-star "≥50% MFU" target
+(BASELINE.json:5); the reference publishes no absolute number to compare
+against (BASELINE.json:13 "published": {}).
+
+All diagnostics go to stderr; stdout carries only the JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    # Honor an explicit JAX_PLATFORMS env var even if a site plugin
+    # overrode the config default at import (parallel/cluster.py note).
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_tpu.models import common
+    from distributed_tensorflow_tpu.models.resnet import (
+        ResNet50, ResNetConfig, flops_per_example,
+    )
+    from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh, describe
+    from distributed_tensorflow_tpu.parallel import sharding as sh
+    from distributed_tensorflow_tpu.train import (
+        StepOptions, init_train_state, jit_train_step, make_train_step,
+    )
+    from distributed_tensorflow_tpu.utils import flops as flops_lib
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    platform = devices[0].platform
+    kind = getattr(devices[0], "device_kind", "")
+    # Robust TPU detection: tunneled platforms (axon) expose platform="tpu"
+    # / device_kind="TPU v5 lite"; gate on either so an accelerator never
+    # silently gets the tiny-CPU fallback config.
+    on_tpu = platform == "tpu" or kind.upper().startswith("TPU")
+    log(f"bench devices: {devices} (platform={platform}, kind={kind})")
+
+    # Per-chip batch sized for a v5e (16 GiB HBM) bf16 train step; tiny on
+    # CPU so the fallback run finishes fast.
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", "128" if on_tpu else "8"))
+    image = 224 if on_tpu else 64
+    cfg = ResNetConfig() if on_tpu else ResNetConfig(
+        stage_sizes=(1, 1, 1, 1), width=16, num_classes=100, dtype="float32"
+    )
+    global_batch = per_chip_batch * n_chips
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    log(f"mesh: {describe(mesh)}  global_batch={global_batch}  image={image}")
+
+    model = ResNet50(cfg)
+    loss_fn = common.classification_loss_fn(model, weight_decay=1e-4)
+    tx = optax.sgd(0.1, momentum=0.9)
+    state, specs = init_train_state(
+        common.make_init_fn(model, (image, image, 3)), tx, mesh,
+        jax.random.PRNGKey(0),
+    )
+    step = jit_train_step(make_train_step(loss_fn, tx, StepOptions()), mesh, specs)
+
+    rng = np.random.RandomState(0)
+    from jax.sharding import NamedSharding
+
+    batch = {
+        "image": rng.randn(global_batch, image, image, 3).astype(np.float32),
+        "label": rng.randint(0, cfg.num_classes, global_batch).astype(np.int32),
+    }
+    batch = jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, sh.batch_spec(x.ndim))),
+        batch,
+    )
+
+    warmup = 3
+    measured = int(os.environ.get("BENCH_STEPS", "10"))
+    log("compiling + warmup...")
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    log("measuring...")
+    t0 = time.perf_counter()
+    for _ in range(measured):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = measured / dt
+    images_per_sec = steps_per_sec * global_batch
+    images_per_sec_per_chip = images_per_sec / n_chips
+    model_flops = flops_per_example(cfg, image) * global_batch
+    peak = flops_lib.peak_flops_per_chip(devices[0])
+    mfu = flops_lib.mfu(model_flops, steps_per_sec, n_chips, peak)
+    log(f"steps/sec={steps_per_sec:.3f} images/sec/chip={images_per_sec_per_chip:.1f} "
+        f"MFU={mfu:.3f} (peak={peak:.3g})")
+
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(images_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "mfu": round(mfu, 4),
+        "platform": platform,
+        "n_chips": n_chips,
+        "global_batch": global_batch,
+        "image_size": image,
+        "full_resnet50": bool(on_tpu),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,3 +7,4 @@ accounting (utils/flops.py)."""
 from . import common  # noqa: F401
 from .mlp import MLP, MLPConfig  # noqa: F401
 from .cnn import CNN, CNNConfig  # noqa: F401
+from .resnet import ResNet, ResNet50, ResNetConfig  # noqa: F401

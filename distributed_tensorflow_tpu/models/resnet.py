@@ -1,0 +1,117 @@
+"""ResNet-50 — the BASELINE primary-metric workload (BASELINE.json:2,9).
+
+Reference analog: the harness's ResNet-50 train script over PS/worker
+(SURVEY.md §2a). TPU-first choices: bf16 conv/matmul compute with f32
+params and f32 BatchNorm statistics (MXU-friendly, numerically safe), NHWC
+layout (TPU conv native), and BatchNorm that becomes cross-replica synced
+for free under GSPMD (the batch mean reduces over the sharded batch axis).
+v1.5 variant (stride-2 on the 3x3, not the 1x1 — the MLPerf standard).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: str = "bfloat16"
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        dtype = jnp.dtype(self.cfg.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=dtype,
+                       kernel_init=nn.initializers.he_normal())
+        # BN computes statistics in f32 regardless of compute dtype.
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=self.cfg.bn_momentum, epsilon=self.cfg.bn_epsilon,
+                     dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = bn(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 name="conv2")(y)  # v1.5: stride on the 3x3
+        y = bn(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        # zero-init last BN scale: residual branch starts as identity
+        y = bn(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="proj_conv")(residual)
+            residual = bn(name="proj_bn")(residual)
+        return nn.relu(residual.astype(y.dtype) + y)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        x = x.astype(dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=dtype, kernel_init=nn.initializers.he_normal(),
+                    name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=cfg.bn_momentum,
+                         epsilon=cfg.bn_epsilon, dtype=jnp.float32,
+                         name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, blocks in enumerate(cfg.stage_sizes):
+            for block in range(blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(
+                    cfg.width * 2**stage, strides, cfg,
+                    name=f"stage{stage}_block{block}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        # head in f32: the last matmul is tiny; keep logits stable
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def ResNet50(cfg: ResNetConfig | None = None) -> ResNet:
+    return ResNet(cfg or ResNetConfig())
+
+
+def flops_per_example(cfg: ResNetConfig, image_size: int = 224) -> float:
+    """Analytic fwd+bwd FLOPs per image (the §6 honesty rule: model
+    arithmetic, not profiler counts). Counts conv/dense MACs ×2."""
+    total = 0.0
+    size = image_size // 2  # stem stride 2
+    total += 2.0 * size * size * cfg.width * 3 * 49  # 7x7 stem
+    size //= 2  # maxpool
+    in_c = cfg.width
+    for stage, blocks in enumerate(cfg.stage_sizes):
+        filters = cfg.width * 2**stage
+        for block in range(blocks):
+            stride = 2 if stage > 0 and block == 0 else 1
+            out_size = size // stride
+            # 1x1 in (at input res), 3x3 (strided), 1x1 out
+            total += 2.0 * size * size * filters * in_c
+            total += 2.0 * out_size * out_size * filters * filters * 9
+            total += 2.0 * out_size * out_size * (filters * 4) * filters
+            if in_c != filters * 4 or stride != 1:
+                total += 2.0 * out_size * out_size * (filters * 4) * in_c
+            in_c = filters * 4
+            size = out_size
+    total += 2.0 * in_c * cfg.num_classes
+    return 3.0 * total  # fwd + bwd
