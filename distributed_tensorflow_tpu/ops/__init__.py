@@ -1,0 +1,15 @@
+"""TPU-native hot-op library (Pallas kernels + pure-JAX references).
+
+The reference framework's hot ops lived in hand-written C++/CUDA kernels
+behind the TF op registry (SURVEY.md §2b — NCCL allreduce, accumulator and
+queue kernels); on TPU the data-plane equivalents are XLA-lowered collectives
+plus Pallas kernels for the ops XLA cannot fuse optimally (SURVEY.md §5.8
+"native-code policy"). This package holds those kernels and their pure-JAX
+reference implementations (the oracle every kernel is tested against).
+"""
+
+from .attention import (  # noqa: F401
+    attention_reference,
+    blockwise_attention,
+)
+from .flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,140 @@
+"""Attention: O(S²) reference and O(block) blockwise (online-softmax) forms.
+
+Layout convention for this module: ``[batch, heads, seq, head_dim]``
+(blocking over ``seq`` puts the two innermost dims — seq-block × head_dim —
+onto the TPU's (sublane × lane) tiles; models transpose once at the
+attention boundary).
+
+``attention_reference`` is the numerics oracle. ``blockwise_attention`` is
+the memory-efficient pure-JAX form (FlashAttention recurrence as a
+``lax.scan`` over KV blocks) — it is the inner loop of ring attention
+(parallel/ring_attention.py), the CPU fallback for the Pallas kernel
+(ops/flash_attention.py), and fully differentiable by autodiff.
+
+The reference framework has no analog — its attention-era models predate it
+(SURVEY.md §5.7 "Reference: entirely absent"); this is new-framework
+capability required first-class by the task spec.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
+
+
+def _scale(q, sm_scale):
+    return sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    kv_mask: jax.Array | None = None,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Plain softmax(QKᵀ)V in f32. Shapes: q [B,H,Sq,D], k/v [B,H,Sk,D],
+    kv_mask [B,Sk] bool (True = attend). Returns [B,H,Sq,D] in q.dtype."""
+    Sq, Sk = q.shape[2], k.shape[2]
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * _scale(q, sm_scale)
+    if kv_mask is not None:
+        logits = jnp.where(kv_mask[:, None, None, :], logits, NEG_INF)
+    if causal:
+        qi = jnp.arange(Sq)[:, None] + (Sk - Sq)  # supports Sq<Sk (decode)
+        ki = jnp.arange(Sk)[None, :]
+        logits = jnp.where((ki <= qi)[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", probs.astype(v.dtype), v
+    ).astype(q.dtype)
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    kv_mask: jax.Array | None = None,
+    sm_scale: float | None = None,
+    block_k: int = 512,
+) -> jax.Array:
+    """Online-softmax attention scanned over KV blocks — O(Sq·block_k)
+    activation memory instead of O(Sq·Sk).
+
+    The recurrence (running max m, running denominator l, rescaled
+    accumulator acc) is the same one the Pallas kernel implements on-chip
+    and ring attention runs across chips; here it is a ``lax.scan`` that XLA
+    compiles directly, so it runs on any backend and differentiates via
+    autodiff (each block is rematerialized in the backward pass by the scan).
+    """
+    B, H, Sq, D = q.shape
+    orig_sk = k.shape[2]
+    scale = _scale(q, sm_scale)
+    block_k = min(block_k, orig_sk)
+    if orig_sk % block_k != 0:
+        # pad keys to a block multiple; padded positions are masked out
+        pad = block_k - orig_sk % block_k
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        base = jnp.arange(orig_sk + pad) < orig_sk
+        kv_mask = (
+            jnp.pad(kv_mask, ((0, 0), (0, pad))) & base[None]
+            if kv_mask is not None
+            else jnp.broadcast_to(base[None], (B, orig_sk + pad))
+        )
+    Sk = k.shape[2]
+    n_blocks = Sk // block_k
+
+    kb = jnp.moveaxis(k.reshape(B, H, n_blocks, block_k, D), 2, 0)
+    vb = jnp.moveaxis(v.reshape(B, H, n_blocks, block_k, D), 2, 0)
+    mb = (
+        jnp.moveaxis(kv_mask.reshape(B, n_blocks, block_k), 1, 0)
+        if kv_mask is not None
+        else jnp.ones((n_blocks, 1, block_k), bool)
+    )
+
+    q32 = q.astype(jnp.float32)
+    # causal offset aligns the last query with the last ORIGINAL key
+    qpos = jnp.arange(Sq)[:, None] + (orig_sk - Sq)
+
+    def body(carry, xs):
+        acc, m, l = carry
+        k_j, v_j, mask_j, j = xs
+        logits = jnp.einsum(
+            "bhqd,bhkd->bhqk", q32, k_j.astype(jnp.float32)
+        ) * scale  # [B,H,Sq,block_k]
+        mask = jnp.broadcast_to(mask_j[:, None, None, :], logits.shape)
+        if causal:
+            kpos = j * block_k + jnp.arange(block_k)[None, :]
+            mask = mask & jnp.broadcast_to(
+                (kpos <= qpos)[None, None], logits.shape
+            )
+        logits = jnp.where(mask, logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(-1))
+        # explicit zero under the mask: for fully-masked rows m stays
+        # NEG_INF and exp(NEG_INF - NEG_INF) would be 1, poisoning l
+        p = jnp.where(mask, jnp.exp(logits - m_new[..., None]), 0.0)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(-1)
+        acc_new = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_j.astype(jnp.float32)
+        )
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m0 = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    (acc, m, l), _ = jax.lax.scan(
+        body, (acc0, m0, l0), (kb, vb, mb, jnp.arange(n_blocks))
+    )
+
+    # l == 0 only when every key is masked for that query; emit zeros.
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
