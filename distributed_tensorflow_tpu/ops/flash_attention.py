@@ -132,7 +132,8 @@ def _fwd_kernel(
 
 
 def _fwd_call(
-    q, k, v, kv_mask, *, sm_scale, causal, block_q, block_k, interpret
+    q, k, v, kv_mask, *, sm_scale, causal, q_offset, block_q, block_k,
+    interpret
 ):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -141,8 +142,7 @@ def _fwd_call(
     kernel = functools.partial(
         _fwd_kernel,
         sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k,
-        q_offset=Sk - Sq,  # align last query with last key (decode-style)
+        block_q=block_q, block_k=block_k, q_offset=q_offset,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -277,32 +277,35 @@ def _bwd_dq_kernel(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, kv_mask, sm_scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, kv_mask, sm_scale, causal, block_q, block_k, interpret,
+           q_offset):
     out, _ = _fwd_call(
         q, k, v, kv_mask,
-        sm_scale=sm_scale, causal=causal,
+        sm_scale=sm_scale, causal=causal, q_offset=q_offset,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return out
 
 
-def _flash_fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k,
+               interpret, q_offset):
     out, lse = _fwd_call(
         q, k, v, kv_mask,
-        sm_scale=sm_scale, causal=causal,
+        sm_scale=sm_scale, causal=causal, q_offset=q_offset,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return out, (q, k, v, kv_mask, out, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, q_offset,
+               res, do):
     q, k, v, kv_mask, out, lse = res
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     common = dict(
         sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, q_offset=Sk - Sq,
+        block_q=block_q, block_k=block_k, q_offset=q_offset,
     )
 
     qspec = lambda b, h, j, i: (b, h, i, 0)  # noqa: E731
@@ -414,4 +417,10 @@ def flash_attention(
         # full-size middle dim (TPU tiling wants the 2nd-to-last dim full)
         kv_mask = kv_mask.astype(jnp.int32)[:, None, :]
     scale = sm_scale if sm_scale is not None else D**-0.5
-    return _flash(q, k, v, kv_mask, scale, causal, block_q, block_k, interpret)
+    # causal alignment: last query attends the last key (self-attn; also
+    # right for decode where Sq < Sk). Traced per-device offsets (sequence
+    # parallelism) cannot be a static kernel param — those paths use the
+    # dense position-aware fallback in parallel/ring_attention.py.
+    q_offset = Sk - Sq
+    return _flash(q, k, v, kv_mask, scale, causal, block_q, block_k,
+                  interpret, q_offset)
