@@ -6,3 +6,10 @@ from .pipeline import (  # noqa: F401
     local_batch_size,
     make_dataset,
 )
+from .text import (  # noqa: F401
+    SyntheticLM,
+    SyntheticMLM,
+    TextDataConfig,
+    TokenFileLM,
+    make_text_dataset,
+)

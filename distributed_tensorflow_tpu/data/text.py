@@ -1,0 +1,172 @@
+"""Text streams for LM/MLM workloads: synthetic learnable corpora + token
+file reader.
+
+Reference analog: the BERT config's TFRecord input pipeline
+(SURVEY.md §2a 'Input pipeline' row; BASELINE.json:10). Per-host disjoint
+slices follow the same seeding discipline as pipeline.py; batches are
+numpy dicts that Trainer.put_batch assembles into global sharded arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+from .pipeline import local_batch_size
+
+MASK_FRACTION_KEEP = 0.1  # BERT 80/10/10 corruption split
+MASK_FRACTION_RANDOM = 0.1
+IGNORE_INDEX = -100
+
+
+@dataclasses.dataclass(frozen=True)
+class TextDataConfig:
+    dataset: str = "synthetic_mlm"  # synthetic_mlm | synthetic_lm | tokens:<path.npy>
+    global_batch_size: int = 256
+    seq_len: int = 128
+    vocab_size: int = 30528
+    mask_prob: float = 0.15
+    seed: int = 0
+    mask_token: int = 103  # [MASK] in BERT vocab
+
+
+class SyntheticMLM:
+    """Learnable synthetic MLM: positions alternate (free, determined) —
+    token at odd index = perm[token at even index]. A masked odd token is
+    recoverable from its left neighbor, a masked even one from its right
+    neighbor via the inverse permutation, so MLM accuracy has real headroom
+    (≈1.0 achievable) and convergence tests are meaningful — the text analog
+    of pipeline.SyntheticClassification's linear teacher."""
+
+    def __init__(self, cfg: TextDataConfig, num_batches: int | None = None,
+                 index_offset: int = 0):
+        self.cfg = cfg
+        self.num_batches = num_batches
+        self.index_offset = index_offset
+        self.local_bs = local_batch_size(cfg.global_batch_size)
+        rng = np.random.RandomState(cfg.seed)
+        self.perm = rng.permutation(cfg.vocab_size)
+
+    def _tokens(self, rng: np.random.RandomState) -> np.ndarray:
+        cfg = self.cfg
+        half = (cfg.seq_len + 1) // 2
+        even = rng.randint(0, cfg.vocab_size, (self.local_bs, half))
+        odd = self.perm[even]
+        seq = np.empty((self.local_bs, half * 2), np.int64)
+        seq[:, 0::2] = even
+        seq[:, 1::2] = odd
+        return seq[:, : cfg.seq_len]
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        import jax
+
+        cfg = self.cfg
+        index += self.index_offset
+        seed = (cfg.seed * 1_000_003 + index) * 97 + jax.process_index()
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        tokens = self._tokens(rng)
+
+        masked = rng.rand(*tokens.shape) < cfg.mask_prob
+        labels = np.where(masked, tokens, IGNORE_INDEX)
+        u = rng.rand(*tokens.shape)
+        inputs = tokens.copy()
+        # 80% -> [MASK], 10% -> random token, 10% -> keep
+        inputs[masked & (u < 0.8)] = cfg.mask_token
+        rand_tok = rng.randint(0, cfg.vocab_size, tokens.shape)
+        inputs[masked & (u >= 0.8) & (u < 0.9)] = rand_tok[
+            masked & (u >= 0.8) & (u < 0.9)
+        ]
+        return {
+            "input_ids": inputs.astype(np.int32),
+            "labels": labels.astype(np.int32),
+        }
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        i = 0
+        while self.num_batches is None or i < self.num_batches:
+            yield self.batch(i)
+            i += 1
+
+
+class SyntheticLM:
+    """Learnable causal stream: first token free, then a noisy deterministic
+    walk t[i+1] = perm[t[i]] (with ``noise`` chance of a uniform resample) —
+    next-token accuracy converges toward 1-noise."""
+
+    def __init__(self, cfg: TextDataConfig, num_batches: int | None = None,
+                 index_offset: int = 0, noise: float = 0.05):
+        self.cfg = cfg
+        self.num_batches = num_batches
+        self.index_offset = index_offset
+        self.noise = noise
+        self.local_bs = local_batch_size(cfg.global_batch_size)
+        rng = np.random.RandomState(cfg.seed)
+        self.perm = rng.permutation(cfg.vocab_size)
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        import jax
+
+        cfg = self.cfg
+        index += self.index_offset
+        seed = (cfg.seed * 1_000_003 + index) * 97 + jax.process_index()
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        seq = np.empty((self.local_bs, cfg.seq_len), np.int64)
+        seq[:, 0] = rng.randint(0, cfg.vocab_size, self.local_bs)
+        for i in range(1, cfg.seq_len):
+            step = self.perm[seq[:, i - 1]]
+            resample = rng.rand(self.local_bs) < self.noise
+            seq[:, i] = np.where(
+                resample, rng.randint(0, cfg.vocab_size, self.local_bs), step
+            )
+        return {"input_ids": seq.astype(np.int32)}
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        i = 0
+        while self.num_batches is None or i < self.num_batches:
+            yield self.batch(i)
+            i += 1
+
+
+class TokenFileLM:
+    """Causal LM batches over a flat token array (.npy of int32 ids) — the
+    hook for real corpora tokenized offline. Per-host disjoint strided
+    windows; index_offset resumes the stream."""
+
+    def __init__(self, path: str, cfg: TextDataConfig,
+                 num_batches: int | None = None, index_offset: int = 0):
+        self.tokens = np.load(path, mmap_mode="r")
+        self.cfg = cfg
+        self.num_batches = num_batches
+        self.index_offset = index_offset
+        self.local_bs = local_batch_size(cfg.global_batch_size)
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        import jax
+
+        cfg = self.cfg
+        index += self.index_offset
+        n_windows = (len(self.tokens) - 1) // cfg.seq_len
+        rng = np.random.RandomState((cfg.seed + index) & 0x7FFFFFFF)
+        starts = rng.randint(0, n_windows, self.local_bs * jax.process_count())
+        starts = starts[jax.process_index():: jax.process_count()] * cfg.seq_len
+        ids = np.stack([self.tokens[s : s + cfg.seq_len] for s in starts])
+        return {"input_ids": ids.astype(np.int32)}
+
+    def __iter__(self):
+        i = 0
+        while self.num_batches is None or i < self.num_batches:
+            yield self.batch(i)
+            i += 1
+
+
+def make_text_dataset(cfg: TextDataConfig, num_batches: int | None = None,
+                      index_offset: int = 0):
+    if cfg.dataset == "synthetic_mlm":
+        return SyntheticMLM(cfg, num_batches, index_offset)
+    if cfg.dataset == "synthetic_lm":
+        return SyntheticLM(cfg, num_batches, index_offset)
+    if cfg.dataset.startswith("tokens:"):
+        return TokenFileLM(cfg.dataset[7:], cfg, num_batches, index_offset)
+    raise ValueError(f"Unknown text dataset '{cfg.dataset}'")

@@ -8,3 +8,9 @@ from . import common  # noqa: F401
 from .mlp import MLP, MLPConfig  # noqa: F401
 from .cnn import CNN, CNNConfig  # noqa: F401
 from .resnet import ResNet, ResNet50, ResNetConfig  # noqa: F401
+from .transformer import (  # noqa: F401
+    Transformer,
+    TransformerConfig,
+    bert_base,
+    gpt_small,
+)

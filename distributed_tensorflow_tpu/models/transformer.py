@@ -1,0 +1,335 @@
+"""Transformer family: BERT-style encoder (MLM) and causal decoder (LM).
+
+Reference analog: the harness's BERT-base distributed train script
+(SURVEY.md §2a 'Model fns' row; BASELINE.json:10) — a raw-TF graph whose
+variables `replica_device_setter` scattered over PS tasks. TPU-first
+choices here:
+
+- **bf16 compute, f32 LayerNorm/softmax**: matmuls hit the MXU in
+  bfloat16; normalization statistics and attention logits stay f32.
+- **Tensor parallelism by layout, not code**: parameters are plain flax
+  params; `tp_rules()` returns the path-regex → PartitionSpec table
+  (megatron column/row pattern) and GSPMD inserts the all-gather /
+  reduce-scatter. Swapping TP degree touches zero model code
+  (parallel/sharding.py design).
+- **Attention dispatch**: dense oracle (ops/attention.py), Pallas flash
+  kernel on TPU (ops/flash_attention.py), or sequence-parallel schedules
+  (ring/ulysses/allgather, parallel/ring_attention.py) when the mesh has
+  a `seq` axis — selected by config, same module code.
+- **Tied embeddings**: the MLM/LM head attends the input embedding table
+  (one [vocab, d_model] matrix, vocab-shardable over `model`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..data.text import IGNORE_INDEX  # single sentinel shared with the data layer
+from ..ops.attention import attention_reference, blockwise_attention
+from ..ops.flash_attention import flash_attention
+from ..parallel import mesh as mesh_lib
+from ..parallel.ring_attention import sequence_parallel_attention
+from ..utils import flops as flops_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 30528  # BERT vocab rounded up to a multiple of 128
+    max_len: int = 512
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    d_ff: int = 3072
+    dropout: float = 0.1
+    causal: bool = False         # False = bidirectional encoder (BERT)
+    pre_ln: bool = False         # BERT is post-LN; decoders default pre-LN
+    dtype: str = "bfloat16"
+    # "auto": flash kernel on TPU, dense reference elsewhere.
+    # "dense" | "blockwise" | "flash" force an implementation.
+    attention_impl: str = "auto"
+    # None = no sequence parallelism; "ring"|"ulysses"|"allgather" engage
+    # when the model is built with a mesh whose seq axis > 1.
+    seq_impl: str | None = None
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.num_heads == 0
+        return self.d_model // self.num_heads
+
+
+def bert_base() -> TransformerConfig:
+    """BERT-base/uncased shape (BASELINE.json:10)."""
+    return TransformerConfig()
+
+
+def gpt_small(causal_len: int = 1024) -> TransformerConfig:
+    """Decoder-only LM, GPT-2-small shape — pre-LN, causal."""
+    return TransformerConfig(
+        vocab_size=50304, max_len=causal_len, num_layers=12, d_model=768,
+        num_heads=12, d_ff=3072, causal=True, pre_ln=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel layout (megatron column/row pattern)
+# ---------------------------------------------------------------------------
+
+#: Path-regex sharding rules for any Transformer tree (sharding.PathRules).
+#: Column-parallel in (output dim over `model`), row-parallel out (input dim
+#: over `model`) — one all-reduce per block half, placed by GSPMD on ICI.
+TP_PATH_RULES = (
+    (r"(query|key|value)/kernel", P(None, mesh_lib.MODEL)),
+    (r"(query|key|value)/bias", P(mesh_lib.MODEL)),
+    (r"attn_out/kernel", P(mesh_lib.MODEL, None)),
+    (r"mlp_in/kernel", P(None, mesh_lib.MODEL)),
+    (r"mlp_in/bias", P(mesh_lib.MODEL)),
+    (r"mlp_out/kernel", P(mesh_lib.MODEL, None)),
+    (r"tok_embed/embedding", P(mesh_lib.MODEL, None)),  # vocab-sharded
+    (r"mlm_bias", P(mesh_lib.MODEL)),
+)
+
+
+def tp_rules():
+    return TP_PATH_RULES
+
+
+# ---------------------------------------------------------------------------
+# Modules
+# ---------------------------------------------------------------------------
+
+
+class SelfAttention(nn.Module):
+    cfg: TransformerConfig
+    mesh: Any = None  # jax.sharding.Mesh or None; static module metadata
+
+    @nn.compact
+    def __call__(self, x, mask, *, train: bool):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        H, D = cfg.num_heads, cfg.head_dim
+        B, S, _ = x.shape
+        dense = lambda name: nn.Dense(
+            H * D, dtype=dtype, name=name,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        # [B,S,Hd] -> [B,H,S,D] (ops/ layout convention)
+        split = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        q = split(dense("query")(x))
+        k = split(dense("key")(x))
+        v = split(dense("value")(x))
+
+        seq_shards = self.mesh.shape[mesh_lib.SEQ] if self.mesh is not None else 1
+        if cfg.seq_impl is not None and seq_shards > 1:
+            out = sequence_parallel_attention(
+                q, k, v, self.mesh, impl=cfg.seq_impl,
+                causal=cfg.causal, kv_mask=mask,
+            )
+        else:
+            impl = cfg.attention_impl
+            if impl == "auto":
+                impl = "flash" if jax.default_backend() == "tpu" else "dense"
+            if impl == "flash":
+                # pad S to the kernel's block multiple; padded keys masked out,
+                # padded query rows sliced off (flash_attention requires
+                # Sq/Sk % block == 0)
+                pad = (-S) % 128 if S > 128 else 0
+                if pad:
+                    pq, pk, pv = (
+                        jnp.pad(t, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                        for t in (q, k, v)
+                    )
+                    pmask = (
+                        mask
+                        if mask is not None
+                        else jnp.ones((B, S), bool)
+                    )
+                    pmask = jnp.pad(pmask, ((0, 0), (0, pad)))
+                    out = flash_attention(
+                        pq, pk, pv, causal=cfg.causal, kv_mask=pmask
+                    )[:, :, :S]
+                else:
+                    out = flash_attention(q, k, v, causal=cfg.causal, kv_mask=mask)
+            elif impl == "blockwise":
+                out = blockwise_attention(q, k, v, causal=cfg.causal, kv_mask=mask)
+            else:
+                out = attention_reference(q, k, v, causal=cfg.causal, kv_mask=mask)
+
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+        out = nn.Dense(cfg.d_model, dtype=dtype, name="attn_out",
+                       kernel_init=nn.initializers.normal(0.02))(out)
+        return nn.Dropout(cfg.dropout, deterministic=not train)(out)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, mask, *, train: bool):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
+        attn = SelfAttention(cfg, self.mesh, name="attn")
+
+        def mlp(h):
+            h = nn.Dense(cfg.d_ff, dtype=dtype, name="mlp_in",
+                         kernel_init=nn.initializers.normal(0.02))(h)
+            h = nn.gelu(h)
+            h = nn.Dense(cfg.d_model, dtype=dtype, name="mlp_out",
+                         kernel_init=nn.initializers.normal(0.02))(h)
+            return nn.Dropout(cfg.dropout, deterministic=not train)(h)
+
+        if cfg.pre_ln:
+            x = x + attn(ln("ln1")(x).astype(dtype), mask, train=train)
+            x = x + mlp(ln("ln2")(x).astype(dtype))
+        else:  # post-LN (BERT)
+            x = ln("ln1")(x + attn(x, mask, train=train)).astype(dtype)
+            x = ln("ln2")(x + mlp(x)).astype(dtype)
+        return x
+
+
+class Transformer(nn.Module):
+    """Token-in, logits-out transformer. ``input_ids`` [B,S] int32;
+    ``attention_mask`` [B,S] (1 = real token) or None. Returns [B,S,vocab]
+    logits (f32) from the tied embedding head."""
+
+    cfg: TransformerConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, *, train: bool = False):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        B, S = input_ids.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.d_model, name="tok_embed",
+                       embedding_init=nn.initializers.normal(0.02))
+        x = tok(input_ids)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (cfg.max_len, cfg.d_model), jnp.float32,
+        )
+        x = (x + pos[None, :S]).astype(dtype)
+        if not cfg.pre_ln:
+            x = nn.LayerNorm(dtype=jnp.float32, name="embed_ln")(x).astype(dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+
+        mask = attention_mask.astype(bool) if attention_mask is not None else None
+        for i in range(cfg.num_layers):
+            x = Block(cfg, self.mesh, name=f"layer_{i}")(x, mask, train=train)
+        if cfg.pre_ln:
+            x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x).astype(dtype)
+
+        if not cfg.causal:
+            # BERT MLM transform head before the tied projection
+            x = nn.Dense(cfg.d_model, dtype=dtype, name="mlm_transform",
+                         kernel_init=nn.initializers.normal(0.02))(x)
+            x = nn.gelu(x)
+            x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x).astype(dtype)
+        logits = tok.attend(x.astype(jnp.float32))
+        bias = self.param("mlm_bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.float32)
+        return logits + bias
+
+
+# ---------------------------------------------------------------------------
+# Loss adapters (train-engine LossFn contract, cf. models/common.py)
+# ---------------------------------------------------------------------------
+
+
+
+def _masked_xent(logits, labels):
+    """Mean cross-entropy over positions where labels != IGNORE_INDEX."""
+    valid = labels != IGNORE_INDEX
+    safe = jnp.where(valid, labels, 0)
+    xent = -jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    per_tok = jnp.take_along_axis(xent, safe[..., None], axis=-1)[..., 0]
+    per_tok = jnp.where(valid, per_tok, 0.0)
+    count = jnp.maximum(valid.sum(), 1)
+    loss = per_tok.sum() / count
+    acc = jnp.where(
+        valid, jnp.argmax(logits, -1) == safe, False
+    ).sum() / count
+    return loss, acc
+
+
+def mlm_loss_fn(model: Transformer):
+    """Masked-LM loss. Batch: {"input_ids" [B,S], "labels" [B,S] with
+    IGNORE_INDEX on unmasked positions, optional "attention_mask" [B,S]}."""
+
+    def loss_fn(params, model_state, batch, rng):
+        logits = model.apply(
+            {"params": params}, batch["input_ids"],
+            batch.get("attention_mask"), train=True, rngs={"dropout": rng},
+        )
+        loss, acc = _masked_xent(logits, batch["labels"])
+        return loss, (model_state, {"accuracy": acc})
+
+    return loss_fn
+
+
+def lm_loss_fn(model: Transformer):
+    """Next-token loss for causal models. Batch: {"input_ids" [B,S]};
+    position t predicts token t+1."""
+
+    def loss_fn(params, model_state, batch, rng):
+        ids = batch["input_ids"]
+        logits = model.apply(
+            {"params": params}, ids, batch.get("attention_mask"),
+            train=True, rngs={"dropout": rng},
+        )
+        labels = jnp.concatenate(
+            [ids[:, 1:], jnp.full_like(ids[:, :1], IGNORE_INDEX)], axis=1
+        )
+        if "attention_mask" in batch:
+            labels = jnp.where(batch["attention_mask"] > 0, labels, IGNORE_INDEX)
+        loss, acc = _masked_xent(logits, labels)
+        return loss, (model_state, {"accuracy": acc})
+
+    return loss_fn
+
+
+def make_init_fn(model: Transformer, seq_len: int):
+    """init_fn(rng) -> (params, {}) for init_train_state.
+
+    Initializes through a dense twin (seq_impl=None, no mesh): attention
+    has no impl-dependent parameters, and the twin avoids tracing shard_map
+    islands with a batch-1 dummy that a data axis couldn't divide."""
+    cfg = model.cfg
+    init_model = (
+        Transformer(dataclasses.replace(cfg, seq_impl=None))
+        if (model.mesh is not None or cfg.seq_impl is not None)
+        else model
+    )
+
+    def init_fn(rng):
+        dummy = jnp.zeros((1, seq_len), jnp.int32)
+        variables = init_model.init({"params": rng, "dropout": rng}, dummy,
+                                    train=False)
+        return variables["params"], {}
+
+    return init_fn
+
+
+def param_count(cfg: TransformerConfig) -> int:
+    """Analytic parameter count (embeddings + blocks + heads)."""
+    d, L = cfg.d_model, cfg.num_layers
+    embed = cfg.vocab_size * d + cfg.max_len * d
+    embed += 2 * d  # embed_ln (post-LN) or final_ln (pre-LN)
+    per_block = 4 * d * d + 2 * d * cfg.d_ff  # qkv+out, mlp in/out kernels
+    per_block += 4 * d + cfg.d_ff + d + 4 * d  # biases + 2 LN
+    head = 0 if cfg.causal else d * d + 3 * d
+    return embed + L * per_block + head + cfg.vocab_size
+
+
+def flops_per_example(cfg: TransformerConfig, seq_len: int) -> float:
+    """Forward FLOPs per example at ``seq_len`` (×3 for training in the
+    engine's MFU accounting, utils/flops.py train_flops_multiplier)."""
+    return seq_len * flops_lib.transformer_flops_per_token(
+        param_count(cfg), seq_len, cfg.num_layers, cfg.d_model
+    )

@@ -1,8 +1,9 @@
 """Workload registry — the five BASELINE.json configs as presets.
 
 Each workload module exposes ``default_config() -> RunConfig`` and
-``build(cfg) -> WorkloadParts``; the shared runner (runner.py) does the
-rest. Registered lazily so importing the registry doesn't pull every model.
+``build(cfg, mesh) -> WorkloadParts``; the shared runner (runner.py) does
+the rest. Registered lazily so importing the registry doesn't pull every
+model.
 """
 
 from __future__ import annotations

@@ -1,0 +1,62 @@
+"""Workload 4 — BERT-base MLM pretraining, pod-scale config
+(BASELINE.json:10).
+
+Reference analog: the harness's BERT script — PS/worker sync replicas at
+512 tokens (SURVEY.md §2a). TPU-native: one jit SPMD step over a
+data×fsdp×model mesh; tensor parallelism via the megatron path rules
+(models/transformer.TP_PATH_RULES), optional sequence parallelism
+(cfg.model.seq_impl + mesh seq axis) for long-context variants
+(SURVEY.md §5.7: 512-token baseline doesn't need SP; the plumbing is
+first-class here and gated by config)."""
+
+from __future__ import annotations
+
+from ..data import TextDataConfig, make_text_dataset
+from ..models import transformer as tfm
+from ..parallel import MeshSpec
+from ..train import OptimizerConfig
+from ..utils import flops as flops_lib
+from .runner import RunConfig, TrainSection, WorkloadParts
+
+
+def default_config() -> RunConfig:
+    model = tfm.bert_base()
+    return RunConfig(
+        workload="bert_pretrain",
+        model=model,
+        mesh=MeshSpec(data=-1),
+        data=TextDataConfig(
+            dataset="synthetic_mlm", global_batch_size=256,
+            seq_len=model.max_len, vocab_size=model.vocab_size,
+        ),
+        optimizer=OptimizerConfig(
+            name="adamw", learning_rate=1e-4, weight_decay=0.01,
+            warmup_steps=1000, schedule="linear", total_steps=10000,
+        ),
+        train=TrainSection(num_steps=10000, log_every=100),
+    )
+
+
+def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
+    mcfg: tfm.TransformerConfig = cfg.model
+    if cfg.data.seq_len > mcfg.max_len:
+        raise ValueError(
+            f"data.seq_len={cfg.data.seq_len} exceeds model.max_len={mcfg.max_len}"
+        )
+    if cfg.data.vocab_size != mcfg.vocab_size:
+        # out-of-range ids would be silently clamped by jnp.take under jit
+        raise ValueError(
+            f"data.vocab_size={cfg.data.vocab_size} != "
+            f"model.vocab_size={mcfg.vocab_size}"
+        )
+    model = tfm.Transformer(mcfg, mesh)
+    fwd_flops = tfm.flops_per_example(mcfg, cfg.data.seq_len)
+    return WorkloadParts(
+        init_fn=tfm.make_init_fn(model, cfg.data.seq_len),
+        loss_fn=tfm.mlm_loss_fn(model),
+        dataset_fn=lambda start: make_text_dataset(cfg.data, index_offset=start),
+        flops_per_step=fwd_flops * cfg.data.global_batch_size,
+        param_rules=tfm.tp_rules(),
+        fsdp=True,
+        batch_size=cfg.data.global_batch_size,
+    )

@@ -30,7 +30,7 @@ def default_config() -> RunConfig:
     )
 
 
-def build(cfg: RunConfig) -> WorkloadParts:
+def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
     model = CNN(cfg.model)
     input_shape = (cfg.data.image_size, cfg.data.image_size, cfg.data.channels)
     from ..models.cnn import flops_per_example

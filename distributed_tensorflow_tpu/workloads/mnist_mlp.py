@@ -27,7 +27,7 @@ def default_config() -> RunConfig:
     )
 
 
-def build(cfg: RunConfig) -> WorkloadParts:
+def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
     model = MLP(cfg.model)
     input_shape = (cfg.data.image_size, cfg.data.image_size, cfg.data.channels)
     input_dim = cfg.data.image_size**2 * cfg.data.channels

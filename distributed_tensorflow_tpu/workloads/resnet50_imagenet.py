@@ -37,7 +37,7 @@ def default_config() -> RunConfig:
     )
 
 
-def build(cfg: RunConfig) -> WorkloadParts:
+def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
     model = ResNet50(cfg.model)
     input_shape = (cfg.data.image_size, cfg.data.image_size, cfg.data.channels)
     return WorkloadParts(

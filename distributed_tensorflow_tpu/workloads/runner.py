@@ -86,15 +86,18 @@ class RunResult:
     mesh: Any
 
 
-def run(cfg: RunConfig, build: Callable[[RunConfig], WorkloadParts],
+def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
         extra_callbacks: Iterable[cb.Callback] = ()) -> RunResult:
+    """``build(cfg, mesh) -> WorkloadParts``: every workload takes the mesh
+    (models embedding collective schedules — seq-parallel attention,
+    pipeline stages — need it at construction; others ignore it)."""
     cluster.initialize()
     mesh = build_mesh(cfg.mesh)
     if cluster.is_chief():
         logger.info("mesh: %s", describe(mesh))
         logger.info("config:\n%s", config_lib.to_json(cfg))
 
-    parts = build(cfg)
+    parts = build(cfg, mesh)
     tx = make_optimizer(cfg.optimizer)
     rng = jax.random.PRNGKey(cfg.train.seed)
 
