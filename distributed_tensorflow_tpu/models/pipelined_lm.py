@@ -1,0 +1,191 @@
+"""Pipeline-parallel decoder LM: embed/head pipe-replicated, transformer
+blocks sharded stage-wise over the ``pipe`` mesh axis.
+
+The full-integration demonstration of parallel/pipeline.py (SURVEY.md §2c
+'Pipeline parallel' row): parameters are a plain pytree (the train engine's
+LossFn contract is framework-agnostic — flax is a convenience, not a
+requirement), with every block leaf carrying a leading ``[n_stages,
+layers_per_stage, ...]`` dim; stage s scans its own layer slice. The
+heterogeneous ends (token embedding lookup, final LN + tied head) run
+outside the shard_map island, replicated over ``pipe`` — the standard
+shape-preservation constraint of SPMD pipelining (pipeline.py docstring).
+
+Composes pp×dp/fsdp: the batch dim stays sharded over (data, fsdp) inside
+the pipeline's shard_map. Deterministic (no dropout) — pipelined
+pretraining at this scale regularizes with data, not dropout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import blockwise_attention
+from ..parallel import mesh as mesh_lib
+from ..parallel.pipeline import (
+    microbatch,
+    pipeline_apply,
+    stage_param_specs,
+    unmicrobatch,
+)
+from .transformer import IGNORE_INDEX, _masked_xent
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelinedLMConfig:
+    vocab_size: int = 50304
+    max_len: int = 1024
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    d_ff: int = 3072
+    n_stages: int = 2
+    n_microbatches: int = 4
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def layers_per_stage(self) -> int:
+        if self.num_layers % self.n_stages:
+            raise ValueError(
+                f"num_layers={self.num_layers} not divisible by "
+                f"n_stages={self.n_stages}"
+            )
+        return self.num_layers // self.n_stages
+
+
+def _init_block(key, cfg: PipelinedLMConfig):
+    d, f = cfg.d_model, cfg.d_ff
+    ks = jax.random.split(key, 6)
+    norm = lambda k, shape, scale: jax.random.normal(k, shape, jnp.float32) * scale
+    return {
+        "ln1_scale": jnp.ones((d,)), "ln1_bias": jnp.zeros((d,)),
+        "wqkv": norm(ks[0], (d, 3 * d), 0.02), "bqkv": jnp.zeros((3 * d,)),
+        "wo": norm(ks[1], (d, d), 0.02), "bo": jnp.zeros((d,)),
+        "ln2_scale": jnp.ones((d,)), "ln2_bias": jnp.zeros((d,)),
+        "w_in": norm(ks[2], (d, f), 0.02), "b_in": jnp.zeros((f,)),
+        "w_out": norm(ks[3], (f, d), 0.02), "b_out": jnp.zeros((d,)),
+    }
+
+
+def init_params(key, cfg: PipelinedLMConfig):
+    kb, ke, kp = jax.random.split(key, 3)
+    S, Lps = cfg.n_stages, cfg.layers_per_stage
+    block_keys = jax.random.split(kb, S * Lps).reshape(S, Lps, 2)
+    # vmap over (stage, layer) -> every block leaf is [S, Lps, ...]
+    blocks = jax.vmap(jax.vmap(lambda k: _init_block(k, cfg)))(block_keys)
+    return {
+        "embed": jax.random.normal(ke, (cfg.vocab_size, cfg.d_model)) * 0.02,
+        "pos": jax.random.normal(kp, (cfg.max_len, cfg.d_model)) * 0.02,
+        "final_ln_scale": jnp.ones((cfg.d_model,)),
+        "final_ln_bias": jnp.zeros((cfg.d_model,)),
+        "head_bias": jnp.zeros((cfg.vocab_size,)),
+    } | {"blocks": blocks}
+
+
+def param_specs(params: Any) -> Any:
+    """blocks → P('pipe', ...); everything else pipe-replicated."""
+    specs = jax.tree.map(lambda x: P(), params)
+    specs["blocks"] = stage_param_specs(params["blocks"])
+    return specs
+
+
+def _ln(x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return (x32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+
+
+def _block_apply(p, x, cfg: PipelinedLMConfig):
+    """Pre-LN causal block; x [mb, S, d]."""
+    dtype = jnp.dtype(cfg.dtype)
+    H, D = cfg.num_heads, cfg.head_dim
+    mb, S, d = x.shape
+    h = _ln(x, p["ln1_scale"], p["ln1_bias"]).astype(dtype)
+    qkv = h @ p["wqkv"].astype(dtype) + p["bqkv"].astype(dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = lambda t: t.reshape(mb, S, H, D).transpose(0, 2, 1, 3)
+    out = blockwise_attention(split(q), split(k), split(v), causal=True)
+    out = out.transpose(0, 2, 1, 3).reshape(mb, S, H * D)
+    x = x + (out @ p["wo"].astype(dtype) + p["bo"].astype(dtype))
+    h = _ln(x, p["ln2_scale"], p["ln2_bias"]).astype(dtype)
+    h = jax.nn.gelu(h @ p["w_in"].astype(dtype) + p["b_in"].astype(dtype))
+    return x + (h @ p["w_out"].astype(dtype) + p["b_out"].astype(dtype))
+
+
+def make_stage_fn(cfg: PipelinedLMConfig):
+    """(stage_params [Lps, ...], x [mb, S, d]) -> [mb, S, d]: scan the
+    stage's layer slice."""
+
+    def stage_fn(stage_params, x):
+        def layer(x, p):
+            return _block_apply(p, x, cfg), None
+
+        y, _ = jax.lax.scan(layer, x, stage_params)
+        return y
+
+    return stage_fn
+
+
+def apply(params, input_ids, cfg: PipelinedLMConfig, mesh):
+    """input_ids [B, S] -> logits [B, S, vocab] (f32, pipe-replicated)."""
+    dtype = jnp.dtype(cfg.dtype)
+    B, S = input_ids.shape
+    x = params["embed"][input_ids] + params["pos"][None, :S]
+    x = x.astype(dtype)
+    x_mb = microbatch(x, cfg.n_microbatches)
+    y = pipeline_apply(make_stage_fn(cfg), params["blocks"], x_mb, mesh)
+    y = unmicrobatch(y)
+    y = _ln(y, params["final_ln_scale"], params["final_ln_bias"])
+    return y @ params["embed"].T.astype(jnp.float32) + params["head_bias"]
+
+
+def make_init_fn(cfg: PipelinedLMConfig):
+    def init_fn(rng):
+        return init_params(rng, cfg), {}
+
+    return init_fn
+
+
+def lm_loss_fn(cfg: PipelinedLMConfig, mesh):
+    """Engine LossFn: next-token loss. Batch {"input_ids" [B, S]}."""
+
+    def loss_fn(params, model_state, batch, rng):
+        del rng  # deterministic
+        ids = batch["input_ids"]
+        logits = apply(params, ids, cfg, mesh)
+        labels = jnp.concatenate(
+            [ids[:, 1:], jnp.full_like(ids[:, :1], IGNORE_INDEX)], axis=1
+        )
+        loss, acc = _masked_xent(logits, labels)
+        return loss, (model_state, {"accuracy": acc})
+
+    return loss_fn
+
+
+def reference_apply(params, input_ids, cfg: PipelinedLMConfig):
+    """Sequential (no-pipeline) oracle for tests: same params, same math,
+    plain scan over all S·Lps layers."""
+    dtype = jnp.dtype(cfg.dtype)
+    B, S = input_ids.shape
+    x = params["embed"][input_ids] + params["pos"][None, :S]
+    x = x.astype(dtype)
+    flat = jax.tree.map(
+        lambda p: p.reshape(p.shape[0] * p.shape[1], *p.shape[2:]),
+        params["blocks"],
+    )
+
+    def layer(x, p):
+        return _block_apply(p, x, cfg), None
+
+    x, _ = jax.lax.scan(layer, x, flat)
+    x = _ln(x, params["final_ln_scale"], params["final_ln_bias"])
+    return x @ params["embed"].T.astype(jnp.float32) + params["head_bias"]

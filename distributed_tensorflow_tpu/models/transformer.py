@@ -287,7 +287,13 @@ def lm_loss_fn(model: Transformer):
             [ids[:, 1:], jnp.full_like(ids[:, :1], IGNORE_INDEX)], axis=1
         )
         if "attention_mask" in batch:
-            labels = jnp.where(batch["attention_mask"] > 0, labels, IGNORE_INDEX)
+            # label[t] = ids[t+1]: its validity is the mask at t+1, so the
+            # last real token isn't trained to predict padding
+            mask = batch["attention_mask"]
+            label_valid = jnp.concatenate(
+                [mask[:, 1:] > 0, jnp.zeros_like(mask[:, :1], bool)], axis=1
+            )
+            labels = jnp.where(label_valid, labels, IGNORE_INDEX)
         loss, acc = _masked_xent(logits, labels)
         return loss, (model_state, {"accuracy": acc})
 

@@ -29,3 +29,10 @@ from .cluster import (  # noqa: F401
 )
 from . import collectives  # noqa: F401
 from . import sharding  # noqa: F401
+from .pipeline import (  # noqa: F401
+    microbatch,
+    pipeline_apply,
+    stack_stages,
+    stage_param_specs,
+    unmicrobatch,
+)

@@ -1,0 +1,170 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis.
+
+SURVEY.md §2c row 'Pipeline parallel (PP)': absent from the reference
+(its model-parallelism was variables round-robined over PS processes,
+device_setter.py:147-149); this is the TPU-native mechanism — a
+collective-permute microbatch schedule expressed as one ``shard_map``
+island, activations hopping stage→stage over ICI via ``ppermute``.
+
+Design (the standard GPipe-on-SPMD formulation, cf. the scaling-book's
+pipelining chapter and praxis' LayerwiseShardablePipelined):
+
+- Every stage runs the SAME program (SPMD); stage identity comes from
+  ``lax.axis_index('pipe')``. Stage s holds the parameters for its layer
+  slice — every parameter leaf carries a leading ``[n_stages, ...]`` dim
+  sharded ``P('pipe')``, so each device materializes only its own slice.
+- The schedule is a ``lax.scan`` over ``M + S - 1`` ticks (M microbatches,
+  S stages). At tick t, stage 0 injects microbatch t (while t < M), every
+  stage applies its layers to its current buffer, and the buffer rotates
+  one hop around the ring. Stage S-1's outputs are collected into the
+  result; trailing-edge devices compute on garbage that is masked out —
+  the classic (S-1)/(M+S-1) bubble.
+- Backward is autodiff through the scan: ``ppermute``'s transpose is the
+  reverse-direction ``ppermute``, so the backward pipeline (activations'
+  cotangents flowing stage S-1 → 0) falls out of ``jax.grad`` — no
+  hand-written 1F1B needed for correctness. ``jax.checkpoint`` on the
+  stage fn keeps activation memory at O(layers_per_stage) per tick.
+- Output collection: only stage S-1 holds real outputs; they are
+  broadcast to all pipe ranks with a masked ``psum`` so downstream global
+  code (loss over the full batch) sees a pipe-replicated array.
+
+Constraints (documented, standard): stage_fn must be shape-preserving
+([mb, ...] -> [mb, ...]); heterogeneous ends (embedding lookup, output
+head) run OUTSIDE the pipeline, pipe-replicated — see
+models/pipelined_lm.py. Composes with data/fsdp (batch dim sharded inside
+the same shard_map); tensor parallelism inside a stage would need manual
+collectives and is out of scope here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+
+def stage_param_specs(stage_params: Any) -> Any:
+    """P('pipe', None, ...) for every leaf (leading dim = stage)."""
+    return jax.tree.map(
+        lambda x: P(mesh_lib.PIPE, *([None] * (jnp.ndim(x) - 1))), stage_params
+    )
+
+
+def stack_stages(per_stage: list) -> Any:
+    """[tree_0, ..., tree_{S-1}] (same structure) -> one tree with a
+    leading stage dim on every leaf."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *per_stage)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x_mb: jax.Array,
+    mesh: Mesh,
+) -> jax.Array:
+    """Run ``x_mb`` through the S-stage pipeline.
+
+    stage_fn: (params_slice, x [mb, ...]) -> y [mb, ...] — shape-preserving.
+    stage_params: every leaf [S, ...], to be sharded P('pipe').
+    x_mb: [M, mb, ...] microbatches; mb dim is sharded over (data, fsdp),
+        the microbatch dim M is replicated. Returns [M, mb, ...] outputs,
+        pipe-replicated.
+    """
+    n_stages = mesh.shape[mesh_lib.PIPE]
+    M = x_mb.shape[0]
+    if n_stages == 1:
+        # degenerate: no pipe axis — just scan the single stage's params
+        sq = jax.tree.map(lambda p: p[0], stage_params)
+        return jax.vmap(lambda x: stage_fn(sq, x))(x_mb)
+    if M < n_stages:
+        raise ValueError(
+            f"need at least as many microbatches ({M}) as stages "
+            f"({n_stages}) — bubble would dominate and the schedule "
+            "below assumes M >= S"
+        )
+
+    batch_shards = mesh_lib.mesh_axis_size(mesh, mesh_lib.BATCH_AXES)
+    if x_mb.shape[1] % batch_shards:
+        raise ValueError(
+            f"microbatch size {x_mb.shape[1]} not divisible by "
+            f"data×fsdp={batch_shards}; use fewer microbatches or a larger "
+            "global batch"
+        )
+
+    param_specs = stage_param_specs(stage_params)
+    x_spec = P(None, mesh_lib.BATCH_AXES, *([None] * (x_mb.ndim - 2)))
+
+    body = functools.partial(
+        _pipeline_body, stage_fn, n_stages=n_stages, n_microbatches=M,
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )(stage_params, x_mb)
+
+
+def _pipeline_body(stage_fn, stage_params, x_mb, *, n_stages, n_microbatches):
+    """Per-device schedule; runs inside shard_map. stage_params leaves are
+    [1, ...] local slices; x_mb is [M, mb_local, ...]."""
+    stage = jax.lax.axis_index(mesh_lib.PIPE)
+    params_local = jax.tree.map(lambda p: p[0], stage_params)
+    M, S = n_microbatches, n_stages
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    fn = jax.checkpoint(stage_fn)
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # stage 0 injects microbatch t (clamped; past-M ticks feed garbage
+        # that never reaches a collected output)
+        x_t = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
+        )
+        inp = jnp.where(stage == 0, x_t, buf)
+        y = fn(params_local, inp)
+        # collect this tick's result for microbatch t-(S-1); only stage
+        # S-1's buffer survives the masked psum below, so the per-tick
+        # guard only needs to protect index 0 from pre-warmup clamping
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, y.astype(outputs.dtype), out_idx, 0
+        )
+        outputs = jnp.where(t >= S - 1, updated, outputs)
+        buf = jax.lax.ppermute(y, mesh_lib.PIPE, perm)
+        return (buf, outputs), None
+
+    buf0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros_like(x_mb)
+    (_, outputs), _ = jax.lax.scan(
+        tick, (buf0, out0), jnp.arange(M + S - 1)
+    )
+    # broadcast stage S-1's outputs to every pipe rank (masked psum); the
+    # other ranks' buffers hold zeros/garbage masked to zero above
+    outputs = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(outputs, mesh_lib.PIPE)
+
+
+def microbatch(x: jax.Array, n_microbatches: int) -> jax.Array:
+    """[B, ...] -> [M, B//M, ...] with STRIDED assignment (microbatch m
+    takes rows m, M+m, 2M+m, ...): a device owning a contiguous batch
+    slice keeps exactly its own rows in every microbatch, so the
+    (data, fsdp) sharding lands on dim 1 with no cross-device movement —
+    a contiguous split would shard the M dim instead and force an
+    all-to-all at pipeline_apply's shard_map boundary."""
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError(f"batch {B} not divisible by microbatches {n_microbatches}")
+    return x.reshape(B // n_microbatches, n_microbatches, *x.shape[1:]).swapaxes(0, 1)
+
+
+def unmicrobatch(y: jax.Array) -> jax.Array:
+    """Inverse of :func:`microbatch` (restores original row order)."""
+    return y.swapaxes(0, 1).reshape(y.shape[0] * y.shape[1], *y.shape[2:])

@@ -1,0 +1,149 @@
+"""Pipeline-parallel tests: schedule parity vs sequential oracle (forward
+and gradients), pp×dp composition through the train engine — the
+strategy_test_lib-style distributed-correctness oracles of SURVEY.md §4.4."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.models import pipelined_lm as plm
+from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh
+from distributed_tensorflow_tpu.parallel import sharding as sh
+from distributed_tensorflow_tpu.parallel.pipeline import (
+    microbatch,
+    pipeline_apply,
+    stack_stages,
+    unmicrobatch,
+)
+from distributed_tensorflow_tpu.train import (
+    StepOptions, init_train_state, jit_train_step, make_train_step,
+)
+
+
+def test_microbatch_roundtrip():
+    x = jnp.arange(24.0).reshape(12, 2)
+    mb = microbatch(x, 4)
+    assert mb.shape == (4, 3, 2)
+    np.testing.assert_array_equal(unmicrobatch(mb), x)
+    with pytest.raises(ValueError, match="not divisible"):
+        microbatch(x, 5)
+
+
+def _toy_stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _toy_params(key, n_stages, d):
+    keys = jax.random.split(key, n_stages)
+    return stack_stages([
+        {"w": jax.random.normal(k, (d, d)) * 0.5, "b": jnp.zeros((d,))}
+        for k in keys
+    ])
+
+
+def _toy_sequential(params, x_mb):
+    def per_mb(x):
+        def body(x, p):
+            return _toy_stage_fn(p, x), None
+
+        y, _ = jax.lax.scan(body, x, params)
+        return y
+
+    return jax.vmap(per_mb)(x_mb)
+
+
+def test_pipeline_matches_sequential(devices):
+    mesh = build_mesh(MeshSpec(pipe=4, data=2), devices[:8])
+    params = _toy_params(jax.random.PRNGKey(0), 4, 8)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 6, 8))  # [M, mb, d]
+    want = _toy_sequential(params, x)
+    got = jax.jit(
+        lambda p, x: pipeline_apply(_toy_stage_fn, p, x, mesh)
+    )(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_pipeline_gradients_match(devices):
+    mesh = build_mesh(MeshSpec(pipe=4), devices[:4])
+    params = _toy_params(jax.random.PRNGKey(0), 4, 4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 2, 4))
+
+    def loss_pipe(p):
+        return (pipeline_apply(_toy_stage_fn, p, x, mesh) ** 2).sum()
+
+    def loss_seq(p):
+        return (_toy_sequential(p, x) ** 2).sum()
+
+    g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    g_seq = jax.jit(jax.grad(loss_seq))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        g_pipe, g_seq,
+    )
+
+
+def test_pipeline_rejects_too_few_microbatches(devices):
+    mesh = build_mesh(MeshSpec(pipe=4), devices[:4])
+    params = _toy_params(jax.random.PRNGKey(0), 4, 4)
+    x = jnp.zeros((2, 2, 4))  # M=2 < S=4
+    with pytest.raises(ValueError, match="microbatches"):
+        pipeline_apply(_toy_stage_fn, params, x, mesh)
+
+
+def _tiny_lm_cfg(**kw):
+    base = dict(vocab_size=64, max_len=16, num_layers=4, d_model=32,
+                num_heads=4, d_ff=64, n_stages=2, n_microbatches=4,
+                dtype="float32")
+    base.update(kw)
+    return plm.PipelinedLMConfig(**base)
+
+
+def test_pipelined_lm_matches_reference(devices):
+    cfg = _tiny_lm_cfg(n_stages=4)
+    mesh = build_mesh(MeshSpec(pipe=4, data=2), devices[:8])
+    params = plm.init_params(jax.random.PRNGKey(0), cfg)
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16))
+    ids = jnp.asarray(ids, jnp.int32)
+    want = plm.reference_apply(params, ids, cfg)
+    got = jax.jit(lambda p, i: plm.apply(p, i, cfg, mesh))(params, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_pipelined_lm_trains(devices):
+    """Full train-engine integration on a pipe=2 × data=2 × fsdp=2 mesh:
+    loss decreases on the deterministic-walk corpus."""
+    cfg = _tiny_lm_cfg()
+    mesh = build_mesh(MeshSpec(pipe=2, data=2, fsdp=2), devices[:8])
+    tx = optax.adam(3e-3)
+    state, specs = init_train_state(
+        plm.make_init_fn(cfg), tx, mesh, jax.random.PRNGKey(0),
+        param_specs=plm.param_specs(
+            jax.eval_shape(plm.make_init_fn(cfg), jax.random.PRNGKey(0))[0]
+        ),
+    )
+    assert state.params["blocks"]["wqkv"].sharding.spec[0] == "pipe"
+    step = jit_train_step(
+        make_train_step(plm.lm_loss_fn(cfg, mesh), tx, StepOptions()),
+        mesh, specs,
+    )
+    rng = np.random.RandomState(0)
+    losses = []
+    for i in range(25):
+        start = rng.randint(0, cfg.vocab_size, (16, 1))
+        ids = (start + np.arange(16)[None]) % cfg.vocab_size
+        batch = {"input_ids": jnp.asarray(ids, jnp.int32)}
+        batch = jax.tree.map(
+            lambda x: jax.device_put(
+                x, NamedSharding(mesh, sh.batch_spec(x.ndim))
+            ),
+            batch,
+        )
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+        assert float(metrics["grads_finite"]) == 1.0
+    assert losses[-1] < losses[0] * 0.8, losses

@@ -132,6 +132,27 @@ def test_lm_loss_decreases():
     assert losses[-1] < losses[0] * 0.7, losses
 
 
+def test_lm_loss_ignores_padding_targets():
+    """The last real token of a right-padded sequence must not be trained
+    to predict the pad token: zeroing the pad's mask position removes its
+    label from the loss."""
+    cfg = tiny_cfg(causal=True, pre_ln=True)
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 8)(jax.random.PRNGKey(0))
+    loss_fn = tfm.lm_loss_fn(model)
+    ids = jnp.asarray([[5, 6, 7, 1, 0, 0, 0, 0]], jnp.int32)
+    mask = jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]], jnp.int32)
+    rng = jax.random.PRNGKey(1)
+    loss_masked, _ = loss_fn(params, {}, {"input_ids": ids,
+                                          "attention_mask": mask}, rng)
+    # manual oracle: only labels at positions 0..2 (targets ids[1..3]) count
+    logits = model.apply({"params": params}, ids, mask, train=True,
+                         rngs={"dropout": rng})
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    want = -(lp[0, 0, 6] + lp[0, 1, 7] + lp[0, 2, 1]) / 3
+    np.testing.assert_allclose(float(loss_masked), float(want), rtol=1e-5)
+
+
 def test_synthetic_mlm_dataset():
     cfg = TextDataConfig(global_batch_size=4, seq_len=12, vocab_size=32,
                          mask_prob=0.5, mask_token=0)
