@@ -6,6 +6,7 @@ from .pipeline import (  # noqa: F401
     local_batch_size,
     make_dataset,
 )
+from .recsys import RecsysConfig, SyntheticCTR  # noqa: F401
 from .text import (  # noqa: F401
     SyntheticLM,
     SyntheticMLM,

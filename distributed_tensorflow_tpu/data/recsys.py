@@ -1,0 +1,73 @@
+"""Synthetic CTR stream for the Wide&Deep workload (BASELINE.json:11).
+
+Same design as pipeline.SyntheticClassification: a fixed random teacher
+(per-feature embedding tables + linear head) labels clicks, so loss/AUC
+curves are meaningful without dataset files; per-host disjoint via
+process_index folded into the per-batch seed; Zipf-ish id draws so
+mod-sharded tables see realistic hot-id skew (SURVEY.md §7 M9).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import jax
+import numpy as np
+
+from .pipeline import local_batch_size
+
+
+@dataclasses.dataclass(frozen=True)
+class RecsysConfig:
+    vocab_sizes: tuple[int, ...] = (1024, 1024, 512, 128, 64)
+    dense_features: int = 13
+    global_batch_size: int = 256
+    teacher_dim: int = 8
+    zipf_a: float = 1.3  # id popularity skew
+    seed: int = 0
+
+
+class SyntheticCTR:
+    def __init__(self, cfg: RecsysConfig, num_batches: int | None = None,
+                 index_offset: int = 0):
+        self.cfg = cfg
+        self.num_batches = num_batches
+        self.index_offset = index_offset
+        self.local_bs = local_batch_size(cfg.global_batch_size)
+        rng = np.random.RandomState(cfg.seed)
+        self.teachers = [
+            rng.randn(v, cfg.teacher_dim).astype(np.float32) * 0.5
+            for v in cfg.vocab_sizes
+        ]
+        self.head = rng.randn(
+            len(cfg.vocab_sizes) * cfg.teacher_dim + cfg.dense_features
+        ).astype(np.float32)
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        index += self.index_offset
+        seed = (self.cfg.seed * 1_000_003 + index) * 97 + jax.process_index()
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        cfg = self.cfg
+        b = self.local_bs
+        cat = np.stack(
+            [
+                np.minimum(rng.zipf(cfg.zipf_a, size=b) - 1, v - 1)
+                for v in cfg.vocab_sizes
+            ],
+            axis=-1,
+        ).astype(np.int32)
+        dense = rng.randn(b, cfg.dense_features).astype(np.float32)
+        feats = np.concatenate(
+            [t[cat[:, i]] for i, t in enumerate(self.teachers)] + [dense],
+            axis=-1,
+        )
+        score = feats @ self.head
+        label = (score > 0).astype(np.float32)  # stationary teacher threshold
+        return {"cat": cat, "dense": dense, "label": label}
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        i = 0
+        while self.num_batches is None or i < self.num_batches:
+            yield self.batch(i)
+            i += 1

@@ -1,0 +1,189 @@
+"""Wide & Deep recommender (BASELINE.json:11) — embedding-parallel, TPU-first.
+
+Reference analog (SURVEY.md §2a 'Model fns', §2c 'Embedding parallel'): a
+wide linear path over sparse crosses plus a deep MLP over embeddings, with
+the big tables living on parameter servers as sparse variables
+(round-robin via device_setter.py:147-149; sparse sync gradients through
+SparseConditionalAccumulator, data_flow_ops.py:1478). The substrate's TPU
+answer is TPUEmbedding ($TF/python/tpu/tpu_embedding_v2.py:76).
+
+TPU-first choices:
+
+- **Tables sharded by layout**: each categorical feature's [V, D] table is
+  a plain flax param; ``embedding_rules()`` vocab-shards it over the
+  ``model`` axis (P('model', None)) and GSPMD turns ``jnp.take`` into the
+  gather + collective exchange — zero model code knows about placement
+  (same design as transformer.py TP).
+- **Explicit-collective option**: ``embed_impl='explicit'`` routes lookups
+  through ops/embedding.py's mod-sharded shard_map path — the hand-written
+  exchange (gather + psum) for when GSPMD's choice needs overriding; parity
+  is tested against the take path.
+- **Dense gradients**: on TPU the IndexedSlices/sparse-accumulator
+  machinery disappears — table grads are dense scatter-adds inside the one
+  compiled step, aggregated by the same psum as every other grad.
+- **Wide weights folded into the tables**: each table is [V, D+1]; the last
+  column is the per-id wide (linear) weight, zero-init. One lookup per
+  feature serves both paths — half the model-axis exchanges of separate
+  wide tables (the `tf.feature_column` linear path without the vocabulary
+  plumbing, fused).
+
+Batch contract: {"cat": (B, F) int32, "dense": (B, Dd) float32,
+"label": (B,) float in {0,1}} — F categorical features, Dd dense features.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel import mesh as mesh_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class WideDeepConfig:
+    # multiples of 8 so vocab dims shard evenly over any test mesh axis
+    vocab_sizes: tuple[int, ...] = (1024, 1024, 512, 128, 64)
+    embed_dim: int = 32
+    dense_features: int = 13
+    hidden_sizes: tuple[int, ...] = (256, 128, 64)
+    dropout: float = 0.0
+    dtype: str = "bfloat16"
+    # "take": plain jnp.take, sharding by layout (GSPMD inserts comms).
+    # "explicit": ops/embedding.py mod-sharded shard_map lookup.
+    embed_impl: str = "take"
+
+
+def embedding_rules() -> list[tuple[str, P]]:
+    """Path rules: vocab-shard every table over `model`; MLP replicated
+    (recommender MLPs are small — DP/fsdp handles them)."""
+    return [(r"table_\d+", P(mesh_lib.MODEL, None))]
+
+
+class WideDeep(nn.Module):
+    cfg: WideDeepConfig
+    mesh: Any = None  # required only for embed_impl='explicit'
+
+    @nn.compact
+    def __call__(self, cat, dense, *, train: bool = False):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        n_feat = len(cfg.vocab_sizes)
+        assert cat.shape[-1] == n_feat, (cat.shape, n_feat)
+
+        def table_init(key, shape, dtype_):
+            # cols [:embed_dim] = deep embedding (normal); col [-1] = wide
+            # linear weight (zeros, like the reference's linear path)
+            v, d1 = shape
+            embed = nn.initializers.normal(
+                stddev=1.0 / jnp.sqrt(cfg.embed_dim)
+            )(key, (v, d1 - 1), dtype_)
+            return jnp.concatenate([embed, jnp.zeros((v, 1), dtype_)], axis=-1)
+
+        tables = [
+            self.param(f"table_{i}", table_init, (v, cfg.embed_dim + 1),
+                       jnp.float32)
+            for i, v in enumerate(cfg.vocab_sizes)
+        ]
+
+        lookup = self._make_lookup()
+        rows = [lookup(cat[..., i], t) for i, t in enumerate(tables)]
+        embeds = [r[..., : cfg.embed_dim].astype(dtype) for r in rows]
+        wide_logit = sum(r[..., cfg.embed_dim].astype(jnp.float32) for r in rows)
+        wide_logit = wide_logit + nn.Dense(
+            1, dtype=jnp.float32, name="wide_dense"
+        )(dense)[..., 0]
+
+        h = jnp.concatenate(embeds + [dense.astype(dtype)], axis=-1)
+        for j, width in enumerate(cfg.hidden_sizes):
+            h = nn.Dense(width, dtype=dtype, name=f"deep_{j}")(h)
+            h = nn.relu(h)
+            if cfg.dropout > 0:
+                h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
+        deep_logit = nn.Dense(1, dtype=jnp.float32, name="deep_out")(h)[..., 0]
+        return wide_logit + deep_logit
+
+    def _make_lookup(self):
+        if self.cfg.embed_impl == "take":
+            return lambda ids, table: jnp.take(table, ids, axis=0)
+        if self.cfg.embed_impl == "explicit":
+            from ..ops import embedding as emb
+
+            if self.mesh is None or self.mesh.shape[mesh_lib.MODEL] == 1:
+                # degrade gracefully: mod-sharding over a size-1 axis is take
+                return lambda ids, table: jnp.take(table, ids, axis=0)
+
+            # Table params are laid out P(model, None) by embedding_rules —
+            # range sharding — which the range kernel consumes with zero
+            # re-layout.
+            return emb.make_range_sharded_lookup(self.mesh, mesh_lib.MODEL)
+        raise ValueError(f"Unknown embed_impl {self.cfg.embed_impl!r}")
+
+
+def make_init_fn(cfg: WideDeepConfig, mesh=None):
+    # Init twin with the plain-take lookup: param shapes are impl-independent,
+    # and the twin avoids tracing shard_map with the size-1 dummy batch
+    # (same trick as transformer.make_init_fn).
+    del mesh
+    model = WideDeep(dataclasses.replace(cfg, embed_impl="take"))
+
+    def init_fn(rng):
+        cat = jnp.zeros((1, len(cfg.vocab_sizes)), jnp.int32)
+        dense = jnp.zeros((1, cfg.dense_features), jnp.float32)
+        variables = model.init({"params": rng, "dropout": rng}, cat, dense)
+        variables = dict(variables)
+        return variables.pop("params"), variables
+
+    return init_fn
+
+
+def ctr_loss_fn(model: WideDeep):
+    """Binary cross-entropy on click logits + AUC-proxy accuracy."""
+
+    def loss_fn(params, model_state, batch, rng):
+        logits = model.apply(
+            {"params": params, **model_state},
+            batch["cat"], batch["dense"], train=True, rngs={"dropout": rng},
+        )
+        labels = batch["label"].astype(jnp.float32)
+        loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+        acc = jnp.mean(((logits > 0) == (labels > 0.5)).astype(jnp.float32))
+        return loss, (model_state, {"accuracy": acc})
+
+    return loss_fn
+
+
+def ctr_eval_fn(model: WideDeep):
+    def eval_fn(params, model_state, batch):
+        logits = model.apply(
+            {"params": params, **model_state}, batch["cat"], batch["dense"]
+        )
+        labels = batch["label"].astype(jnp.float32)
+        loss = optax.sigmoid_binary_cross_entropy(logits, labels).sum()
+        correct = jnp.sum(((logits > 0) == (labels > 0.5)).astype(jnp.float32))
+        return {
+            "loss_sum": loss,
+            "correct": correct,
+            "count": jnp.asarray(labels.shape[0], jnp.float32),
+        }
+
+    return eval_fn
+
+
+def flops_per_example(cfg: WideDeepConfig) -> float:
+    """Analytic fwd+bwd FLOPs (MFU accounting, SURVEY.md §5.5). Embedding
+    gathers are bandwidth, not FLOPs; count the MLP matmuls ×3 for bwd."""
+    d_in = len(cfg.vocab_sizes) * cfg.embed_dim + cfg.dense_features
+    flops = 0.0
+    prev = d_in
+    for w in cfg.hidden_sizes:
+        flops += 2.0 * prev * w
+        prev = w
+    flops += 2.0 * prev  # deep_out
+    flops += 2.0 * cfg.dense_features  # wide_dense
+    return 3.0 * flops

@@ -1,0 +1,56 @@
+"""Workload 5 — Wide&Deep CTR, embedding-parallel (BASELINE.json:11).
+
+Reference shape (SURVEY.md §2a/§2c): wide linear + deep MLP with the
+embedding tables as sparse PS variables. Here tables are vocab-sharded over
+the `model` mesh axis (models/wide_deep.py, ops/embedding.py) and the batch
+rides (data, fsdp) — the SURVEY.md §7 M9 milestone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..data import DataConfig
+from ..data.recsys import RecsysConfig, SyntheticCTR
+from ..models import wide_deep as wd
+from ..parallel import MeshSpec
+from ..train import OptimizerConfig
+from .runner import RunConfig, TrainSection, WorkloadParts
+
+
+def default_config() -> RunConfig:
+    model = wd.WideDeepConfig()
+    return RunConfig(
+        workload="wide_deep",
+        model=model,
+        # embedding-parallel over `model`, DP over the rest
+        mesh=MeshSpec(data=-1, model=2),
+        data=DataConfig(dataset="synthetic_ctr", global_batch_size=256),
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        train=TrainSection(num_steps=500, log_every=50),
+    )
+
+
+def _recsys_cfg(cfg: RunConfig) -> RecsysConfig:
+    return RecsysConfig(
+        vocab_sizes=tuple(cfg.model.vocab_sizes),
+        dense_features=cfg.model.dense_features,
+        global_batch_size=cfg.data.global_batch_size,
+        seed=cfg.data.seed,
+    )
+
+
+def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
+    model = wd.WideDeep(cfg.model, mesh)
+    rcfg = _recsys_cfg(cfg)
+    return WorkloadParts(
+        init_fn=wd.make_init_fn(cfg.model, mesh),
+        loss_fn=wd.ctr_loss_fn(model),
+        eval_fn=wd.ctr_eval_fn(model),
+        dataset_fn=lambda start: SyntheticCTR(rcfg, index_offset=start),
+        eval_dataset_fn=lambda n: SyntheticCTR(rcfg, n, index_offset=10**6),
+        flops_per_step=wd.flops_per_example(cfg.model)
+        * cfg.data.global_batch_size,
+        param_rules=wd.embedding_rules(),
+        batch_size=cfg.data.global_batch_size,
+    )

@@ -1,0 +1,115 @@
+"""Wide&Deep (M9): forward parity take-vs-explicit, sharded training
+convergence, workload end-to-end (SURVEY.md §7 M9, BASELINE.json:11)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.recsys import RecsysConfig, SyntheticCTR
+from distributed_tensorflow_tpu.models import wide_deep as wd
+from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh
+
+CFG = wd.WideDeepConfig(
+    vocab_sizes=(64, 32, 16),
+    embed_dim=8,
+    dense_features=4,
+    hidden_sizes=(32, 16),
+    dtype="float32",
+)
+
+
+@pytest.fixture()
+def mesh_tp4(devices):
+    return build_mesh(MeshSpec(data=2, model=4), devices[:8])
+
+
+def _batch(seed=0, b=16, cfg=CFG):
+    rng = np.random.RandomState(seed)
+    return {
+        "cat": np.stack(
+            [rng.randint(0, v, b) for v in cfg.vocab_sizes], -1
+        ).astype(np.int32),
+        "dense": rng.randn(b, cfg.dense_features).astype(np.float32),
+        "label": rng.randint(0, 2, b).astype(np.float32),
+    }
+
+
+def test_forward_shape_and_finite():
+    model = wd.WideDeep(CFG)
+    params, _ = wd.make_init_fn(CFG)(jax.random.PRNGKey(0))
+    b = _batch()
+    logits = model.apply({"params": params}, b["cat"], b["dense"])
+    assert logits.shape == (16,)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_explicit_lookup_matches_take(mesh_tp4):
+    b = _batch(1)
+    params, _ = wd.make_init_fn(CFG)(jax.random.PRNGKey(0))
+    dense_model = wd.WideDeep(CFG)
+    expl_cfg = wd.WideDeepConfig(**{
+        **CFG.__dict__, "embed_impl": "explicit"
+    })
+    expl_model = wd.WideDeep(expl_cfg, mesh_tp4)
+
+    want = dense_model.apply({"params": params}, b["cat"], b["dense"])
+    got = jax.jit(
+        lambda p, c, d: expl_model.apply({"params": p}, c, d)
+    )(params, b["cat"], b["dense"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    # backward parity: table gradients through the explicit exchange
+    def loss(model):
+        return lambda p: model.apply(
+            {"params": p}, b["cat"], b["dense"]
+        ).sum()
+
+    g_take = jax.grad(loss(dense_model))(params)
+    g_expl = jax.jit(jax.grad(loss(expl_model)))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        g_expl, g_take,
+    )
+
+
+@pytest.mark.parametrize("impl", ["take", "explicit"])
+def test_workload_trains_and_evals(mesh_tp4, tmp_path, impl):
+    from distributed_tensorflow_tpu.workloads import run_workload
+
+    res = run_workload(
+        "wide_deep",
+        overrides=[
+            f"model.embed_impl={impl}",
+            "model.vocab_sizes=[64,32,16]",
+            "model.embed_dim=8",
+            "model.dense_features=4",
+            "model.hidden_sizes=[32,16]",
+            "model.dtype=float32",
+            "mesh.data=2",
+            "mesh.model=4",
+            "data.global_batch_size=64",
+            "train.num_steps=60",
+            "train.log_every=20",
+            "optimizer.learning_rate=0.01",
+        ],
+    )
+    first = res.history[0]["loss"]
+    last = res.history[-1]["loss"]
+    assert last < first, (first, last)
+    assert res.eval_metrics["accuracy"] > 0.6, res.eval_metrics
+
+
+def test_ctr_dataset_deterministic_and_skewed():
+    cfg = RecsysConfig(vocab_sizes=(64, 32), dense_features=4,
+                       global_batch_size=32)
+    a = SyntheticCTR(cfg).batch(3)
+    b = SyntheticCTR(cfg).batch(3)
+    np.testing.assert_array_equal(a["cat"], b["cat"])
+    # zipf skew: hot ids are 0 (head) and v-1 (clipped tail)
+    big = np.concatenate([SyntheticCTR(cfg).batch(i)["cat"][:, 0]
+                          for i in range(20)])
+    assert np.bincount(big).argmax() in (0, cfg.vocab_sizes[0] - 1)
+    assert set(np.unique(a["label"])) <= {0.0, 1.0}
