@@ -32,6 +32,15 @@ class DataConfig:
     flat: bool = False  # emit (N, H*W*C) instead of (N, H, W, C)
 
 
+def batch_rng(seed: int, index: int) -> np.random.RandomState:
+    """Per-batch, per-host RandomState: deterministic in (seed, index) and
+    disjoint across hosts (process_index folded in). The single definition
+    of the stream-seeding scheme — every synthetic dataset uses it, so a
+    change to host-disjointness lands everywhere at once."""
+    s = (seed * 1_000_003 + index) * 97 + jax.process_index()
+    return np.random.RandomState(s & 0x7FFFFFFF)
+
+
 def local_batch_size(global_batch_size: int) -> int:
     n = jax.process_count()
     if global_batch_size % n != 0:
@@ -62,8 +71,7 @@ class SyntheticClassification:
 
     def batch(self, index: int) -> dict[str, np.ndarray]:
         index += self.index_offset
-        seed = (self.cfg.seed * 1_000_003 + index) * 97 + jax.process_index()
-        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        rng = batch_rng(self.cfg.seed, index)
         cfg = self.cfg
         shape = (
             (self.local_bs, cfg.image_size * cfg.image_size * cfg.channels)

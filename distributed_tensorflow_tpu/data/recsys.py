@@ -12,10 +12,9 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterator
 
-import jax
 import numpy as np
 
-from .pipeline import local_batch_size
+from .pipeline import batch_rng, local_batch_size
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,8 +45,7 @@ class SyntheticCTR:
 
     def batch(self, index: int) -> dict[str, np.ndarray]:
         index += self.index_offset
-        seed = (self.cfg.seed * 1_000_003 + index) * 97 + jax.process_index()
-        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        rng = batch_rng(self.cfg.seed, index)
         cfg = self.cfg
         b = self.local_bs
         cat = np.stack(

@@ -14,7 +14,7 @@ from typing import Iterator
 
 import numpy as np
 
-from .pipeline import local_batch_size
+from .pipeline import batch_rng, local_batch_size
 
 MASK_FRACTION_KEEP = 0.1  # BERT 80/10/10 corruption split
 MASK_FRACTION_RANDOM = 0.1
@@ -60,12 +60,9 @@ class SyntheticMLM:
         return seq[:, : cfg.seq_len]
 
     def batch(self, index: int) -> dict[str, np.ndarray]:
-        import jax
-
         cfg = self.cfg
         index += self.index_offset
-        seed = (cfg.seed * 1_000_003 + index) * 97 + jax.process_index()
-        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        rng = batch_rng(cfg.seed, index)
         tokens = self._tokens(rng)
 
         masked = rng.rand(*tokens.shape) < cfg.mask_prob
@@ -106,12 +103,9 @@ class SyntheticLM:
         self.perm = rng.permutation(cfg.vocab_size)
 
     def batch(self, index: int) -> dict[str, np.ndarray]:
-        import jax
-
         cfg = self.cfg
         index += self.index_offset
-        seed = (cfg.seed * 1_000_003 + index) * 97 + jax.process_index()
-        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        rng = batch_rng(cfg.seed, index)
         seq = np.empty((self.local_bs, cfg.seq_len), np.int64)
         seq[:, 0] = rng.randint(0, cfg.vocab_size, self.local_bs)
         for i in range(1, cfg.seq_len):

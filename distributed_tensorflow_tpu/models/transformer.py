@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 from ..data.text import IGNORE_INDEX  # single sentinel shared with the data layer
 from ..ops.attention import attention_reference, blockwise_attention
 from ..ops.flash_attention import flash_attention
+from ..ops.moe import collect_aux_loss
 from ..parallel import mesh as mesh_lib
 from ..parallel.ring_attention import sequence_parallel_attention
 from ..utils import flops as flops_lib
@@ -56,6 +57,13 @@ class TransformerConfig:
     # None = no sequence parallelism; "ring"|"ulysses"|"allgather" engage
     # when the model is built with a mesh whose seq axis > 1.
     seq_impl: str | None = None
+    # MoE: 0 = dense FFN everywhere; >0 = every `moe_every`-th block swaps
+    # its FFN for a MoEMLP with this many experts (ops/moe.py; expert dim
+    # shards over the `expert` mesh axis via moe_rules()).
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -96,7 +104,11 @@ TP_PATH_RULES = (
 
 
 def tp_rules():
-    return TP_PATH_RULES
+    from ..ops.moe import moe_rules
+
+    # MoE rules first: "moe/w_in" must not fall through to the dense
+    # "mlp_in" patterns (first-match-wins in specs_from_path_rules)
+    return tuple(moe_rules()) + TP_PATH_RULES
 
 
 # ---------------------------------------------------------------------------
@@ -169,6 +181,7 @@ class SelfAttention(nn.Module):
 class Block(nn.Module):
     cfg: TransformerConfig
     mesh: Any = None
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, mask, *, train: bool):
@@ -177,13 +190,30 @@ class Block(nn.Module):
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
         attn = SelfAttention(cfg, self.mesh, name="attn")
 
-        def mlp(h):
-            h = nn.Dense(cfg.d_ff, dtype=dtype, name="mlp_in",
-                         kernel_init=nn.initializers.normal(0.02))(h)
-            h = nn.gelu(h)
-            h = nn.Dense(cfg.d_model, dtype=dtype, name="mlp_out",
-                         kernel_init=nn.initializers.normal(0.02))(h)
-            return nn.Dropout(cfg.dropout, deterministic=not train)(h)
+        if self.use_moe:
+            from ..ops.moe import MoEConfig, MoEMLP
+
+            moe = MoEMLP(
+                MoEConfig(
+                    num_experts=cfg.num_experts, d_model=cfg.d_model,
+                    d_ff=cfg.d_ff, top_k=cfg.moe_top_k,
+                    capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+                ),
+                name="moe",
+            )
+
+            def mlp(h):
+                h = moe(h, train=train)
+                return nn.Dropout(cfg.dropout, deterministic=not train)(h)
+        else:
+
+            def mlp(h):
+                h = nn.Dense(cfg.d_ff, dtype=dtype, name="mlp_in",
+                             kernel_init=nn.initializers.normal(0.02))(h)
+                h = nn.gelu(h)
+                h = nn.Dense(cfg.d_model, dtype=dtype, name="mlp_out",
+                             kernel_init=nn.initializers.normal(0.02))(h)
+                return nn.Dropout(cfg.dropout, deterministic=not train)(h)
 
         if cfg.pre_ln:
             x = x + attn(ln("ln1")(x).astype(dtype), mask, train=train)
@@ -221,7 +251,12 @@ class Transformer(nn.Module):
 
         mask = attention_mask.astype(bool) if attention_mask is not None else None
         for i in range(cfg.num_layers):
-            x = Block(cfg, self.mesh, name=f"layer_{i}")(x, mask, train=train)
+            use_moe = (
+                cfg.num_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
+            )
+            x = Block(cfg, self.mesh, use_moe, name=f"layer_{i}")(
+                x, mask, train=train
+            )
         if cfg.pre_ln:
             x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x).astype(dtype)
 
@@ -263,11 +298,13 @@ def mlm_loss_fn(model: Transformer):
     IGNORE_INDEX on unmasked positions, optional "attention_mask" [B,S]}."""
 
     def loss_fn(params, model_state, batch, rng):
-        logits = model.apply(
+        logits, mut = model.apply(
             {"params": params}, batch["input_ids"],
             batch.get("attention_mask"), train=True, rngs={"dropout": rng},
+            mutable=["losses"],
         )
         loss, acc = _masked_xent(logits, batch["labels"])
+        loss = loss + collect_aux_loss(mut)  # MoE router load-balance
         return loss, (model_state, {"accuracy": acc})
 
     return loss_fn
@@ -279,9 +316,9 @@ def lm_loss_fn(model: Transformer):
 
     def loss_fn(params, model_state, batch, rng):
         ids = batch["input_ids"]
-        logits = model.apply(
+        logits, mut = model.apply(
             {"params": params}, ids, batch.get("attention_mask"),
-            train=True, rngs={"dropout": rng},
+            train=True, rngs={"dropout": rng}, mutable=["losses"],
         )
         labels = jnp.concatenate(
             [ids[:, 1:], jnp.full_like(ids[:, :1], IGNORE_INDEX)], axis=1
@@ -295,6 +332,7 @@ def lm_loss_fn(model: Transformer):
             )
             labels = jnp.where(label_valid, labels, IGNORE_INDEX)
         loss, acc = _masked_xent(logits, labels)
+        loss = loss + collect_aux_loss(mut)  # MoE router load-balance
         return loss, (model_state, {"accuracy": acc})
 
     return loss_fn
@@ -322,20 +360,59 @@ def make_init_fn(model: Transformer, seq_len: int):
     return init_fn
 
 
+def _block_counts(cfg: TransformerConfig) -> tuple[int, int]:
+    """(number of dense-FFN blocks, number of MoE blocks)."""
+    if cfg.num_experts <= 0:
+        return cfg.num_layers, 0
+    n_moe = sum(
+        1 for i in range(cfg.num_layers)
+        if i % cfg.moe_every == cfg.moe_every - 1
+    )
+    return cfg.num_layers - n_moe, n_moe
+
+
+def _ffn_params(cfg: TransformerConfig, experts: int) -> int:
+    """FFN params per block with ``experts`` expert copies (1 = dense)."""
+    d, f = cfg.d_model, cfg.d_ff
+    ffn = experts * (2 * d * f + f + d)
+    if experts > 1:
+        ffn += d * cfg.num_experts + cfg.num_experts  # router
+    return ffn
+
+
 def param_count(cfg: TransformerConfig) -> int:
-    """Analytic parameter count (embeddings + blocks + heads)."""
+    """Analytic parameter count (embeddings + blocks + heads + experts)."""
     d, L = cfg.d_model, cfg.num_layers
     embed = cfg.vocab_size * d + cfg.max_len * d
     embed += 2 * d  # embed_ln (post-LN) or final_ln (pre-LN)
-    per_block = 4 * d * d + 2 * d * cfg.d_ff  # qkv+out, mlp in/out kernels
-    per_block += 4 * d + cfg.d_ff + d + 4 * d  # biases + 2 LN
+    attn = 4 * d * d + 4 * d  # qkv+out kernels + biases
+    ln = 4 * d  # 2 LayerNorms
     head = 0 if cfg.causal else d * d + 3 * d
-    return embed + L * per_block + head + cfg.vocab_size
+    n_dense, n_moe = _block_counts(cfg)
+    blocks = (
+        L * (attn + ln)
+        + n_dense * _ffn_params(cfg, 1)
+        + n_moe * _ffn_params(cfg, cfg.num_experts)
+    )
+    return embed + blocks + head + cfg.vocab_size
+
+
+def active_param_count(cfg: TransformerConfig) -> int:
+    """Params touched per token: MoE blocks engage only top_k experts —
+    this is the N that enters the 2N FLOPs/token estimate."""
+    if cfg.num_experts <= 0:
+        return param_count(cfg)
+    _, n_moe = _block_counts(cfg)
+    d, f = cfg.d_model, cfg.d_ff
+    idle_experts = cfg.num_experts - cfg.moe_top_k
+    return param_count(cfg) - n_moe * idle_experts * (2 * d * f + f + d)
 
 
 def flops_per_example(cfg: TransformerConfig, seq_len: int) -> float:
     """Forward FLOPs per example at ``seq_len`` (×3 for training in the
-    engine's MFU accounting, utils/flops.py train_flops_multiplier)."""
+    engine's MFU accounting, utils/flops.py train_flops_multiplier).
+    Uses *active* params so MoE MFU accounting stays honest (SURVEY.md §7
+    'MFU accounting honesty')."""
     return seq_len * flops_lib.transformer_flops_per_token(
-        param_count(cfg), seq_len, cfg.num_layers, cfg.d_model
+        active_param_count(cfg), seq_len, cfg.num_layers, cfg.d_model
     )

@@ -15,9 +15,11 @@ TPU-first choices:
   gather + collective exchange — zero model code knows about placement
   (same design as transformer.py TP).
 - **Explicit-collective option**: ``embed_impl='explicit'`` routes lookups
-  through ops/embedding.py's mod-sharded shard_map path — the hand-written
-  exchange (gather + psum) for when GSPMD's choice needs overriding; parity
-  is tested against the take path.
+  through ops/embedding.py's *range*-sharded shard_map path — the
+  hand-written exchange (owned-gather + psum) over the same P('model',
+  None) layout GSPMD gives the param, so no re-layout; parity is tested
+  against the take path. (The mod-sharded variant for hot-id balancing
+  lives in ops/embedding.py too, with its own layout.)
 - **Dense gradients**: on TPU the IndexedSlices/sparse-accumulator
   machinery disappears — table grads are dense scatter-adds inside the one
   compiled step, aggregated by the same psum as every other grad.
@@ -55,7 +57,7 @@ class WideDeepConfig:
     dropout: float = 0.0
     dtype: str = "bfloat16"
     # "take": plain jnp.take, sharding by layout (GSPMD inserts comms).
-    # "explicit": ops/embedding.py mod-sharded shard_map lookup.
+    # "explicit": ops/embedding.py range-sharded shard_map lookup.
     embed_impl: str = "take"
 
 

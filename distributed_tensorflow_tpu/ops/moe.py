@@ -1,0 +1,178 @@
+"""Mixture-of-Experts layer — expert parallelism over the ``expert`` mesh axis.
+
+The reference has no MoE (SURVEY.md §2c marks EP as a new-framework
+capability on the same collective substrate as Ulysses: `lax.all_to_all`
+token dispatch over an `expert` mesh axis). TPU-first design:
+
+- **Dispatch by einsum, not gather**: tokens are routed with one-hot
+  dispatch/combine tensors contracted by einsums (the Mesh-TensorFlow /
+  Switch-Transformer pattern). Static shapes — capacity-bounded expert
+  buffers — so XLA can tile the expert FFNs on the MXU, and with the expert
+  dimension sharded over the ``expert`` axis GSPMD lowers the dispatch
+  einsum to exactly the all_to_all exchange of a hand-written EP backend.
+- **Capacity + drop**: each expert processes at most
+  ``ceil(top_k · T · capacity_factor / E)`` tokens per batch; overflow
+  tokens are dropped (residual connection carries them) — lockstep SPMD
+  needs shape-static buffers, the TPU analog of the reference's unbounded
+  PS queues.
+- **Router in f32**: routing logits/softmax stay f32 (bf16 elsewhere), the
+  same precision split as attention softmax.
+- **Load-balance aux loss** (Switch §2.2): E · Σ_e f_e · p̄_e, sown into
+  the ``losses`` collection so loss adapters can pick it up without
+  threading it through every return value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel import mesh as mesh_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    d_model: int = 512
+    d_ff: int = 2048
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+    dtype: str = "bfloat16"
+
+
+def moe_rules() -> list[tuple[str, P]]:
+    """Path rules: expert dim over `expert`, FFN hidden dim over `model`
+    (EP × TP compose); router stays replicated."""
+    return [
+        (r"moe/w_in", P(mesh_lib.EXPERT, None, mesh_lib.MODEL)),
+        (r"moe/b_in", P(mesh_lib.EXPERT, mesh_lib.MODEL)),
+        (r"moe/w_out", P(mesh_lib.EXPERT, mesh_lib.MODEL, None)),
+        (r"moe/b_out", P(mesh_lib.EXPERT, None)),
+    ]
+
+
+def expert_capacity(num_tokens: int, cfg: MoEConfig) -> int:
+    return max(
+        1,
+        -(-int(cfg.top_k * num_tokens * cfg.capacity_factor) // cfg.num_experts),
+    )
+
+
+def top_k_routing(probs: jax.Array, capacity: int, top_k: int):
+    """probs [T, E] → (dispatch [T, E, C] 0/1, combine [T, E, C] weights,
+    aux_loss scalar). Greedy per-slot routing: slot j sends each token to
+    its j-th choice expert if that expert still has capacity (position =
+    running count of tokens already routed there, across slots)."""
+    T, E = probs.shape
+    remaining = probs
+    fill = jnp.zeros((E,), jnp.int32)  # tokens assigned per expert so far
+    dispatch = jnp.zeros((T, E, capacity), probs.dtype)
+    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    for _ in range(top_k):
+        choice = jnp.argmax(remaining, axis=-1)  # [T]
+        onehot = jax.nn.one_hot(choice, E, dtype=probs.dtype)  # [T, E]
+        # position of each token in its chosen expert's buffer
+        pos = fill[None, :] + (jnp.cumsum(onehot, axis=0) - onehot).astype(
+            jnp.int32
+        )
+        keep = (pos < capacity).astype(probs.dtype) * onehot
+        pos_oh = jax.nn.one_hot(
+            jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), capacity,
+            dtype=probs.dtype,
+        )
+        d = keep[:, :, None] * pos_oh[:, None, :]
+        dispatch = dispatch + d
+        gate = jnp.sum(probs * onehot, axis=-1)  # [T]
+        combine = combine + gate[:, None, None] * d
+        fill = fill + jnp.sum(keep, axis=0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+    # renormalize combine over the chosen experts (top-k gates sum to 1)
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    # Switch load-balance loss on first-choice statistics
+    first = jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=probs.dtype)
+    frac_tokens = first.mean(axis=0)
+    mean_probs = probs.mean(axis=0)
+    aux = E * jnp.sum(frac_tokens * mean_probs)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for a transformer FFN block: [B, S, D] → [B, S, D].
+
+    Expert weights live as [E, ...] arrays; `moe_rules()` shards the E dim
+    over the `expert` mesh axis, so the dispatch/combine einsums become
+    all_to_all exchanges under GSPMD."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        B, S, D = x.shape
+        assert D == cfg.d_model, (D, cfg.d_model)
+        T = B * S
+        tokens = x.reshape(T, D)
+
+        logits = nn.Dense(
+            cfg.num_experts, dtype=jnp.float32, name="router",
+            kernel_init=nn.initializers.normal(0.02),
+        )(tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        C = expert_capacity(T, cfg)
+        dispatch, combine, aux = top_k_routing(probs, C, cfg.top_k)
+        self.sow(
+            "losses", "moe_aux", cfg.router_aux_weight * aux,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+            reduce_fn=lambda a, b: a + b,
+        )
+
+        w_in = self.param(
+            "w_in", nn.initializers.normal(0.02),
+            (cfg.num_experts, D, cfg.d_ff), jnp.float32,
+        )
+        b_in = self.param(
+            "b_in", nn.initializers.zeros, (cfg.num_experts, cfg.d_ff),
+            jnp.float32,
+        )
+        w_out = self.param(
+            "w_out", nn.initializers.normal(0.02),
+            (cfg.num_experts, cfg.d_ff, D), jnp.float32,
+        )
+        b_out = self.param(
+            "b_out", nn.initializers.zeros, (cfg.num_experts, D), jnp.float32,
+        )
+
+        # dispatch: [T,E,C] × [T,D] → expert buffers [E,C,D]
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(dtype), tokens.astype(dtype)
+        )
+        h = jnp.einsum("ecd,edf->ecf", expert_in, w_in.astype(dtype))
+        h = nn.gelu(h + b_in[:, None, :].astype(dtype))
+        out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(dtype))
+        out = out + b_out[:, None, :].astype(dtype)
+        # combine: [T,E,C] × [E,C,D] → [T,D]; dropped tokens get zeros
+        y = jnp.einsum("tec,ecd->td", combine.astype(dtype), out)
+        return y.reshape(B, S, D)
+
+
+def collect_aux_loss(variables: Any) -> jax.Array:
+    """Sum every sown `losses` entry (zero if none) — call on the mutated
+    collections returned by ``model.apply(..., mutable=['losses'])``."""
+    losses = variables.get("losses", {}) if isinstance(variables, dict) else {}
+    leaves = jax.tree.leaves(losses)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(jnp.sum(l) for l in leaves)
+
+
+def flops_per_token(cfg: MoEConfig) -> float:
+    """Fwd FLOPs per token: top_k experts' FFN matmuls (router negligible)."""
+    return cfg.top_k * 2.0 * 2.0 * cfg.d_model * cfg.d_ff

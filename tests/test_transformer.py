@@ -226,3 +226,14 @@ def test_param_count_matches_analytic():
     params, _ = tfm.make_init_fn(model, 16)(jax.random.PRNGKey(0))
     actual = sum(p.size for p in jax.tree.leaves(params))
     assert actual == tfm.param_count(cfg)
+
+
+def test_param_count_matches_analytic_moe():
+    cfg = tiny_cfg(num_layers=4, num_experts=4, moe_every=2, moe_top_k=2)
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 16)(jax.random.PRNGKey(0))
+    actual = sum(p.size for p in jax.tree.leaves(params))
+    assert actual == tfm.param_count(cfg)
+    # active params: only top_k of num_experts FFNs per MoE block
+    assert tfm.active_param_count(cfg) < tfm.param_count(cfg)
+    assert tfm.active_param_count(tiny_cfg()) == tfm.param_count(tiny_cfg())
