@@ -139,6 +139,16 @@ def make_dataset(cfg: DataConfig, num_batches: int | None = None,
     if cfg.dataset.startswith("npz:"):
         return NpzDataset(cfg.dataset[4:], cfg, num_batches=num_batches,
                           index_offset=index_offset)
+    if cfg.dataset.startswith("records:"):
+        from .records import RecordClassificationDataset
+
+        return RecordClassificationDataset(
+            cfg.dataset[len("records:"):],
+            (cfg.image_size, cfg.image_size, cfg.channels),
+            cfg.global_batch_size, seed=cfg.seed,
+            num_batches=num_batches, index_offset=index_offset,
+            flat=cfg.flat,
+        )
     raise ValueError(f"Unknown dataset '{cfg.dataset}'")
 
 

@@ -44,6 +44,10 @@ class MoEConfig:
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.01
     dtype: str = "bfloat16"
+    # Tokens are routed within fixed-size groups (the Mesh-TF/Switch group
+    # dimension): capacity is per-group, so dispatch/combine memory is
+    # O(T·group) instead of O(T²). 0 = auto (largest divisor of T ≤ 1024).
+    group_size: int = 0
 
 
 def moe_rules() -> list[tuple[str, P]]:
@@ -62,6 +66,20 @@ def expert_capacity(num_tokens: int, cfg: MoEConfig) -> int:
         1,
         -(-int(cfg.top_k * num_tokens * cfg.capacity_factor) // cfg.num_experts),
     )
+
+
+def resolve_group_size(num_tokens: int, cfg: MoEConfig) -> int:
+    """Routing-group size: must divide T. Auto = largest divisor ≤ 1024."""
+    if cfg.group_size > 0:
+        if num_tokens % cfg.group_size != 0:
+            raise ValueError(
+                f"group_size={cfg.group_size} must divide tokens={num_tokens}"
+            )
+        return cfg.group_size
+    g = min(num_tokens, 1024)
+    while num_tokens % g != 0:
+        g -= 1
+    return g
 
 
 def top_k_routing(probs: jax.Array, capacity: int, top_k: int):
@@ -126,8 +144,16 @@ class MoEMLP(nn.Module):
             kernel_init=nn.initializers.normal(0.02),
         )(tokens.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)
-        C = expert_capacity(T, cfg)
-        dispatch, combine, aux = top_k_routing(probs, C, cfg.top_k)
+        # group the token axis: capacity (and the [g, E, C] one-hots) are
+        # per-group, so memory is linear in T, not quadratic
+        G = resolve_group_size(T, cfg)
+        n_groups = T // G
+        probs_g = probs.reshape(n_groups, G, cfg.num_experts)
+        C = expert_capacity(G, cfg)
+        dispatch, combine, aux = jax.vmap(
+            lambda p: top_k_routing(p, C, cfg.top_k)
+        )(probs_g)  # [n, G, E, C] ×2, aux [n]
+        aux = aux.mean()
         self.sow(
             "losses", "moe_aux", cfg.router_aux_weight * aux,
             init_fn=lambda: jnp.zeros((), jnp.float32),
@@ -150,16 +176,16 @@ class MoEMLP(nn.Module):
             "b_out", nn.initializers.zeros, (cfg.num_experts, D), jnp.float32,
         )
 
-        # dispatch: [T,E,C] × [T,D] → expert buffers [E,C,D]
-        expert_in = jnp.einsum(
-            "tec,td->ecd", dispatch.astype(dtype), tokens.astype(dtype)
-        )
-        h = jnp.einsum("ecd,edf->ecf", expert_in, w_in.astype(dtype))
-        h = nn.gelu(h + b_in[:, None, :].astype(dtype))
-        out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(dtype))
-        out = out + b_out[:, None, :].astype(dtype)
-        # combine: [T,E,C] × [E,C,D] → [T,D]; dropped tokens get zeros
-        y = jnp.einsum("tec,ecd->td", combine.astype(dtype), out)
+        # dispatch: [n,G,E,C] × [n,G,D] → expert buffers [n,E,C,D]
+        tokens_g = tokens.reshape(n_groups, G, D).astype(dtype)
+        expert_in = jnp.einsum("ngec,ngd->necd", dispatch.astype(dtype),
+                               tokens_g)
+        h = jnp.einsum("necd,edf->necf", expert_in, w_in.astype(dtype))
+        h = nn.gelu(h + b_in[None, :, None, :].astype(dtype))
+        out = jnp.einsum("necf,efd->necd", h, w_out.astype(dtype))
+        out = out + b_out[None, :, None, :].astype(dtype)
+        # combine: [n,G,E,C] × [n,E,C,D] → [n,G,D]; dropped tokens get zeros
+        y = jnp.einsum("ngec,necd->ngd", combine.astype(dtype), out)
         return y.reshape(B, S, D)
 
 

@@ -1,0 +1,13 @@
+"""Native host runtime bindings (C++ core in native/dtf_runtime.cpp).
+
+The reference's host data plane was C++ behind Python wrappers (SURVEY.md
+§2b: FIFOQueue/accumulator kernels, QueueRunner, Saver IO kernels). This
+package is the TPU-native equivalent: a compiled record loader and
+checksummed checkpoint IO, bound via ctypes (no pybind11 in the image),
+with bit-identical pure-Python fallbacks so nothing hard-depends on a
+toolchain at run time.
+"""
+
+from .native import available, load_library  # noqa: F401
+from .loader import RecordFileLoader, epoch_permutation  # noqa: F401
+from .io import read_payload, write_payload  # noqa: F401

@@ -1,0 +1,73 @@
+"""Checksummed atomic payload IO — the Saver-IO-kernel analog.
+
+Format (shared with native/dtf_runtime.cpp): payload bytes followed by a
+20-byte trailer [magic "DTFCKPT1"][u64 LE length][u32 LE zlib-CRC32].
+Writes go to <path>.tmp then fsync + rename, so a crash mid-write never
+clobbers an existing good shard (the reference Saver's discipline,
+$TF/python/training/saver.py:642 → C++ IO kernels). Native C++ path when
+the library is built; byte-identical Python fallback otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+import numpy as np
+
+from . import native
+
+_MAGIC = b"DTFCKPT1"
+
+
+def write_payload(path: str, data: bytes | np.ndarray) -> None:
+    buf = np.ascontiguousarray(
+        np.frombuffer(data, np.uint8) if isinstance(data, bytes)
+        else data.view(np.uint8).reshape(-1)
+    )
+    lib = native.load_library()
+    if lib is not None:
+        rc = lib.dtf_write_file(
+            path.encode(), buf.ctypes.data, buf.size
+        )
+        if rc != 0:
+            raise OSError(f"native write to {path} failed (rc={rc})")
+        return
+    tmp = path + ".tmp"
+    view = memoryview(buf)  # zero-copy: crc32 and write take buffers
+    trailer = _MAGIC + struct.pack("<QI", buf.size,
+                                   zlib.crc32(view) & 0xFFFFFFFF)
+    with open(tmp, "wb") as f:
+        f.write(view)
+        f.write(trailer)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_payload(path: str) -> bytes:
+    """Read + CRC-verify a payload; raises on truncation/corruption."""
+    lib = native.load_library()
+    if lib is not None:
+        size = lib.dtf_read_file(path.encode(), None, 0)
+        if size < 0:
+            raise OSError(f"{path}: invalid payload (rc={size})")
+        out = np.empty(size, np.uint8)
+        rc = lib.dtf_read_file(path.encode(), out.ctypes.data, size)
+        if rc == -3:
+            raise OSError(f"{path}: CRC mismatch (corrupt shard)")
+        if rc < 0:
+            raise OSError(f"{path}: read failed (rc={rc})")
+        return out.tobytes()
+    with open(path, "rb") as f:
+        raw = f.read()
+    if len(raw) < 20 or raw[-20:-12] != _MAGIC:
+        raise OSError(f"{path}: missing/invalid trailer")
+    length, crc = struct.unpack("<QI", raw[-12:])
+    payload = raw[:-20]
+    if length != len(payload):
+        raise OSError(f"{path}: length mismatch")
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise OSError(f"{path}: CRC mismatch (corrupt shard)")
+    return payload

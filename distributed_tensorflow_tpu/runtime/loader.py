@@ -1,0 +1,157 @@
+"""Record-file loader: native C++ pipeline with a bit-identical fallback.
+
+The host-side input pipeline tier beneath data/pipeline.Prefetcher — the
+native descendant of the reference's FIFOQueue + QueueRunner machinery
+($TF/python/ops/data_flow_ops.py:774; queue_runner_impl.py:34): worker
+threads assemble shuffled, shard-disjoint batches from an mmap'd file of
+fixed-size records and hand them over a bounded ordered queue.
+
+Format: a flat binary file of N records × ``record_bytes`` each; the
+caller supplies ``decode(raw_uint8_batch) -> batch dict`` (vectorized
+numpy — e.g. split image/label bytes and cast).
+
+Determinism: epoch e's order is Fisher–Yates under SplitMix64 with seed
+``seed + e`` — the same bits in C++ (native/dtf_runtime.cpp) and here, so
+the native and fallback paths produce identical streams, and resume at
+batch k is exact on either path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, Iterator
+
+import numpy as np
+
+from . import native
+
+_M64 = (1 << 64) - 1
+
+
+def _splitmix64(state: int) -> tuple[int, int]:
+    state = (state + 0x9E3779B97F4A7C15) & _M64
+    z = state
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M64
+    return state, (z ^ (z >> 31)) & _M64
+
+
+def epoch_permutation(n: int, seed: int) -> np.ndarray:
+    """Python mirror of the native Fisher–Yates (parity-tested)."""
+    out = np.arange(n, dtype=np.int64)
+    s = seed & _M64
+    for i in range(n - 1, 0, -1):
+        s, r = _splitmix64(s)
+        j = r % (i + 1)
+        out[i], out[j] = out[j], out[i]
+    return out
+
+
+class RecordFileLoader:
+    """Iterate batches of raw records as [batch_records, record_bytes]
+    uint8 arrays (decoded via ``decode`` if given).
+
+    ``shard``/``n_shards`` slice each epoch's shuffled order stride-wise
+    (disjoint across hosts — the `Dataset.shard` analog); ``start_batch``
+    fast-forwards for checkpoint resume.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        record_bytes: int,
+        batch_records: int,
+        *,
+        seed: int = 0,
+        shard: int = 0,
+        n_shards: int = 1,
+        n_threads: int = 4,
+        depth: int = 2,
+        decode: Callable[[np.ndarray], object] | None = None,
+        start_batch: int = 0,
+        num_batches: int | None = None,
+        use_native: bool | None = None,  # None = auto
+    ):
+        self.path = path
+        self.record_bytes = record_bytes
+        self.batch_records = batch_records
+        self.seed = seed
+        self.shard = shard
+        self.n_shards = n_shards
+        self.n_threads = n_threads
+        self.depth = depth
+        self.decode = decode
+        self.start_batch = start_batch
+        self.num_batches = num_batches
+        self.use_native = (
+            native.available() if use_native is None else use_native
+        )
+
+        # fallback path state (also used for metadata)
+        self._mm = np.memmap(path, dtype=np.uint8, mode="r")
+        self.n_records = self._mm.size // record_bytes
+        self.batches_per_epoch = (self.n_records // n_shards) // batch_records
+        if self.batches_per_epoch < 1:
+            raise ValueError(
+                f"{path}: {self.n_records} records can't fill one batch of "
+                f"{batch_records} over {n_shards} shard(s)"
+            )
+        self._perm_epoch = -1
+        self._perm: np.ndarray | None = None
+
+    # -- shared index math (mirrors Loader::batch_indices) -----------------
+
+    def batch_indices(self, bi: int) -> np.ndarray:
+        epoch, pos = divmod(bi, self.batches_per_epoch)
+        if epoch != self._perm_epoch:
+            self._perm = epoch_permutation(self.n_records, self.seed + epoch)
+            self._perm_epoch = epoch
+        k = (pos * self.batch_records + np.arange(self.batch_records)) \
+            * self.n_shards + self.shard
+        return self._perm[k]
+
+    # -- iteration ---------------------------------------------------------
+
+    def _iter_native(self) -> Iterator[np.ndarray]:
+        lib = native.load_library()
+        h = lib.dtf_loader_create(
+            self.path.encode(), self.record_bytes, self.batch_records,
+            self.n_threads, self.depth, self.seed, self.shard, self.n_shards,
+            self.start_batch,
+        )
+        if not h:
+            raise OSError(f"native loader failed to open {self.path}")
+        try:
+            nbytes = self.batch_records * self.record_bytes
+            i = 0
+            while self.num_batches is None or i < self.num_batches:
+                b = lib.dtf_loader_next(h)
+                if not b:
+                    return
+                buf = np.ctypeslib.as_array(
+                    lib.dtf_batch_data(b), shape=(nbytes,)
+                ).copy()
+                lib.dtf_loader_release(h, b)
+                yield buf.reshape(self.batch_records, self.record_bytes)
+                i += 1
+        finally:
+            lib.dtf_loader_destroy(h)
+
+    def _iter_python(self) -> Iterator[np.ndarray]:
+        i = 0
+        bi = self.start_batch
+        while self.num_batches is None or i < self.num_batches:
+            idx = self.batch_indices(bi)
+            # fancy indexing already copies out of the memmap; asarray just
+            # normalizes the subclass without a second memcpy
+            yield np.asarray(
+                self._mm.reshape(self.n_records, self.record_bytes)[idx]
+            )
+            bi += 1
+            i += 1
+
+    def __iter__(self):
+        it = self._iter_native() if self.use_native else self._iter_python()
+        if self.decode is None:
+            return it
+        return (self.decode(raw) for raw in it)

@@ -58,6 +58,21 @@ def test_matches_dense_oracle_when_no_drops():
     )
 
 
+def test_grouped_routing_matches_oracle():
+    # several routing groups (T=16, group=4): same per-token result as the
+    # ungrouped oracle when capacity is ample
+    cfg = moe_lib.MoEConfig(**{**CFG.__dict__, "group_size": 4})
+    model, params = _init(cfg)
+    x = _x(7)
+    y, _ = model.apply({"params": params}, x, train=True, mutable=["losses"])
+    np.testing.assert_allclose(
+        np.asarray(y), _dense_oracle(params, x, cfg), atol=1e-4
+    )
+    with pytest.raises(ValueError, match="divide"):
+        bad = moe_lib.MoEConfig(**{**CFG.__dict__, "group_size": 5})
+        moe_lib.MoEMLP(bad).init(jax.random.PRNGKey(0), _x(), train=False)
+
+
 def test_aux_loss_positive_and_bounded():
     model, params = _init()
     _, mut = model.apply({"params": params}, _x(2), train=True,
