@@ -61,7 +61,7 @@ def main() -> None:
 
     # Per-chip batch sized for a v5e (16 GiB HBM) bf16 train step; tiny on
     # CPU so the fallback run finishes fast.
-    per_chip_batch = int(os.environ.get("BENCH_BATCH", "128" if on_tpu else "8"))
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", "256" if on_tpu else "8"))
     image = 224 if on_tpu else 64
     cfg = ResNetConfig() if on_tpu else ResNetConfig(
         stage_sizes=(1, 1, 1, 1), width=16, num_classes=100, dtype="float32"
@@ -84,26 +84,39 @@ def main() -> None:
     from jax.sharding import NamedSharding
 
     batch = {
-        "image": rng.randn(global_batch, image, image, 3).astype(np.float32),
+        # bf16 images on TPU: halves host→HBM bytes; first conv casts anyway
+        "image": rng.randn(global_batch, image, image, 3).astype(np.float32)
+        .astype(jnp.bfloat16 if on_tpu else np.float32),
         "label": rng.randint(0, cfg.num_classes, global_batch).astype(np.int32),
     }
     batch = jax.tree.map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, sh.batch_spec(x.ndim))),
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, sh.batch_spec(np.ndim(x)))
+        ),
         batch,
     )
+
+    # Timing sync MUST fetch a value: on tunneled platforms (axon relay)
+    # jax.block_until_ready returns before the computation runs, which
+    # inflates step rates ~40x. device_get of the chained loss forces every
+    # step in the dependency chain to have executed.
+    def sync(metrics) -> float:
+        return float(jax.device_get(metrics["loss"]))
 
     warmup = 3
     measured = int(os.environ.get("BENCH_STEPS", "10"))
     log("compiling + warmup...")
     for _ in range(warmup):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    sync(metrics)
     log("measuring...")
     t0 = time.perf_counter()
     for _ in range(measured):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    final_loss = sync(metrics)
     dt = time.perf_counter() - t0
+    log(f"final loss {final_loss:.4f} (finite => really trained)")
+    assert np.isfinite(final_loss)
 
     steps_per_sec = measured / dt
     images_per_sec = steps_per_sec * global_batch

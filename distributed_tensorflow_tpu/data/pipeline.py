@@ -23,7 +23,7 @@ import numpy as np
 
 @dataclasses.dataclass(frozen=True)
 class DataConfig:
-    dataset: str = "synthetic"  # synthetic | npz:<path>
+    dataset: str = "synthetic"  # synthetic | npz:<path> | records:<path>
     global_batch_size: int = 128
     image_size: int = 28
     channels: int = 1

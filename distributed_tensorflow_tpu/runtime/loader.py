@@ -66,7 +66,7 @@ class RecordFileLoader:
         shard: int = 0,
         n_shards: int = 1,
         n_threads: int = 4,
-        depth: int = 2,
+        depth: int | None = None,  # None = n_threads (one in-flight per worker)
         decode: Callable[[np.ndarray], object] | None = None,
         start_batch: int = 0,
         num_batches: int | None = None,
@@ -79,7 +79,7 @@ class RecordFileLoader:
         self.shard = shard
         self.n_shards = n_shards
         self.n_threads = n_threads
-        self.depth = depth
+        self.depth = n_threads if depth is None else depth
         self.decode = decode
         self.start_batch = start_batch
         self.num_batches = num_batches

@@ -100,12 +100,13 @@ def load_library() -> ctypes.CDLL | None:
             return None
         try:
             lib = _configure(ctypes.CDLL(so))
-            # sanity-probe a pure function; a corrupt/stale .so fails here,
+            # sanity-probe a pure function; a corrupt/stale .so fails here
+            # (AttributeError when a symbol is missing from an old build),
             # and deleting it makes the next process rebuild cleanly
             if lib.dtf_crc32(b"123456789", 9) != 0xCBF43926:
                 raise OSError("crc self-test failed")
             _lib = lib
-        except OSError as e:
+        except (OSError, AttributeError) as e:
             logger.warning("native runtime load failed (%s); rebuilding "
                            "next run", e)
             try:

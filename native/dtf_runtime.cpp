@@ -210,6 +210,11 @@ struct Loader {
     Batch* b = ready.front();
     ready.pop_front();
     next_to_take++;
+    l.unlock();
+    // taking a batch lowers the in-flight count — wake a producer (without
+    // this, consumers that hold several batches before releasing any would
+    // deadlock the pipeline)
+    cv_produce.notify_one();
     return b;
   }
 
@@ -255,6 +260,9 @@ void* dtf_loader_create(const char* path, int64_t record_bytes,
   madvise(const_cast<uint8_t*>(L->base), L->file_bytes, MADV_WILLNEED);
   for (int i = 0; i < L->depth + 1; ++i) L->freelist.push_back(new Batch());
   if (n_threads < 1) n_threads = 1;
+  // at most `depth` batches are ever in flight, so extra workers would
+  // only sleep — cap instead of wasting threads
+  if (n_threads > L->depth) n_threads = L->depth;
   for (int i = 0; i < n_threads; ++i)
     L->workers.emplace_back([L] { L->worker_loop(); });
   return L;

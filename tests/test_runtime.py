@@ -88,6 +88,31 @@ def test_resume_continues_stream(record_file, lib):
         np.testing.assert_array_equal(a, b)
 
 
+def test_next_without_release_does_not_deadlock(record_file, lib):
+    """Holding several batches before releasing any must not starve the
+    producers (next() must wake a worker when it lowers in-flight)."""
+    import threading
+
+    path, _ = record_file
+    h = lib.dtf_loader_create(path.encode(), 20, 8, 2, 2, 0, 0, 1, 0)
+    assert h
+    held = []
+
+    def consume():
+        for _ in range(3):  # depth=2: the 3rd next needs a producer wakeup
+            held.append(lib.dtf_loader_next(h))
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(timeout=30)
+    ok = not t.is_alive()
+    if ok:
+        for b in held:
+            lib.dtf_loader_release(h, b)
+        lib.dtf_loader_destroy(h)  # leak on failure: destroy would race
+    assert ok, "loader deadlocked when batches were held across next() calls"
+
+
 def test_decode_hook(record_file):
     path, data = record_file
     ldr = RecordFileLoader(
