@@ -72,8 +72,14 @@ def main() -> None:
     log(f"mesh: {describe(mesh)}  global_batch={global_batch}  image={image}")
 
     model = ResNet50(cfg)
-    loss_fn = common.classification_loss_fn(model, weight_decay=1e-4)
-    tx = optax.sgd(0.1, momentum=0.9)
+    loss_fn = common.classification_loss_fn(model)
+    # the exact optimizer the resnet50_imagenet workload uses (coupled L2
+    # on kernels, fused into the update pass)
+    from distributed_tensorflow_tpu.train import OptimizerConfig, make_optimizer
+
+    tx = make_optimizer(OptimizerConfig(
+        name="momentum", learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+    ))
     state, specs = init_train_state(
         common.make_init_fn(model, (image, image, 3)), tx, mesh,
         jax.random.PRNGKey(0),

@@ -25,6 +25,22 @@ class ResNetConfig:
     dtype: str = "bfloat16"
     bn_momentum: float = 0.9
     bn_epsilon: float = 1e-5
+    # "conv": standard 7x7/2 stem. "space_to_depth": fold the image 2x2
+    # (H,W,3)→(H/2,W/2,12) and run a 4x4/1 conv — same receptive field as
+    # an 8x8/2 conv (7x7 kernel zero-padded), but 12 input channels pack
+    # the MXU's contracting dimension 4x better than 3 (the MLPerf TPU
+    # ResNet conv0 optimization).
+    stem: str = "conv"
+
+
+def space_to_depth(x, block: int):
+    """(B, H, W, C) → (B, H/b, W/b, C·b²): fold b×b spatial patches into
+    channels. Pure reshape/transpose — XLA fuses it into the consumer."""
+    b_, h, w, c = x.shape
+    x = x.reshape(b_, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b_, h // block, w // block, c * block * block
+    )
 
 
 class BottleneckBlock(nn.Module):
@@ -68,9 +84,17 @@ class ResNet(nn.Module):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         x = x.astype(dtype)
-        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
-                    dtype=dtype, kernel_init=nn.initializers.he_normal(),
-                    name="stem_conv")(x)
+        if cfg.stem == "space_to_depth":
+            x = space_to_depth(x, 2)
+            x = nn.Conv(cfg.width, (4, 4), strides=(1, 1), use_bias=False,
+                        dtype=dtype, kernel_init=nn.initializers.he_normal(),
+                        name="stem_conv_s2d")(x)
+        elif cfg.stem == "conv":
+            x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
+                        dtype=dtype, kernel_init=nn.initializers.he_normal(),
+                        name="stem_conv")(x)
+        else:
+            raise ValueError(f"Unknown stem {cfg.stem!r}")
         x = nn.BatchNorm(use_running_average=not train, momentum=cfg.bn_momentum,
                          epsilon=cfg.bn_epsilon, dtype=jnp.float32,
                          name="stem_bn")(x)
@@ -96,8 +120,14 @@ def flops_per_example(cfg: ResNetConfig, image_size: int = 224) -> float:
     """Analytic fwd+bwd FLOPs per image (the §6 honesty rule: model
     arithmetic, not profiler counts). Counts conv/dense MACs ×2."""
     total = 0.0
-    size = image_size // 2  # stem stride 2
-    total += 2.0 * size * size * cfg.width * 3 * 49  # 7x7 stem
+    size = image_size // 2  # stem stride 2 (or s2d fold)
+    if cfg.stem == "space_to_depth":
+        stem_macs = 12 * 16
+    elif cfg.stem == "conv":
+        stem_macs = 3 * 49
+    else:
+        raise ValueError(f"Unknown stem {cfg.stem!r}")
+    total += 2.0 * size * size * cfg.width * stem_macs
     size //= 2  # maxpool
     in_c = cfg.width
     for stage, blocks in enumerate(cfg.stage_sizes):

@@ -184,6 +184,20 @@ def test_optimizer_clip_grad_norm_wired(mesh8):
     assert np.abs(after - before).max() <= 1e-3 + 1e-6
 
 
+@pytest.mark.parametrize("name", ["sgd", "momentum", "adam", "rmsprop",
+                                  "adagrad"])
+def test_weight_decay_honored_everywhere(name):
+    """OptimizerConfig.weight_decay must shrink kernels (ndim>1) for every
+    non-decoupled optimizer, not silently no-op."""
+    tx = make_optimizer(OptimizerConfig(name=name, learning_rate=0.1,
+                                        weight_decay=0.5))
+    params = {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))}
+    grads = jax.tree.map(jnp.zeros_like, params)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    assert float(jnp.max(updates["kernel"])) < 0  # decay pulls down
+    assert float(jnp.abs(updates["bias"]).max()) < 1e-5  # biases exempt
+
+
 def test_ftrl_l1_applies():
     tx = make_optimizer(OptimizerConfig(name="ftrl", learning_rate=0.1, l1=0.5))
     params = {"w": jnp.ones((4,))}

@@ -29,6 +29,31 @@ def test_resnet_forward_shape_and_params():
     assert logits.dtype == jnp.float32
 
 
+def test_space_to_depth_stem():
+    from distributed_tensorflow_tpu.models.resnet import space_to_depth
+
+    # fold/unfold bookkeeping: channels carry the 2x2 patch
+    x = jnp.arange(2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 3)
+    y = space_to_depth(x, 2)
+    assert y.shape == (2, 2, 2, 12)
+    np.testing.assert_array_equal(y[0, 0, 0, :3], x[0, 0, 0])
+    np.testing.assert_array_equal(y[0, 0, 0, 3:6], x[0, 0, 1])
+
+    # the s2d stem trains: same downstream shapes, finite loss, and the
+    # stem kernel is the folded 4x4x(C*4) layout
+    cfg = tiny_cfg(stem="space_to_depth")
+    model = ResNet50(cfg)
+    params, mstate = common.make_init_fn(model, (32, 32, 3))(
+        jax.random.PRNGKey(0)
+    )
+    assert params["stem_conv_s2d"]["kernel"].shape == (4, 4, 12, 8)
+    logits = model.apply(
+        {"params": params, **mstate}, jnp.zeros((2, 32, 32, 3)), train=False
+    )
+    assert logits.shape == (2, 10)
+    assert flops_per_example(cfg, 32) != flops_per_example(tiny_cfg(), 32)
+
+
 def test_resnet_train_step_updates_bn_stats(mesh8):
     import optax
 
