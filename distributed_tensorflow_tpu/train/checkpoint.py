@@ -43,6 +43,11 @@ class CheckpointConfig:
     max_to_keep: int = 3
     async_save: bool = True
     save_on_preemption: bool = True
+    # Multi-host preemption agreement runs every N steps (a host-side
+    # allgather; every step would serialize hosts). A preempted host waits
+    # at most N steps before the coordinated save — keep N·step_time well
+    # under the preemption grace period.
+    preemption_check_every: int = 8
 
 
 class PreemptionWatcher:
@@ -92,11 +97,30 @@ class Checkpointer:
         """Cadence save; also fires unconditionally on observed preemption
         (then asks the caller loop to stop via the returned flag +
         PreemptionError)."""
-        if self.watcher is not None and self.watcher.preempted:
+        if self.watcher is not None and self._any_host_preempted(step):
             self.save(step, state, force=True)
             self.wait()
             raise PreemptionSaved(step)
         return self.save(step, state)
+
+    def _any_host_preempted(self, step: int) -> bool:
+        """Cross-host OR of the local SIGTERM flag. Orbax saves are
+        collective — if only the signaled host entered the save, the others
+        would hang it — so every host must agree, the agreement protocol of
+        TF's PreemptionCheckpointHandler ($TF failure_handling.py:337),
+        throttled to every ``preemption_check_every`` steps."""
+        local = bool(self.watcher.preempted)
+        if jax.process_count() == 1:
+            return local
+        if step % max(self.cfg.preemption_check_every, 1) != 0:
+            return False  # between agreement rounds even if locally flagged
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([1 if local else 0], np.int32)
+        )
+        return bool(np.max(flags) > 0)
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         if step in self.manager.all_steps():
