@@ -23,6 +23,12 @@ class ResNetConfig:
     num_classes: int = 1000
     width: int = 64
     dtype: str = "bfloat16"
+    # BatchNorm *output* dtype; None = follow `dtype`. Statistics are always
+    # computed in f32 (flax normalization upcasts internally); bf16 output
+    # halves the HBM traffic of the normalize/scale pass — the activations
+    # between BN and the next conv are the widest tensors in the net
+    # (round-1 used f32 BN output: -25% throughput, PERF_NOTES.md).
+    norm_dtype: str | None = None
     bn_momentum: float = 0.9
     bn_epsilon: float = 1e-5
     # "conv": standard 7x7/2 stem. "space_to_depth": fold the image 2x2
@@ -53,10 +59,10 @@ class BottleneckBlock(nn.Module):
         dtype = jnp.dtype(self.cfg.dtype)
         conv = partial(nn.Conv, use_bias=False, dtype=dtype,
                        kernel_init=nn.initializers.he_normal())
-        # BN computes statistics in f32 regardless of compute dtype.
+        # BN computes statistics in f32 regardless of output dtype.
         bn = partial(nn.BatchNorm, use_running_average=not train,
                      momentum=self.cfg.bn_momentum, epsilon=self.cfg.bn_epsilon,
-                     dtype=jnp.float32)
+                     dtype=jnp.dtype(self.cfg.norm_dtype or self.cfg.dtype))
         residual = x
         y = conv(self.filters, (1, 1), name="conv1")(x)
         y = bn(name="bn1")(y)
@@ -96,7 +102,8 @@ class ResNet(nn.Module):
         else:
             raise ValueError(f"Unknown stem {cfg.stem!r}")
         x = nn.BatchNorm(use_running_average=not train, momentum=cfg.bn_momentum,
-                         epsilon=cfg.bn_epsilon, dtype=jnp.float32,
+                         epsilon=cfg.bn_epsilon,
+                         dtype=jnp.dtype(cfg.norm_dtype or cfg.dtype),
                          name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -43,6 +43,11 @@ class CheckpointConfig:
     max_to_keep: int = 3
     async_save: bool = True
     save_on_preemption: bool = True
+    # Refuse to write a checkpoint whose params contain NaN/Inf. One device
+    # reduce over the param tree at save cadence (~free); closes the window
+    # where gradients poison the params at step N but the loss — NaNGuard's
+    # only signal when debug metrics are off — stays finite until N+1.
+    validate_before_save: bool = True
     # Multi-host preemption agreement runs every N steps (a host-side
     # allgather; every step would serialize hosts). A preempted host waits
     # at most N steps before the coordinated save — keep N·step_time well
@@ -91,6 +96,7 @@ class Checkpointer:
         self.manager = ocp.CheckpointManager(
             os.path.abspath(os.path.expanduser(cfg.directory)), options=options
         )
+        self._finite_check = None
 
     # -- save -------------------------------------------------------------
     def maybe_save(self, step: int, state: Any) -> bool:
@@ -122,9 +128,32 @@ class Checkpointer:
         )
         return bool(np.max(flags) > 0)
 
+    def _params_finite(self, state: Any) -> bool:
+        """All-finite reduce over the float leaves of state.params (or of
+        the whole tree for non-TrainState pytrees). Jitted once; identical
+        on every host, so multi-host saves stay in agreement."""
+        import jax.numpy as jnp
+
+        params = getattr(state, "params", state)
+        if self._finite_check is None:
+            def all_finite(tree):
+                leaves = [
+                    jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(tree)
+                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                ]
+                return jnp.all(jnp.stack(leaves)) if leaves else jnp.asarray(True)
+
+            self._finite_check = jax.jit(all_finite)
+        return bool(jax.device_get(self._finite_check(params)))
+
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         if step in self.manager.all_steps():
             return False  # already saved (e.g. cadence save + final save)
+        if self.cfg.validate_before_save and not self._params_finite(state):
+            logger.error(
+                "refusing to checkpoint at step %d: non-finite params", step
+            )
+            return False
         saved = self.manager.save(
             step, args=ocp.args.StandardSave(state), force=force
         )

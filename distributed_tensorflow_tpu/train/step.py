@@ -151,7 +151,12 @@ def init_train_state(
 @dataclasses.dataclass(frozen=True)
 class StepOptions:
     grad_accum_steps: int = 1
-    compute_grad_norm: bool = True
+    # Debug signals are OPT-IN: each is a full extra pass over every gradient
+    # leaf per step (real HBM bandwidth on conv nets). NaNGuard works without
+    # them — it reads the loss, which the host fetches anyway, and a NaN in
+    # the grads poisons the loss within one step.
+    compute_grad_norm: bool = False
+    check_grads_finite: bool = False
     clip_grad_norm: float | None = None  # applied here, before tx
 
 
@@ -215,11 +220,14 @@ def make_train_step(
             scale = jnp.minimum(1.0, options.clip_grad_norm / (gnorm + 1e-9))
             grads = jax.tree.map(lambda g: g * scale, grads)
 
-        # NaN guard signal, computed on-device and piggybacked on the step
-        # output (SURVEY.md §5.5) — the NanTensorHook replacement.
-        metrics["grads_finite"] = jnp.all(
-            jnp.asarray([jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)])
-        ).astype(jnp.float32)
+        if options.check_grads_finite:
+            # NaN guard signal, computed on-device and piggybacked on the step
+            # output (SURVEY.md §5.5) — the NanTensorHook replacement. Off by
+            # default: NaNGuard's loss check catches the same failures one
+            # step later at zero cost.
+            metrics["grads_finite"] = jnp.all(
+                jnp.asarray([jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)])
+            ).astype(jnp.float32)
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)

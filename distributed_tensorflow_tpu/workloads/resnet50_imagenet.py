@@ -19,7 +19,9 @@ from .runner import RunConfig, TrainSection, WorkloadParts
 def default_config() -> RunConfig:
     return RunConfig(
         workload="resnet50_imagenet",
-        model=ResNetConfig(),
+        # space_to_depth conv0 (the MLPerf TPU stem) + bf16 BN output:
+        # +28% images/sec over the naive stem/f32-BN config (PERF_NOTES.md).
+        model=ResNetConfig(stem="space_to_depth"),
         mesh=MeshSpec(data=-1),
         data=DataConfig(
             dataset="synthetic", global_batch_size=1024,

@@ -47,6 +47,9 @@ class TrainSection:
     eval_batches: int = 16
     profile: bool = False
     profile_dir: str = "/tmp/dtf_tpu_profile"
+    # Adds grad_norm + grads_finite to the step metrics — an extra pass over
+    # every gradient leaf per step; off in production (PERF_NOTES.md).
+    debug_metrics: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -130,7 +133,11 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
 
     step_fn = make_train_step(
         parts.loss_fn, tx,
-        StepOptions(grad_accum_steps=cfg.train.grad_accum_steps),
+        StepOptions(
+            grad_accum_steps=cfg.train.grad_accum_steps,
+            compute_grad_norm=cfg.train.debug_metrics,
+            check_grads_finite=cfg.train.debug_metrics,
+        ),
     )
     trainer = Trainer(step_fn, state, mesh, specs, callbacks=callbacks)
 

@@ -169,6 +169,30 @@ def test_failed_run_never_checkpoints_poisoned_state(mesh8, tmp_path):
     ckpt.close()
 
 
+def test_save_refuses_nonfinite_params(mesh8, tmp_path):
+    """validate_before_save: a direct save() of NaN params is refused — the
+    guard that holds even when debug metrics (grads_finite) are off and the
+    loss hasn't gone non-finite yet."""
+    tx = optax.sgd(0.1)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "v"), async_save=False,
+                         save_on_preemption=False),
+        mesh8,
+    )
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    poisoned = state.replace(
+        params=jax.tree.map(lambda p: p * jnp.nan, state.params)
+    )
+    assert ckpt.save(0, poisoned, force=True) is False
+    assert ckpt.latest_step() is None
+    # and a clean state still saves
+    assert ckpt.save(0, state, force=True) is True
+    assert ckpt.latest_step() == 0
+    ckpt.close()
+
+
 def test_optimizer_clip_grad_norm_wired(mesh8):
     """clip_grad_norm on OptimizerConfig must actually clip."""
     big = make_batch(16)

@@ -137,7 +137,8 @@ def test_transformer_moe_trains(devices):
         param_rules=tp_rules(),
     )
     step = jit_train_step(
-        make_train_step(lm_loss_fn(model), tx, StepOptions()), mesh, specs
+        make_train_step(lm_loss_fn(model), tx,
+                        StepOptions(check_grads_finite=True)), mesh, specs
     )
     rng = np.random.RandomState(0)
     losses = []

@@ -128,7 +128,8 @@ def test_pipelined_lm_trains(devices):
     )
     assert state.params["blocks"]["wqkv"].sharding.spec[0] == "pipe"
     step = jit_train_step(
-        make_train_step(plm.lm_loss_fn(cfg, mesh), tx, StepOptions()),
+        make_train_step(plm.lm_loss_fn(cfg, mesh), tx,
+                        StepOptions(check_grads_finite=True)),
         mesh, specs,
     )
     rng = np.random.RandomState(0)

@@ -105,7 +105,8 @@ def test_metrics_contents(mesh8):
     tx = optax.sgd(0.1)
     state, specs = init_train_state(linear_init, tx, mesh8, jax.random.PRNGKey(0))
     step = jit_train_step(
-        make_train_step(linear_loss, tx, StepOptions(clip_grad_norm=1.0)),
+        make_train_step(linear_loss, tx,
+                        StepOptions(clip_grad_norm=1.0, check_grads_finite=True)),
         mesh8, specs,
     )
     state, metrics = step(state, _put(make_batch(), mesh8))

@@ -65,7 +65,8 @@ def _run_steps(mesh, param_rules, n_steps=3, seq_impl=None, mesh_for_model=None)
         param_rules=param_rules,
     )
     step = jit_train_step(
-        make_train_step(tfm.mlm_loss_fn(model), tx, StepOptions()), mesh, specs
+        make_train_step(tfm.mlm_loss_fn(model), tx,
+                        StepOptions(check_grads_finite=True)), mesh, specs
     )
     rng = np.random.RandomState(0)
     losses = []
@@ -119,7 +120,8 @@ def test_lm_loss_decreases():
         tfm.make_init_fn(model, 16), tx, mesh, jax.random.PRNGKey(0)
     )
     step = jit_train_step(
-        make_train_step(tfm.lm_loss_fn(model), tx, StepOptions()), mesh, specs
+        make_train_step(tfm.lm_loss_fn(model), tx,
+                        StepOptions(check_grads_finite=True)), mesh, specs
     )
     # deterministic walk: ids[t+1] = (ids[t]+1) % V — learnable
     rng = np.random.RandomState(0)

@@ -44,6 +44,7 @@ def test_cifar10_cnn_sync_dp8_smoke():
             "--train.num_steps=6",
             "--train.log_every=3",
             "--train.eval_batches=2",
+            "--train.debug_metrics=true",
             "--data.global_batch_size=64",
             "--mesh.data=8",
         ],
