@@ -65,9 +65,17 @@ def main() -> None:
     image = 224 if on_tpu else 64
     # Round-2 tuning (PERF_NOTES.md): space-to-depth stem + bf16 BN output
     # measured +28% over the round-1 config; batch 256/chip is the knee
-    # (384/512/1024 all slower per image — HBM pressure).
-    cfg = ResNetConfig(stem="space_to_depth") if on_tpu else ResNetConfig(
-        stage_sizes=(1, 1, 1, 1), width=16, num_classes=100, dtype="float32",
+    # (384/512/1024 all slower per image — HBM pressure). The BENCH_* env
+    # knobs exist so tools/ablate_resnet.py can sweep variants through THIS
+    # harness instead of duplicating it.
+    stem = os.environ.get("BENCH_STEM", "space_to_depth" if on_tpu else "conv")
+    norm_dtype = os.environ.get("BENCH_NORM_DTYPE") or None
+    cfg = (
+        ResNetConfig(stem=stem, norm_dtype=norm_dtype) if on_tpu
+        else ResNetConfig(
+            stage_sizes=(1, 1, 1, 1), width=16, num_classes=100,
+            dtype="float32", stem=stem, norm_dtype=norm_dtype,
+        )
     )
     global_batch = per_chip_batch * n_chips
 
@@ -87,7 +95,12 @@ def main() -> None:
         common.make_init_fn(model, (image, image, 3)), tx, mesh,
         jax.random.PRNGKey(0),
     )
-    step = jit_train_step(make_train_step(loss_fn, tx, StepOptions()), mesh, specs)
+    dbg = os.environ.get("BENCH_DEBUG_METRICS", "0") == "1"
+    step = jit_train_step(
+        make_train_step(loss_fn, tx, StepOptions(
+            compute_grad_norm=dbg, check_grads_finite=dbg)),
+        mesh, specs,
+    )
 
     rng = np.random.RandomState(0)
     from jax.sharding import NamedSharding
@@ -130,7 +143,10 @@ def main() -> None:
     steps_per_sec = measured / dt
     images_per_sec = steps_per_sec * global_batch
     images_per_sec_per_chip = images_per_sec / n_chips
-    model_flops = flops_per_example(cfg, image) * global_batch
+    # flops_per_example is fwd-only (framework contract, utils/flops.py);
+    # training MFU applies the fwd+bwd multiplier exactly here.
+    model_flops = (flops_per_example(cfg, image) * global_batch
+                   * flops_lib.train_flops_multiplier())
     peak = flops_lib.peak_flops_per_chip(devices[0])
     mfu = flops_lib.mfu(model_flops, steps_per_sec, n_chips, peak)
     log(f"steps/sec={steps_per_sec:.3f} images/sec/chip={images_per_sec_per_chip:.1f} "

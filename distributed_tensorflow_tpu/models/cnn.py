@@ -48,6 +48,7 @@ class CNN(nn.Module):
 
 
 def flops_per_example(cfg: CNNConfig, image_size: int = 32) -> float:
+    """Forward FLOPs (framework contract: fwd-only, see utils/flops.py)."""
     fwd = 0.0
     h = image_size
     in_c = 3
@@ -57,4 +58,4 @@ def flops_per_example(cfg: CNNConfig, image_size: int = 32) -> float:
         in_c = ch
     fwd += 2.0 * (h * h * in_c) * cfg.dense_size
     fwd += 2.0 * cfg.dense_size * cfg.num_classes
-    return 3.0 * fwd
+    return fwd

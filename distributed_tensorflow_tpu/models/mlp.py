@@ -35,6 +35,6 @@ class MLP(nn.Module):
 
 
 def flops_per_example(cfg: MLPConfig, input_dim: int = 784) -> float:
+    """Forward FLOPs (framework contract: fwd-only, see utils/flops.py)."""
     dims = [input_dim, *cfg.hidden_sizes, cfg.num_classes]
-    fwd = sum(2.0 * a * b for a, b in zip(dims, dims[1:]))
-    return 3.0 * fwd  # fwd + bwd
+    return sum(2.0 * a * b for a, b in zip(dims, dims[1:]))

@@ -124,8 +124,11 @@ def ResNet50(cfg: ResNetConfig | None = None) -> ResNet:
 
 
 def flops_per_example(cfg: ResNetConfig, image_size: int = 224) -> float:
-    """Analytic fwd+bwd FLOPs per image (the §6 honesty rule: model
-    arithmetic, not profiler counts). Counts conv/dense MACs ×2."""
+    """Analytic FORWARD FLOPs per image (the §6 honesty rule: model
+    arithmetic, not profiler counts). Counts conv/dense MACs ×2. The
+    framework-wide contract (utils/flops.py): flops_per_example is always
+    forward-only; training consumers apply train_flops_multiplier() in
+    exactly one place (MetricsLogger / bench)."""
     total = 0.0
     size = image_size // 2  # stem stride 2 (or s2d fold)
     if cfg.stem == "space_to_depth":
@@ -151,4 +154,4 @@ def flops_per_example(cfg: ResNetConfig, image_size: int = 224) -> float:
             in_c = filters * 4
             size = out_size
     total += 2.0 * in_c * cfg.num_classes
-    return 3.0 * total  # fwd + bwd
+    return total

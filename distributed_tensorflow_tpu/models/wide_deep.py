@@ -178,8 +178,9 @@ def ctr_eval_fn(model: WideDeep):
 
 
 def flops_per_example(cfg: WideDeepConfig) -> float:
-    """Analytic fwd+bwd FLOPs (MFU accounting, SURVEY.md §5.5). Embedding
-    gathers are bandwidth, not FLOPs; count the MLP matmuls ×3 for bwd."""
+    """Analytic FORWARD FLOPs (MFU accounting, SURVEY.md §5.5; framework
+    contract: fwd-only, see utils/flops.py). Embedding gathers are
+    bandwidth, not FLOPs; count the MLP matmuls."""
     d_in = len(cfg.vocab_sizes) * cfg.embed_dim + cfg.dense_features
     flops = 0.0
     prev = d_in
@@ -188,4 +189,4 @@ def flops_per_example(cfg: WideDeepConfig) -> float:
         prev = w
     flops += 2.0 * prev  # deep_out
     flops += 2.0 * cfg.dense_features  # wide_dense
-    return 3.0 * flops
+    return flops

@@ -52,12 +52,18 @@ class MoEConfig:
 
 def moe_rules() -> list[tuple[str, P]]:
     """Path rules: expert dim over `expert`, FFN hidden dim over `model`
-    (EP × TP compose); router stays replicated."""
+    (EP × TP compose); router stays replicated.
+
+    Patterns anchor on the parameter *leaf* names (``w_in``/``b_in``/
+    ``w_out``/``b_out`` — names private to MoEMLP), so the rules match
+    wherever the module is mounted — bare, or under any parent scope —
+    instead of silently returning replicated specs when the parent isn't
+    literally called 'moe' (round-1 advisor finding)."""
     return [
-        (r"moe/w_in", P(mesh_lib.EXPERT, None, mesh_lib.MODEL)),
-        (r"moe/b_in", P(mesh_lib.EXPERT, mesh_lib.MODEL)),
-        (r"moe/w_out", P(mesh_lib.EXPERT, mesh_lib.MODEL, None)),
-        (r"moe/b_out", P(mesh_lib.EXPERT, None)),
+        (r"(^|/)w_in$", P(mesh_lib.EXPERT, None, mesh_lib.MODEL)),
+        (r"(^|/)b_in$", P(mesh_lib.EXPERT, mesh_lib.MODEL)),
+        (r"(^|/)w_out$", P(mesh_lib.EXPERT, mesh_lib.MODEL, None)),
+        (r"(^|/)b_out$", P(mesh_lib.EXPERT, None)),
     ]
 
 
@@ -110,9 +116,13 @@ def top_k_routing(probs: jax.Array, capacity: int, top_k: int):
         combine = combine + gate[:, None, None] * d
         fill = fill + jnp.sum(keep, axis=0).astype(jnp.int32)
         remaining = remaining * (1.0 - onehot)
-    # renormalize combine over the chosen experts (top-k gates sum to 1)
-    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
-    combine = combine / jnp.maximum(denom, 1e-9)
+    if top_k > 1:
+        # renormalize combine over the chosen experts (top-k gates sum to 1)
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+    # top_k == 1 keeps the RAW gate probability (Switch Transformer §2.1):
+    # renormalizing would make the gate exactly 1.0 and cut the router off
+    # from the main-loss gradient (round-1 advisor finding).
     # Switch load-balance loss on first-choice statistics
     first = jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=probs.dtype)
     frac_tokens = first.mean(axis=0)

@@ -49,6 +49,10 @@ class MetricsLogger(Callback):
     def __init__(self, every_n: int = 100, batch_size: int | None = None,
                  model_flops_per_step: float | None = None,
                  history: bool = False):
+        """``model_flops_per_step``: FORWARD FLOPs per step (the framework
+        contract — every model's flops_per_example is fwd-only). This
+        callback is the single place the ×3 training multiplier
+        (utils/flops.train_flops_multiplier) is applied for MFU."""
         self.every_n = every_n
         self.batch_size = batch_size
         self.model_flops = model_flops_per_step
@@ -73,7 +77,8 @@ class MetricsLogger(Callback):
                 fetched["examples_per_sec"] = steps_per_sec * self.batch_size
             if self.model_flops:
                 fetched["mfu"] = flops_lib.mfu(
-                    self.model_flops, steps_per_sec, jax.device_count()
+                    self.model_flops * flops_lib.train_flops_multiplier(),
+                    steps_per_sec, jax.device_count()
                 )
         self._t0, self._step0 = now, step
         self.last = fetched

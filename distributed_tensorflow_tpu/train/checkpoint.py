@@ -104,8 +104,18 @@ class Checkpointer:
         (then asks the caller loop to stop via the returned flag +
         PreemptionError)."""
         if self.watcher is not None and self._any_host_preempted(step):
-            self.save(step, state, force=True)
+            saved = self.save(step, state, force=True)
             self.wait()
+            latest = self.latest_step()
+            if not saved and (latest is None or latest < step):
+                # validate_before_save refused (non-finite params) and no
+                # earlier save covers this step: the run must exit FAILED —
+                # raising PreemptionSaved here would tell the scheduler a
+                # step-`step` checkpoint exists when nothing was written.
+                raise FloatingPointError(
+                    f"preempted at step {step} with non-finite params; "
+                    f"checkpoint refused (latest on disk: {latest})"
+                )
             raise PreemptionSaved(step)
         return self.save(step, state)
 

@@ -4,6 +4,13 @@ MFU is computed from *analytic* model FLOPs — the model's own arithmetic
 count, not profiler-counted device FLOPs (which flatter recompute). Peak
 chip FLOP/s comes from a table keyed on jax's device_kind, overridable via
 config for new hardware.
+
+FRAMEWORK-WIDE CONTRACT (round-2 unification, VERDICT.md item 2): every
+model's ``flops_per_example`` and every workload's
+``WorkloadParts.flops_per_step`` are FORWARD-only. The fwd+bwd training
+multiplier (``train_flops_multiplier()``, ×3) is applied in exactly two
+consumer sites: ``MetricsLogger`` (train-loop MFU) and ``bench.py``.
+``tests/test_flops_contract.py`` enforces this for all workloads.
 """
 
 from __future__ import annotations

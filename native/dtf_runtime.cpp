@@ -237,6 +237,13 @@ void* dtf_loader_create(const char* path, int64_t record_bytes,
                         int64_t batch_records, int n_threads, int depth,
                         uint64_t seed, int64_t shard, int64_t n_shards,
                         int64_t start_batch) {
+  // Validate every divisor before use: the ABI promises nullptr on bad
+  // args, not SIGFPE. (The Python wrapper checks too, but direct C callers
+  // hit the divisions below.)
+  if (record_bytes <= 0 || batch_records <= 0 || n_shards <= 0 ||
+      shard < 0 || shard >= n_shards || start_batch < 0) {
+    return nullptr;
+  }
   auto* L = new Loader();
   L->next_to_hand = L->next_to_take = start_batch;
   L->fd = open(path, O_RDONLY);

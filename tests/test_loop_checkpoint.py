@@ -193,6 +193,34 @@ def test_save_refuses_nonfinite_params(mesh8, tmp_path):
     ckpt.close()
 
 
+def test_preemption_with_poisoned_state_fails_not_saves(mesh8, tmp_path):
+    """A preemption save refused by validate_before_save must raise
+    FloatingPointError (run exits FAILED), not PreemptionSaved — the latter
+    would tell the scheduler a checkpoint exists when nothing was written."""
+    tx = optax.sgd(0.1)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "p"), async_save=False,
+                         save_on_preemption=True),
+        mesh8,
+    )
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    poisoned = state.replace(
+        params=jax.tree.map(lambda p: p * jnp.nan, state.params)
+    )
+    ckpt.watcher._event.set()  # simulate SIGTERM observed
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        ckpt.maybe_save(3, poisoned)
+    assert ckpt.latest_step() is None
+    # healthy state at preemption still takes the clean-exit path
+    from distributed_tensorflow_tpu.train.checkpoint import PreemptionSaved
+    with pytest.raises(PreemptionSaved):
+        ckpt.maybe_save(3, state)
+    assert ckpt.latest_step() == 3
+    ckpt.close()
+
+
 def test_optimizer_clip_grad_norm_wired(mesh8):
     """clip_grad_norm on OptimizerConfig must actually clip."""
     big = make_batch(16)

@@ -90,9 +90,10 @@ def test_resnet_train_step_updates_bn_stats(mesh8):
 
 
 def test_resnet50_flops_sane():
-    # ResNet-50 ≈ 4.1 GMACs = 8.2 GFLOPs fwd @224; ×3 for train ≈ 24.6 G
+    # ResNet-50 ≈ 4.1 GMACs = 8.2 GFLOPs fwd @224 (fwd-only contract,
+    # utils/flops.py; the ×3 train multiplier is the consumer's job)
     f = flops_per_example(ResNetConfig(), 224)
-    assert 20e9 < f < 28e9, f
+    assert 6.5e9 < f < 9.5e9, f
 
 
 def test_resnet_bf16_params_stay_f32():

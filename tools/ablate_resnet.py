@@ -1,116 +1,51 @@
 #!/usr/bin/env python
-"""Ablation harness for the ResNet-50 bench (VERDICT round-2 item 1).
+"""Ablation driver for the ResNet-50 bench (PERF_NOTES.md evidence).
 
-Times train-step variants on the real chip to locate the MFU gap:
-stem (conv vs space_to_depth), BN output dtype, debug-metric overhead,
-batch size. Diagnostics to stderr, one JSON line per variant to stdout.
+Thin wrapper: each variant is a `bench.py` run with BENCH_* env overrides,
+so timing methodology, FLOPs accounting (fwd-only × train multiplier), and
+MFU math live in exactly one place — bench.py. One JSON line per variant
+to stdout; bench diagnostics pass through on stderr.
 
-Usage: python tools/ablate_resnet.py [variant ...]
+Usage: python tools/ablate_resnet.py [variant ...]   (default: all)
 """
 
 import json
 import os
+import subprocess
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+BENCH = os.path.join(REPO, "bench.py")
 
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
-
-
+# name: BENCH_* env overrides
 VARIANTS = {
-    # name: (batch, stem, norm_dtype, grad_norm+finite on)
-    "r1_baseline": (256, "conv", "float32", True),
-    "no_metrics": (256, "conv", "float32", False),
-    "bf16_bn": (256, "conv", "bfloat16", False),
-    "s2d": (256, "space_to_depth", "float32", False),
-    "combo256": (256, "space_to_depth", "bfloat16", False),
-    "combo512": (512, "space_to_depth", "bfloat16", False),
-    "combo1024": (1024, "space_to_depth", "bfloat16", False),
+    "r1_baseline": {"BENCH_STEM": "conv", "BENCH_NORM_DTYPE": "float32",
+                    "BENCH_DEBUG_METRICS": "1"},
+    "no_metrics": {"BENCH_STEM": "conv", "BENCH_NORM_DTYPE": "float32"},
+    "bf16_bn": {"BENCH_STEM": "conv"},
+    "s2d_f32bn": {"BENCH_NORM_DTYPE": "float32"},
+    "combo256": {},  # the bench default config
+    "combo384": {"BENCH_BATCH": "384"},
+    "combo512": {"BENCH_BATCH": "512"},
+    "combo1024": {"BENCH_BATCH": "1024"},
 }
 
 
-def run_variant(name, batch, stem, norm_dtype, dbg):
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import NamedSharding
-
-    from distributed_tensorflow_tpu.models import common
-    from distributed_tensorflow_tpu.models.resnet import (
-        ResNet50, ResNetConfig, flops_per_example,
-    )
-    from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh
-    from distributed_tensorflow_tpu.parallel import sharding as sh
-    from distributed_tensorflow_tpu.train import (
-        OptimizerConfig, StepOptions, init_train_state, jit_train_step,
-        make_optimizer, make_train_step,
-    )
-    from distributed_tensorflow_tpu.utils import flops as flops_lib
-
-    devices = jax.devices()
-    image = 224
-    cfg = ResNetConfig(stem=stem, norm_dtype=norm_dtype)
-    mesh = build_mesh(MeshSpec(data=-1))
-    model = ResNet50(cfg)
-    loss_fn = common.classification_loss_fn(model)
-    tx = make_optimizer(OptimizerConfig(
-        name="momentum", learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
-    ))
-    state, specs = init_train_state(
-        common.make_init_fn(model, (image, image, 3)), tx, mesh,
-        jax.random.PRNGKey(0),
-    )
-    opts = StepOptions(compute_grad_norm=dbg, check_grads_finite=dbg)
-    step = jit_train_step(make_train_step(loss_fn, tx, opts), mesh, specs)
-
-    rng = np.random.RandomState(0)
-    bdata = {
-        "image": rng.randn(batch, image, image, 3).astype(np.float32)
-        .astype(jnp.bfloat16),
-        "label": rng.randint(0, cfg.num_classes, batch).astype(np.int32),
-    }
-    bdata = jax.tree.map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, sh.batch_spec(np.ndim(x)))),
-        bdata,
-    )
-
-    def sync(metrics):
-        return float(jax.device_get(metrics["loss"]))
-
-    t_c0 = time.perf_counter()
-    for _ in range(3):
-        state, metrics = step(state, bdata)
-    sync(metrics)
-    log(f"[{name}] compile+warmup {time.perf_counter() - t_c0:.1f}s")
-    measured = int(os.environ.get("BENCH_STEPS", "20"))
-    t0 = time.perf_counter()
-    for _ in range(measured):
-        state, metrics = step(state, bdata)
-    loss = sync(metrics)
-    dt = time.perf_counter() - t0
-    assert np.isfinite(loss), name
-
-    sps = measured / dt
-    ips = sps * batch
-    fl = flops_per_example(cfg, image) * batch
-    peak = flops_lib.peak_flops_per_chip(devices[0])
-    m = flops_lib.mfu(fl, sps, len(devices), peak)
-    out = {"variant": name, "batch": batch, "stem": stem,
-           "norm_dtype": norm_dtype, "debug_metrics": dbg,
-           "images_per_sec": round(ips, 1), "step_ms": round(1e3 / sps, 2),
-           "mfu": round(m, 4), "loss": round(loss, 4)}
-    log(f"[{name}] {out}")
-    print(json.dumps(out), flush=True)
-
-
-def main():
+def main() -> None:
     names = sys.argv[1:] or list(VARIANTS)
-    for n in names:
-        run_variant(n, *VARIANTS[n])
+    for name in names:
+        env = {**os.environ, **VARIANTS[name]}
+        proc = subprocess.run(
+            [sys.executable, BENCH], env=env, capture_output=True, text=True
+        )
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            print(json.dumps({"variant": name, "error": proc.returncode}),
+                  flush=True)
+            continue
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        print(json.dumps({"variant": name, **VARIANTS[name], **result}),
+              flush=True)
 
 
 if __name__ == "__main__":
