@@ -143,6 +143,50 @@ def main() -> None:
     steps_per_sec = measured / dt
     images_per_sec = steps_per_sec * global_batch
     images_per_sec_per_chip = images_per_sec / n_chips
+
+    # ---- pipeline-fed window (VERDICT round-1 item 3) -------------------
+    # Same jit step, but every batch flows host->device through the
+    # Prefetcher: the host pre-stages K distinct bf16 numpy batches (disk
+    # decode stands outside this loop; transfer + dispatch overlap is what's
+    # being proven). pipeline_efficiency = fed / resident throughput.
+    from distributed_tensorflow_tpu.data import Prefetcher
+
+    img_dtype = jnp.bfloat16 if on_tpu else np.float32
+    host_batches = []
+    for k in range(4):
+        host_batches.append({
+            "image": rng.randn(global_batch, image, image, 3)
+            .astype(np.float32).astype(img_dtype),
+            "label": rng.randint(0, cfg.num_classes, global_batch)
+            .astype(np.int32),
+        })
+
+    def host_stream():
+        i = 0
+        while True:
+            yield host_batches[i % len(host_batches)]
+            i += 1
+
+    shardings = jax.tree.map(
+        lambda x: NamedSharding(mesh, sh.batch_spec(np.ndim(x))),
+        host_batches[0],
+    )
+    put = lambda b: jax.tree.map(jax.device_put, b, shardings)
+    fed = iter(Prefetcher(host_stream(), depth=2, transform=put))
+    for _ in range(2):  # warm the fed path (no recompile: same shapes)
+        state, metrics = step(state, next(fed))
+    sync(metrics)
+    t0 = time.perf_counter()
+    for _ in range(measured):
+        state, metrics = step(state, next(fed))
+    fed_loss = sync(metrics)
+    fed_dt = time.perf_counter() - t0
+    assert np.isfinite(fed_loss)
+    fed_steps_per_sec = measured / fed_dt
+    fed_images_per_sec_per_chip = fed_steps_per_sec * global_batch / n_chips
+    pipeline_efficiency = fed_steps_per_sec / steps_per_sec
+    log(f"pipeline-fed: steps/sec={fed_steps_per_sec:.3f} "
+        f"({pipeline_efficiency:.1%} of resident-batch)")
     # flops_per_example is fwd-only (framework contract, utils/flops.py);
     # training MFU applies the fwd+bwd multiplier exactly here.
     model_flops = (flops_per_example(cfg, image) * global_batch
@@ -163,6 +207,11 @@ def main() -> None:
         "global_batch": global_batch,
         "image_size": image,
         "full_resnet50": bool(on_tpu),
+        "stem": cfg.stem,
+        "norm_dtype": cfg.norm_dtype or cfg.dtype,
+        "pipeline_fed_images_per_sec_per_chip":
+            round(fed_images_per_sec_per_chip, 2),
+        "pipeline_efficiency": round(pipeline_efficiency, 4),
     }))
 
 

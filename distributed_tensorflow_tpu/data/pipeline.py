@@ -158,6 +158,10 @@ class Prefetcher:
     runtime/ plugs in beneath it for decode-heavy workloads."""
 
     _DONE = object()
+    # Bound at class-definition time: the generator's `finally` can run
+    # during interpreter shutdown, when the module-global `queue` name may
+    # already be torn down (observed as a TypeError in except-clause).
+    _Empty = queue.Empty
 
     def __init__(self, source: Iterable, depth: int = 2,
                  transform: Callable[[Any], Any] | None = None):
@@ -199,5 +203,5 @@ class Prefetcher:
             try:
                 while True:
                     q.get_nowait()
-            except queue.Empty:
+            except self._Empty:
                 pass

@@ -132,6 +132,18 @@ def batch_shardings(mesh: Mesh, batch: Any) -> Any:
     )
 
 
+def put_host_batch(mesh: Mesh, batch: Any) -> Any:
+    """Host batch (this process's shard of the global batch) → sharded
+    global device array over (data, fsdp). The one feeding entry for both
+    the Trainer and the standalone eval path — replaces per-worker
+    `Dataset.shard`-by-task_index feeding (SURVEY.md §2a)."""
+    shardings = batch_shardings(mesh, batch)
+    return jax.tree.map(
+        lambda x, s: jax.make_array_from_process_local_data(s, x),
+        batch, shardings,
+    )
+
+
 def shard_tree(tree: Any, mesh: Mesh, spec_tree: Any) -> Any:
     """Device_put a pytree with the given PartitionSpec tree."""
     shardings = tree_shardings(mesh, spec_tree)

@@ -91,6 +91,44 @@ class MetricsLogger(Callback):
             logger.info("step %d: %s", step, msg)
 
 
+class SummaryWriter(Callback):
+    """SummarySaverHook analog ($TF basic_session_run_hooks.py:793,
+    SURVEY.md §5.5): writes TensorBoard scalar event files via tensorboardX
+    (same wire format as tf.summary). Chief-only — matching the reference's
+    chief-only summaries — and cadence-gated like MetricsLogger so the
+    steady-state loop stays async. Throughput/MFU scalars come from the
+    paired MetricsLogger when one is given (avoids double-fetching)."""
+
+    def __init__(self, logdir: str, every_n: int = 100,
+                 metrics_logger: "MetricsLogger | None" = None):
+        self.logdir = logdir
+        self.every_n = every_n
+        self.metrics_logger = metrics_logger
+        self._writer = None
+
+    def on_train_start(self, trainer):
+        if cluster.is_chief():
+            from tensorboardX import SummaryWriter as TBWriter
+
+            self._writer = TBWriter(self.logdir)
+
+    def on_step_end(self, trainer, step, metrics):
+        if self._writer is None or step % self.every_n != 0:
+            return
+        if self.metrics_logger is not None and self.metrics_logger.last:
+            scalars = dict(self.metrics_logger.last)
+        else:
+            scalars = {k: float(np.asarray(v)) for k, v in metrics.items()}
+        for k, v in scalars.items():
+            self._writer.add_scalar(f"train/{k}", v, global_step=step)
+
+    def on_train_end(self, trainer):
+        if self._writer is not None:
+            self._writer.flush()
+            self._writer.close()
+            self._writer = None
+
+
 class NaNGuard(Callback):
     """NanTensorHook (:761): stop (or raise) when the step reports non-finite
     loss/grads. Reads the on-device `grads_finite`/`loss` signals the step

@@ -20,6 +20,7 @@ checkpoint-restart (SURVEY.md §5.3).
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import signal
@@ -48,6 +49,10 @@ class CheckpointConfig:
     # where gradients poison the params at step N but the loss — NaNGuard's
     # only signal when debug metrics are off — stays finite until N+1.
     validate_before_save: bool = True
+    # Write a checksummed MANIFEST.dtf (native CRC IO, runtime/io.py) into
+    # each completed step dir and verify it before restore — the reference
+    # Saver's C++ IO-kernel integrity discipline ($TF saver.py:642).
+    write_manifest: bool = True
     # Multi-host preemption agreement runs every N steps (a host-side
     # allgather; every step would serialize hosts). A preempted host waits
     # at most N steps before the coordinated save — keep N·step_time well
@@ -97,6 +102,7 @@ class Checkpointer:
             os.path.abspath(os.path.expanduser(cfg.directory)), options=options
         )
         self._finite_check = None
+        self._manifest_threads: list[threading.Thread] = []
 
     # -- save -------------------------------------------------------------
     def maybe_save(self, step: int, state: Any) -> bool:
@@ -169,7 +175,85 @@ class Checkpointer:
         )
         if saved and cluster.is_chief():
             logger.info("checkpoint saved at step %d", step)
+        if saved and self.cfg.write_manifest and cluster.is_chief():
+            self._manifest_threads = [
+                t for t in self._manifest_threads if t.is_alive()
+            ]
+            if self.cfg.async_save:
+                # manifest can only cover files that exist: wait for the
+                # async commit on a side thread, then stamp the step dir
+                t = threading.Thread(
+                    target=self._manifest_after_commit, args=(step,),
+                    daemon=True, name=f"ckpt-manifest-{step}",
+                )
+                t.start()
+                self._manifest_threads.append(t)
+            else:
+                self._write_manifest(step)
         return saved
+
+    # -- native CRC manifest (runtime/io.py integration) -------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(
+            os.path.abspath(os.path.expanduser(self.cfg.directory)), str(step)
+        )
+
+    def _manifest_after_commit(self, step: int) -> None:
+        try:
+            self.manager.wait_until_finished()
+            self._write_manifest(step)
+        except Exception:  # never kill the train loop from this thread
+            logger.exception("manifest write for step %d failed", step)
+
+    def _write_manifest(self, step: int) -> None:
+        """List every committed file of the step dir into MANIFEST.dtf,
+        written through the checksummed atomic native IO (runtime/io.py:
+        payload + [magic|len|CRC32] trailer, tmp+fsync+rename). Chief-only;
+        on multi-host it records the files visible on the chief's
+        filesystem at commit time."""
+        from ..runtime import io as io_lib
+
+        d = self._step_dir(step)
+        if not os.path.isdir(d):
+            return
+        files = []
+        for root, _, names in os.walk(d):
+            for n in sorted(names):
+                if n == "MANIFEST.dtf" or n.endswith(".tmp"):
+                    continue
+                p = os.path.join(root, n)
+                files.append({
+                    "path": os.path.relpath(p, d),
+                    "bytes": os.path.getsize(p),
+                })
+        payload = json.dumps({"step": step, "files": files}).encode()
+        io_lib.write_payload(os.path.join(d, "MANIFEST.dtf"), payload)
+
+    def verify_manifest(self, step: int) -> bool | None:
+        """CRC-verify MANIFEST.dtf and check every listed file exists with
+        the recorded size. Returns None when no manifest exists (pre-manifest
+        checkpoint — allowed), True when intact; raises OSError on a corrupt
+        manifest or missing/resized shard."""
+        from ..runtime import io as io_lib
+
+        d = self._step_dir(step)
+        path = os.path.join(d, "MANIFEST.dtf")
+        if not os.path.exists(path):
+            return None
+        manifest = json.loads(io_lib.read_payload(path))  # raises on bad CRC
+        for entry in manifest["files"]:
+            p = os.path.join(d, entry["path"])
+            if not os.path.exists(p):
+                raise OSError(
+                    f"checkpoint step {step}: missing shard {entry['path']}"
+                )
+            size = os.path.getsize(p)
+            if size != entry["bytes"]:
+                raise OSError(
+                    f"checkpoint step {step}: shard {entry['path']} is "
+                    f"{size} bytes, manifest says {entry['bytes']}"
+                )
+        return True
 
     def save_config(self, cfg_obj: Any) -> None:
         """Serialize the run config next to checkpoints (SURVEY.md §5.6
@@ -185,6 +269,11 @@ class Checkpointer:
 
     def wait(self) -> None:
         self.manager.wait_until_finished()
+        for t in self._manifest_threads:
+            t.join(timeout=60)
+        self._manifest_threads = [
+            t for t in self._manifest_threads if t.is_alive()
+        ]
 
     # -- restore ----------------------------------------------------------
     def latest_step(self) -> int | None:
@@ -203,6 +292,8 @@ class Checkpointer:
             step = self.latest_step()
         if step is None:
             return None
+        if self.cfg.write_manifest:
+            self.verify_manifest(step)  # raises before a corrupt restore
         if self.spec_tree is not None:
             target = jax.tree.map(
                 lambda s, spec: jax.ShapeDtypeStruct(
@@ -220,6 +311,10 @@ class Checkpointer:
         return state
 
     def close(self) -> None:
+        # Drain pending async commits AND their manifest stampers first —
+        # otherwise the daemon manifest thread dies with the process and the
+        # final checkpoint silently lacks its integrity manifest.
+        self.wait()
         self.manager.close()
 
 

@@ -68,16 +68,8 @@ class Trainer:
 
     # -- data -------------------------------------------------------------
     def put_batch(self, batch: Any) -> Any:
-        """Host batch (this process's shard of the global batch) → sharded
-        global device array over (data, fsdp). Replaces per-worker
-        Dataset.shard-by-task_index feeding (SURVEY.md §2a)."""
-        shardings = jax.tree.map(
-            lambda x: NamedSharding(self.mesh, sh.batch_spec(x.ndim)), batch
-        )
-        return jax.tree.map(
-            lambda x, s: jax.make_array_from_process_local_data(s, x),
-            batch, shardings,
-        )
+        """Host batch → sharded global device array (sharding.put_host_batch)."""
+        return sh.put_host_batch(self.mesh, batch)
 
     # -- loop -------------------------------------------------------------
     def fit(

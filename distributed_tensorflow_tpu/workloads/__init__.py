@@ -10,7 +10,15 @@ from __future__ import annotations
 
 import importlib
 
-from .runner import RunConfig, RunResult, TrainSection, WorkloadParts, evaluate, run
+from .runner import (
+    RunConfig,
+    RunResult,
+    TrainSection,
+    WorkloadParts,
+    evaluate,
+    evaluate_from_checkpoint,
+    run,
+)
 
 _REGISTRY: dict[str, str] = {
     # name -> module (BASELINE.json:7-11 order)
@@ -47,3 +55,17 @@ def run_workload(name: str, overrides: list[str] | None = None,
     if overrides:
         cfg = config_lib.apply_overrides(cfg, overrides)
     return run(cfg, mod.build, **run_kwargs)
+
+
+def eval_workload(name: str, overrides: list[str] | None = None,
+                  **eval_kwargs) -> dict:
+    """Standalone eval-from-checkpoint entry (SURVEY.md §3.5): restores
+    the latest checkpoint in --checkpoint.directory and evaluates, without
+    training."""
+    from ..utils import config as config_lib
+
+    mod = get(name)
+    cfg = mod.default_config()
+    if overrides:
+        cfg = config_lib.apply_overrides(cfg, overrides)
+    return evaluate_from_checkpoint(cfg, mod.build, **eval_kwargs)

@@ -47,6 +47,9 @@ class TrainSection:
     eval_batches: int = 16
     profile: bool = False
     profile_dir: str = "/tmp/dtf_tpu_profile"
+    # Non-empty = write TensorBoard scalar event files there (chief-only,
+    # log_every cadence) — the reference's SummarySaverHook surface.
+    summary_dir: str = ""
     # Adds grad_norm + grads_finite to the step metrics — an extra pass over
     # every gradient leaf per step; off in production (PERF_NOTES.md).
     debug_metrics: bool = False
@@ -125,6 +128,12 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
         history=True,
     )
     callbacks: list[cb.Callback] = [metrics_logger, cb.NaNGuard()]
+    if cfg.train.summary_dir:
+        # after metrics_logger so `last` is fresh at shared cadence
+        callbacks.append(cb.SummaryWriter(
+            cfg.train.summary_dir, every_n=cfg.train.log_every,
+            metrics_logger=metrics_logger,
+        ))
     if ckpt is not None:
         callbacks.append(cb.CheckpointCallback(ckpt))
     if cfg.train.profile:
@@ -161,11 +170,11 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
     return RunResult(state, metrics_logger.history, eval_metrics, mesh)
 
 
-def evaluate(trainer: Trainer, parts: WorkloadParts, num_batches: int) -> dict:
-    """Eval from current state — the reference ran eval single-process from
-    the latest checkpoint (SURVEY.md §3.5); here it shares the mesh and
-    runs sharded. The jitted eval step is cached on parts so repeated
-    mid-train evals don't retrace."""
+def _run_eval(state: Any, put_batch: Callable, parts: WorkloadParts,
+              num_batches: int) -> dict:
+    """Shared eval loop: sums the eval_fn's summed metrics over the eval
+    split and derives accuracy/loss. The jitted eval step is cached on
+    parts so repeated mid-train evals don't retrace."""
     if parts._jit_eval is None:
         parts._jit_eval = jax.jit(make_eval_step(parts.eval_fn))
     eval_step = parts._jit_eval
@@ -173,7 +182,7 @@ def evaluate(trainer: Trainer, parts: WorkloadParts, num_batches: int) -> dict:
     import itertools
 
     for batch in itertools.islice(parts.eval_dataset_fn(num_batches), num_batches):
-        out = eval_step(trainer.state, trainer.put_batch(batch))
+        out = eval_step(state, put_batch(batch))
         for k, v in out.items():
             totals[k] = totals.get(k, 0.0) + float(np.asarray(v))
     result = dict(totals)
@@ -182,6 +191,55 @@ def evaluate(trainer: Trainer, parts: WorkloadParts, num_batches: int) -> dict:
     if "loss_sum" in totals and totals.get("count"):
         result["loss"] = totals["loss_sum"] / totals["count"]
     return result
+
+
+def evaluate(trainer: Trainer, parts: WorkloadParts, num_batches: int) -> dict:
+    """Eval from live trainer state; shares the mesh and runs sharded."""
+    return _run_eval(trainer.state, trainer.put_batch, parts, num_batches)
+
+
+def evaluate_from_checkpoint(
+    cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
+    num_batches: int | None = None,
+) -> dict:
+    """Standalone eval-from-checkpoint — no Trainer (SURVEY.md §3.5: the
+    reference ran eval single-process from `latest_checkpoint`,
+    $TF checkpoint_management.py:329). Restores the latest (or ``step``)
+    checkpoint from cfg.checkpoint.directory, runs classification_eval_fn
+    over the eval split, returns the metric dict."""
+    if not cfg.checkpoint.directory:
+        raise ValueError("evaluate_from_checkpoint needs checkpoint.directory")
+    cluster.initialize()
+    mesh = build_mesh(cfg.mesh)
+    parts = build(cfg, mesh)
+    if parts.eval_fn is None or parts.eval_dataset_fn is None:
+        raise ValueError(f"workload {cfg.workload!r} has no eval surface")
+
+    tx = make_optimizer(cfg.optimizer)
+    ckpt = Checkpointer(cfg.checkpoint, mesh)
+    try:
+        state, _, restored = init_or_restore(
+            ckpt, parts.init_fn, tx, mesh, jax.random.PRNGKey(cfg.train.seed),
+            param_rules=parts.param_rules, fsdp=parts.fsdp,
+        )
+        if not restored:
+            raise FileNotFoundError(
+                f"no checkpoint found in {cfg.checkpoint.directory}"
+            )
+
+        from ..parallel import sharding as sh
+
+        n = num_batches if num_batches is not None else cfg.train.eval_batches
+        metrics = _run_eval(
+            state, lambda b: sh.put_host_batch(mesh, b), parts, n
+        )
+        metrics["step"] = int(state.step)
+        if cluster.is_chief():
+            logger.info("eval from checkpoint @ step %d: %s",
+                        int(state.step), metrics)
+        return metrics
+    finally:
+        ckpt.close()
 
 
 class _EvalCallback(cb.Callback):

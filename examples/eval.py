@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Standalone eval entry point — restore the latest checkpoint and report
+metrics without training (SURVEY.md §3.5: the reference ran eval
+single-process from `latest_checkpoint`, $TF checkpoint_management.py:329).
+
+Usage:
+    python examples/eval.py mnist_mlp --checkpoint.directory=/tmp/ck
+    python examples/eval.py resnet50_imagenet \
+        --checkpoint.directory=/ckpts/run1 --train.eval_batches=64
+"""
+
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from distributed_tensorflow_tpu import workloads
+
+
+def main(argv: list[str]) -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s: %(message)s",
+        datefmt="%H:%M:%S",
+        force=True,
+    )
+    if not argv or argv[0].startswith("-"):
+        print(f"usage: eval.py <workload> --checkpoint.directory=... "
+              f"[--section.key=value ...]\n"
+              f"workloads: {', '.join(workloads.available())}")
+        raise SystemExit(2)
+    name, overrides = argv[0], [a for a in argv[1:] if a.startswith("--")]
+    metrics = workloads.eval_workload(name, overrides)
+    print(f"eval: {metrics}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -2,6 +2,7 @@
 fake devices, CIFAR-CNN sync-DP smoke — the M6 'smallest thing that proves
 the framework'."""
 
+import numpy as np
 import pytest
 
 from distributed_tensorflow_tpu import workloads
@@ -106,3 +107,58 @@ def test_mnist_grad_accum_runs():
         ],
     )
     assert int(result.state.step) == 4
+
+
+def test_summary_event_files_written(tmp_path):
+    """SummarySaverHook analog (SURVEY.md §5.5): a short fit with
+    train.summary_dir set leaves TensorBoard scalar events on disk."""
+    logdir = str(tmp_path / "tb")
+    workloads.run_workload(
+        "mnist_mlp",
+        [
+            "--train.num_steps=6",
+            "--train.log_every=2",
+            f"--train.summary_dir={logdir}",
+            "--data.global_batch_size=64",
+        ],
+    )
+    from tensorboard.backend.event_processing import event_accumulator
+
+    acc = event_accumulator.EventAccumulator(logdir)
+    acc.Reload()
+    tags = acc.Tags()["scalars"]
+    assert "train/loss" in tags, tags
+    assert "train/steps_per_sec" in tags, tags
+    events = acc.Scalars("train/loss")
+    assert len(events) >= 2
+    assert all(np.isfinite(e.value) for e in events)
+    steps = [e.step for e in events]
+    assert steps == sorted(steps)
+
+
+def test_eval_from_checkpoint_matches_live(tmp_path):
+    """SURVEY.md §3.5: train 3 steps + save, then evaluate from disk with
+    no Trainer; numbers must match the live eval at train end."""
+    ckdir = str(tmp_path / "ck")
+    args = [
+        "--train.num_steps=3",
+        "--train.log_every=2",
+        "--train.eval_batches=2",
+        "--data.global_batch_size=64",
+        f"--checkpoint.directory={ckdir}",
+        "--checkpoint.save_interval_steps=1",
+        "--checkpoint.async_save=false",
+    ]
+    live = workloads.run_workload("mnist_mlp", args)
+    assert live.eval_metrics is not None
+    offline = workloads.eval_workload("mnist_mlp", args)
+    assert offline["step"] == 3
+    assert abs(offline["accuracy"] - live.eval_metrics["accuracy"]) < 1e-6
+    assert abs(offline["loss"] - live.eval_metrics["loss"]) < 1e-5
+
+
+def test_eval_from_checkpoint_missing_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+        workloads.eval_workload("mnist_mlp", [
+            f"--checkpoint.directory={tmp_path / 'empty'}",
+        ])

@@ -17,24 +17,37 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
-# name: BENCH_* env overrides
+# Every variant pins ALL knobs explicitly (never inherits ambient BENCH_*
+# from the operator's shell), and the pinned values are echoed into the
+# output row, so a sweep can't be silently mislabeled.
+_KNOBS = ("BENCH_STEM", "BENCH_NORM_DTYPE", "BENCH_DEBUG_METRICS",
+          "BENCH_BATCH", "BENCH_STEPS")
+
+
+def _variant(stem="space_to_depth", norm="bfloat16", dbg="0", batch="256",
+             steps="20"):
+    return {"BENCH_STEM": stem, "BENCH_NORM_DTYPE": norm,
+            "BENCH_DEBUG_METRICS": dbg, "BENCH_BATCH": batch,
+            "BENCH_STEPS": steps}
+
+
 VARIANTS = {
-    "r1_baseline": {"BENCH_STEM": "conv", "BENCH_NORM_DTYPE": "float32",
-                    "BENCH_DEBUG_METRICS": "1"},
-    "no_metrics": {"BENCH_STEM": "conv", "BENCH_NORM_DTYPE": "float32"},
-    "bf16_bn": {"BENCH_STEM": "conv"},
-    "s2d_f32bn": {"BENCH_NORM_DTYPE": "float32"},
-    "combo256": {},  # the bench default config
-    "combo384": {"BENCH_BATCH": "384"},
-    "combo512": {"BENCH_BATCH": "512"},
-    "combo1024": {"BENCH_BATCH": "1024"},
+    "r1_baseline": _variant(stem="conv", norm="float32", dbg="1"),
+    "no_metrics": _variant(stem="conv", norm="float32"),
+    "bf16_bn": _variant(stem="conv"),
+    "s2d_f32bn": _variant(norm="float32"),
+    "combo256": _variant(),  # == the bench default config
+    "combo384": _variant(batch="384"),
+    "combo512": _variant(batch="512"),
+    "combo1024": _variant(batch="1024"),
 }
 
 
 def main() -> None:
     names = sys.argv[1:] or list(VARIANTS)
     for name in names:
-        env = {**os.environ, **VARIANTS[name]}
+        env = {k: v for k, v in os.environ.items() if k not in _KNOBS}
+        env.update(VARIANTS[name])
         proc = subprocess.run(
             [sys.executable, BENCH], env=env, capture_output=True, text=True
         )
