@@ -1,0 +1,100 @@
+"""Host-side image augmentation for the input pipeline (SURVEY.md §7 M7).
+
+The reference's input path ran per-worker tf.data with decode + random
+crop/flip before feeding (SURVEY.md §2a 'Input pipeline'). Augmentation
+stays on the HOST here by design: TPU steps are lockstep SPMD programs and
+per-image branching (crop offsets, flips) belongs on the CPU where it
+overlaps with device compute via the Prefetcher; the device sees only
+dense, statically-shaped batches.
+
+All randomness flows through a caller-provided ``np.random.RandomState``
+seeded per (seed, batch_index) — the pipeline's resume contract: restoring
+at step N reproduces exactly the augmented batches N, N+1, ... that the
+uninterrupted run saw.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def random_crop_flip(
+    images: np.ndarray, rng: np.random.RandomState, *, padding: int = 4
+) -> np.ndarray:
+    """CIFAR-style train augmentation: zero-pad by ``padding``, take a
+    random H×W crop per image, then horizontally flip half of them.
+    Vectorized over the batch (one gather + one masked flip)."""
+    b, h, w, c = images.shape
+    padded = np.pad(
+        images, ((0, 0), (padding, padding), (padding, padding), (0, 0))
+    )
+    ys = rng.randint(0, 2 * padding + 1, b)
+    xs = rng.randint(0, 2 * padding + 1, b)
+    windows = np.lib.stride_tricks.sliding_window_view(
+        padded, (h, w), axis=(1, 2)
+    )  # [B, 2p+1, 2p+1, C, H, W]
+    out = windows[np.arange(b), ys, xs]  # [B, C, H, W]
+    out = np.ascontiguousarray(np.moveaxis(out, 1, -1))  # [B, H, W, C]
+    flips = rng.rand(b) < 0.5
+    out[flips] = out[flips, :, ::-1]
+    return out
+
+
+def random_resized_crop(
+    image: np.ndarray, rng: np.random.RandomState, out_size: int,
+    *, scale: tuple[float, float] = (0.08, 1.0),
+    ratio: tuple[float, float] = (3 / 4, 4 / 3), attempts: int = 10,
+) -> np.ndarray:
+    """ImageNet-style train augmentation for ONE [H, W, C] uint8 image:
+    sample an area/aspect crop (Inception recipe), resize to
+    out_size×out_size (PIL bilinear). Falls back to a center crop when no
+    sample fits."""
+    from PIL import Image
+
+    h, w = image.shape[:2]
+    area = h * w
+    for _ in range(attempts):
+        target_area = area * rng.uniform(*scale)
+        log_ratio = np.log(ratio)
+        aspect = np.exp(rng.uniform(*log_ratio))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            y = rng.randint(0, h - ch + 1)
+            x = rng.randint(0, w - cw + 1)
+            crop = image[y:y + ch, x:x + cw]
+            break
+    else:
+        crop = center_crop(image, min(h, w))
+    pil = Image.fromarray(crop)
+    pil = pil.resize((out_size, out_size), Image.BILINEAR)
+    return np.asarray(pil)
+
+
+def center_crop(image: np.ndarray, size: int) -> np.ndarray:
+    """Eval-side deterministic crop of ONE [H, W, C] image."""
+    h, w = image.shape[:2]
+    y = max(0, (h - size) // 2)
+    x = max(0, (w - size) // 2)
+    return image[y:y + size, x:x + size]
+
+
+def resize_center_crop(
+    image: np.ndarray, out_size: int, *, resize_frac: float = 0.875
+) -> np.ndarray:
+    """Eval ImageNet recipe: resize short side to out_size/resize_frac,
+    then center-crop out_size×out_size."""
+    from PIL import Image
+
+    h, w = image.shape[:2]
+    short = int(round(out_size / resize_frac))
+    if h < w:
+        nh, nw = short, max(short, int(round(w * short / h)))
+    else:
+        nh, nw = max(short, int(round(h * short / w))), short
+    pil = Image.fromarray(image).resize((nw, nh), Image.BILINEAR)
+    return center_crop(np.asarray(pil), out_size)
+
+
+def hflip(image: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+    return image[:, ::-1] if rng.rand() < 0.5 else image

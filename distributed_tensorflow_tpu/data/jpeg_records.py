@@ -1,0 +1,134 @@
+"""JPEG record container + decode/augment dataset — the real-ImageNet path.
+
+The reference consumed per-worker TFRecords of JPEG bytes and decoded with
+tf.data on each worker's host (SURVEY.md §2a 'Input pipeline'). Here the
+container is two flat files the host can mmap:
+
+- ``<path>.dat`` — concatenated raw JPEG streams
+- ``<path>.idx`` — N × [u64 offset, u64 length, i64 label] little-endian
+
+Fixed 24-byte index entries make sharding/shuffling O(1) per record with
+no per-record framing in the data file (same design driver as the dense
+record loader, data/records.py). Decode (PIL) + random-resized-crop/flip
+augmentation run in a host thread pool and overlap device compute through
+the Prefetcher.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+
+import numpy as np
+
+from . import augment
+
+_ENTRY = np.dtype([("offset", "<u8"), ("length", "<u8"), ("label", "<i8")])
+
+
+def make_jpeg_record_file(
+    path: str, images: np.ndarray, labels: np.ndarray, *, quality: int = 90
+) -> int:
+    """Encode [N, H, W, 3] uint8 images as JPEGs into <path>.dat/.idx
+    (test/tooling path — real datasets are converted offline). Returns N."""
+    import io
+
+    from PIL import Image
+
+    entries = np.empty(len(images), _ENTRY)
+    with open(path + ".dat", "wb") as f:
+        off = 0
+        for i, (img, lab) in enumerate(zip(images, labels)):
+            buf = io.BytesIO()
+            Image.fromarray(np.asarray(img, np.uint8)).save(
+                buf, "JPEG", quality=quality
+            )
+            raw = buf.getvalue()
+            f.write(raw)
+            entries[i] = (off, len(raw), int(lab))
+            off += len(raw)
+    entries.tofile(path + ".idx")
+    return len(images)
+
+
+class JpegClassificationDataset:
+    """Iterable of {"image" f32 [B,S,S,3] in [0,1], "label" i32 [B]}
+    batches from a JPEG record pair. Per-host sharded (strided over the
+    epoch shuffle, like NpzDataset), resumable via ``index_offset``,
+    decode+augment parallel across a thread pool.
+
+    ``train=True``: random-resized-crop to ``image_size`` + horizontal
+    flip (the ImageNet recipe); ``train=False``: resize + center crop.
+    """
+
+    def __init__(self, path: str, image_size: int, global_batch_size: int,
+                 *, seed: int = 0, train: bool = True,
+                 num_batches: int | None = None, index_offset: int = 0,
+                 n_threads: int | None = None):
+        import jax
+
+        from .pipeline import local_batch_size
+
+        self.path = path
+        self.image_size = image_size
+        self.seed = seed
+        self.train = train
+        self.num_batches = num_batches
+        self.index_offset = index_offset
+        self.local_bs = local_batch_size(global_batch_size)
+        self.entries = np.fromfile(path + ".idx", _ENTRY)
+        if not len(self.entries):
+            raise ValueError(f"{path}.idx is empty")
+        self._data = np.memmap(path + ".dat", np.uint8, "r")
+        self._shard = jax.process_index()
+        self._n_shards = jax.process_count()
+        self._pool = cf.ThreadPoolExecutor(
+            max_workers=n_threads or min(16, os.cpu_count() or 4)
+        )
+
+    def _batches_per_epoch(self) -> int:
+        n = len(self.entries) // self._n_shards
+        return max(n // self.local_bs, 1)
+
+    def _decode_one(self, entry, rng_seed: int) -> np.ndarray:
+        import io
+
+        from PIL import Image
+
+        raw = self._data[entry["offset"]: entry["offset"] + entry["length"]]
+        img = np.asarray(Image.open(io.BytesIO(raw.tobytes())).convert("RGB"))
+        rng = np.random.RandomState(rng_seed & 0x7FFFFFFF)
+        if self.train:
+            img = augment.random_resized_crop(img, rng, self.image_size)
+            img = augment.hflip(img, rng)
+        else:
+            img = augment.resize_center_crop(img, self.image_size)
+        return img
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        index += self.index_offset
+        bpe = self._batches_per_epoch()
+        epoch, pos = divmod(index, bpe)
+        order = np.arange(len(self.entries))
+        if self.train:
+            np.random.RandomState(self.seed + epoch).shuffle(order)
+        order = order[self._shard:: self._n_shards]
+        idx = order[pos * self.local_bs: (pos + 1) * self.local_bs]
+        entries = self.entries[idx]
+        # per-image seeds: deterministic in (seed, global batch index, slot)
+        seeds = [
+            (self.seed * 1_000_003 + index) * 131 + int(i) for i in idx
+        ]
+        images = list(self._pool.map(self._decode_one, entries, seeds))
+        img = np.stack(images).astype(np.float32)
+        img *= 1.0 / 255.0
+        return {
+            "image": img,
+            "label": entries["label"].astype(np.int32),
+        }
+
+    def __iter__(self):
+        i = 0
+        while self.num_batches is None or i < self.num_batches:
+            yield self.batch(i)
+            i += 1

@@ -23,13 +23,18 @@ import numpy as np
 
 @dataclasses.dataclass(frozen=True)
 class DataConfig:
-    dataset: str = "synthetic"  # synthetic | npz:<path> | records:<path>
+    # synthetic | npz:<path> | records:<path> | jpeg:<path>
+    dataset: str = "synthetic"
     global_batch_size: int = 128
     image_size: int = 28
     channels: int = 1
     num_classes: int = 10
     seed: int = 0
     flat: bool = False  # emit (N, H*W*C) instead of (N, H, W, C)
+    # Train-time host augmentation (data/augment.py): "none" | "crop_flip"
+    # (pad-4 random crop + hflip, the CIFAR recipe; the jpeg: path always
+    # runs the ImageNet random-resized-crop recipe instead).
+    augment: str = "none"
 
 
 def batch_rng(seed: int, index: int) -> np.random.RandomState:
@@ -147,7 +152,18 @@ def make_dataset(cfg: DataConfig, num_batches: int | None = None,
             (cfg.image_size, cfg.image_size, cfg.channels),
             cfg.global_batch_size, seed=cfg.seed,
             num_batches=num_batches, index_offset=index_offset,
-            flat=cfg.flat,
+            flat=cfg.flat, augment=cfg.augment,
+        )
+    if cfg.dataset.startswith("jpeg:"):
+        from .jpeg_records import JpegClassificationDataset
+
+        # Train-mode stream (shuffled, random-resized-crop). Eval callers
+        # construct JpegClassificationDataset(train=False) directly on a
+        # held-out record pair.
+        return JpegClassificationDataset(
+            cfg.dataset[len("jpeg:"):], cfg.image_size,
+            cfg.global_batch_size, seed=cfg.seed,
+            num_batches=num_batches, index_offset=index_offset,
         )
     raise ValueError(f"Unknown dataset '{cfg.dataset}'")
 

@@ -37,13 +37,19 @@ class RecordClassificationDataset:
                  global_batch_size: int, *, seed: int = 0,
                  num_batches: int | None = None, index_offset: int = 0,
                  n_threads: int = 4, use_native: bool | None = None,
-                 flat: bool = False):
+                 flat: bool = False, augment: str = "none"):
         import jax
 
         from .pipeline import local_batch_size
 
         self.image_shape = tuple(image_shape)
         self.flat = flat  # emit (B, H·W·C) — the DataConfig.flat contract
+        if augment not in ("none", "crop_flip"):
+            raise ValueError(f"Unknown augment mode {augment!r}")
+        if augment == "crop_flip" and (flat or len(image_shape) != 3):
+            raise ValueError("crop_flip needs [H, W, C] images (flat=False)")
+        self.augment = augment
+        self.seed = seed
         img_bytes = int(np.prod(image_shape))
         self.loader = RecordFileLoader(
             path, img_bytes + 4, local_batch_size(global_batch_size),
@@ -53,10 +59,20 @@ class RecordClassificationDataset:
             num_batches=num_batches, use_native=use_native,
         )
 
-    def _decode(self, raw: np.ndarray):
-        img = raw[:, :-4].astype(np.float32)
+    def _decode(self, raw: np.ndarray, batch_index: int = 0):
+        img = raw[:, :-4]
         if not self.flat:
             img = img.reshape(-1, *self.image_shape)
+        if self.augment == "crop_flip":
+            # deterministic per (seed, batch index): resume at step N
+            # reproduces batch N's augmentation exactly
+            from . import augment as aug
+
+            rng = np.random.RandomState(
+                (self.seed * 1_000_003 + batch_index) & 0x7FFFFFFF
+            )
+            img = aug.random_crop_flip(img, rng)
+        img = img.astype(np.float32)
         img *= 1.0 / 255.0
         label = raw[:, -4:].copy().view(np.int32)[:, 0]
         return {"image": img, "label": label}

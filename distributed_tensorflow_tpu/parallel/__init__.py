@@ -16,6 +16,7 @@ from .mesh import (  # noqa: F401
     MeshSpec,
     build_mesh,
     describe,
+    factor_mesh_axis,
     mesh_axis_size,
     single_device_mesh,
 )

@@ -40,6 +40,11 @@ class ClusterConfig:
     num_processes: int | None = None
     process_id: int | None = None
     local_device_ids: tuple[int, ...] | None = None
+    # "auto" (default): argless jax.distributed.initialize() when TPU-pod
+    # environment markers are present (the TPUClusterResolver analog,
+    # $TF tpu_cluster_resolver.py:95 — metadata autodetection); "always":
+    # force argless init; "never": only explicit/env-configured init.
+    auto_detect: str = "auto"
 
 
 def initialize(config: ClusterConfig | None = None) -> None:
@@ -68,12 +73,41 @@ def initialize(config: ClusterConfig | None = None) -> None:
             process_id=config.process_id,
             local_device_ids=config.local_device_ids,
         )
-        logger.info(
-            "jax.distributed initialized: process %d/%d, %d local / %d global devices",
-            jax.process_index(), jax.process_count(),
-            jax.local_device_count(), jax.device_count(),
-        )
+        _log_topology()
+    elif config.auto_detect == "always" or (
+        config.auto_detect == "auto" and _on_multihost_tpu_pod()
+    ):
+        # Pod-idiomatic path: argless initialize lets jax's cluster
+        # autodetection (GCE/TPU metadata) discover coordinator + peers —
+        # the TPUClusterResolver analog. Never triggered on single-host
+        # TPU-VMs or CPU test rigs.
+        jax.distributed.initialize()
+        _log_topology()
     _initialized = True
+
+
+def _on_multihost_tpu_pod() -> bool:
+    """True when env markers say this process is one worker of a multi-host
+    Cloud-TPU pod slice. `TPU_WORKER_HOSTNAMES` lists every peer host of
+    the slice (set by the TPU runtime); more than one entry means
+    multi-host, where argless jax.distributed.initialize is both safe and
+    required for a global jax.devices() view."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if "," in hostnames:
+        return True
+    # Multislice (MEGASCALE) deployments always need the coordination
+    # service, even with one host per slice.
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        return True
+    return False
+
+
+def _log_topology() -> None:
+    logger.info(
+        "jax.distributed initialized: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
 
 
 def process_index() -> int:

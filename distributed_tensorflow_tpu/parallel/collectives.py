@@ -39,11 +39,16 @@ def _group_mask(axis: str, groups) -> jax.Array:
 
     ``shard_map`` does not lower ``axis_index_groups`` (JAX 0.9), so grouped
     collectives are emulated: gather the full axis, then reduce the members
-    of this device's group. Correct for any uniform partition of the axis;
-    when a subgroup pattern is *structural* (e.g. per-slice reductions),
-    prefer factoring it into its own mesh axis — that is the idiomatic
-    TPU-native form of the reference's NCCL communicator subgroups /
-    CrossReplicaSum ``group_assignment`` ($TF tpu_ops.py:32-40)."""
+    of this device's group — O(axis) wire traffic for O(group) semantics.
+    Use this ONLY for ad-hoc/irregular groups. When a subgroup pattern is
+    *structural* (contiguous blocks — per-slice reductions, per-replica
+    shards), use ``mesh.factor_mesh_axis`` to split the axis into named
+    sub-axes and run the collective on one sub-axis: XLA then emits a true
+    subgroup collective with no full-axis gather (asserted in
+    tests/test_collectives.py::test_factored_axis_avoids_full_gather).
+    That is the idiomatic TPU-native form of the reference's NCCL
+    communicator subgroups / CrossReplicaSum ``group_assignment``
+    ($TF tpu_ops.py:32-40)."""
     n = lax.axis_size(axis)
     groups_arr = jnp.asarray(groups)  # (G, M), a partition of range(n)
     g = groups_arr.shape[0]

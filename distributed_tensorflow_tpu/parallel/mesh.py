@@ -64,12 +64,31 @@ class MeshSpec:
     seq: int = 1
     expert: int = 1
     model: int = 1
+    # DCN (inter-slice) factors for multislice pods (BASELINE.json:10
+    # "pod-scale"): the TOTAL size of an axis is its ICI part × its DCN
+    # part. E.g. data=8, dcn_data=2 → each of 2 slices holds 4-way ICI
+    # data parallelism, and the gradient psum's final hop rides DCN.
+    # Only axes whose collectives tolerate DCN latency (data/pipe grad
+    # reduction, not per-layer TP) get dcn_* knobs — the
+    # mesh_utils.create_hybrid_device_mesh recipe.
+    dcn_data: int = 1
+    dcn_pipe: int = 1
 
     def sizes(self) -> dict[str, int]:
         return {name: getattr(self, name) for name in AXIS_NAMES}
 
+    def dcn_sizes(self) -> dict[str, int]:
+        return {PIPE: self.dcn_pipe, DATA: self.dcn_data, FSDP: 1,
+                SEQ: 1, EXPERT: 1, MODEL: 1}
+
+    @property
+    def num_slices(self) -> int:
+        return self.dcn_data * self.dcn_pipe
+
     def resolve(self, n_devices: int) -> "MeshSpec":
-        """Fill in the single -1 axis so the product equals ``n_devices``."""
+        """Fill in the single -1 axis so the product equals ``n_devices``.
+        Axis fields are TOTALS (ICI × DCN); each must divide by its dcn_*
+        factor."""
         sizes = self.sizes()
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
@@ -87,13 +106,21 @@ class MeshSpec:
                 f"Mesh {sizes} needs {fixed} devices but {n_devices} are "
                 f"available"
             )
-        return MeshSpec(**sizes)
+        out = MeshSpec(**sizes, dcn_data=self.dcn_data, dcn_pipe=self.dcn_pipe)
+        for name, dcn in out.dcn_sizes().items():
+            if dcn > 1 and out.sizes()[name] % dcn != 0:
+                raise ValueError(
+                    f"axis {name}={out.sizes()[name]} not divisible by its "
+                    f"DCN factor dcn_{name}={dcn}"
+                )
+        return out
 
     @classmethod
     def from_dict(cls, d: Mapping[str, int]) -> "MeshSpec":
-        unknown = set(d) - set(AXIS_NAMES)
+        valid = set(AXIS_NAMES) | {"dcn_data", "dcn_pipe"}
+        unknown = set(d) - valid
         if unknown:
-            raise ValueError(f"Unknown mesh axes {unknown}; valid: {AXIS_NAMES}")
+            raise ValueError(f"Unknown mesh axes {unknown}; valid: {sorted(valid)}")
         return cls(**dict(d))
 
 
@@ -115,16 +142,50 @@ def build_mesh(
         spec = MeshSpec.from_dict(spec)
     spec = spec.resolve(len(devices))
     shape = tuple(spec.sizes()[name] for name in AXIS_NAMES)
-    try:
-        dev_array = mesh_utils.create_device_mesh(
-            shape, devices=np.asarray(devices, dtype=object)
-        )
-    except (ValueError, AssertionError, NotImplementedError):
-        # Fallback for topologies mesh_utils cannot optimize (e.g. CPU fake
-        # devices or single-chip): plain row-major reshape. Collective
-        # placement is still correct, just not hop-optimal.
-        dev_array = np.asarray(devices, dtype=object).reshape(shape)
+    if spec.num_slices > 1:
+        dev_array = _hybrid_device_array(spec, devices)
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=np.asarray(devices, dtype=object)
+            )
+        except (ValueError, AssertionError, NotImplementedError):
+            # Fallback for topologies mesh_utils cannot optimize (e.g. CPU
+            # fake devices or single-chip): plain row-major reshape.
+            # Collective placement is still correct, just not hop-optimal.
+            dev_array = np.asarray(devices, dtype=object).reshape(shape)
     return Mesh(dev_array, AXIS_NAMES)
+
+
+def _hybrid_device_array(spec: MeshSpec, devices: Sequence[jax.Device]) -> np.ndarray:
+    """Device array for a multislice ICI×DCN mesh (SURVEY.md §2d: ICI
+    within a slice, DCN between slices; the DeviceAssignment/Topology
+    analog, $TF device_assignment.py:70).
+
+    Per axis, the DCN factor is the OUTER sub-dimension: neighboring
+    indices along an axis stay on the same slice (ICI), and only the
+    outermost hop crosses DCN — so e.g. a gradient psum over `data`
+    reduces intra-slice first. Uses mesh_utils.create_hybrid_device_mesh
+    (slice-topology-aware) when device slice metadata exists; falls back
+    to a slice-major block construction for test rigs without it."""
+    totals = spec.sizes()
+    dcn = spec.dcn_sizes()
+    ici_shape = tuple(totals[a] // dcn[a] for a in AXIS_NAMES)
+    dcn_shape = tuple(dcn[a] for a in AXIS_NAMES)
+    np_devices = np.asarray(devices, dtype=object)
+    try:
+        return mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=np_devices
+        )
+    except (ValueError, AssertionError, NotImplementedError, KeyError):
+        # Fake-device fallback: jax.devices() is process-/slice-major, so
+        # reshape (dcn..., ici...) then interleave to put each axis's DCN
+        # part just outside its ICI part.
+        arr = np_devices.reshape(*dcn_shape, *ici_shape)
+        n = len(AXIS_NAMES)
+        perm = [k for pair in zip(range(n), range(n, 2 * n)) for k in pair]
+        arr = arr.transpose(perm)
+        return arr.reshape(tuple(totals[a] for a in AXIS_NAMES))
 
 
 def single_device_mesh(device: jax.Device | None = None) -> Mesh:
@@ -132,6 +193,49 @@ def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     if device is None:
         device = jax.devices()[0]
     return build_mesh(MeshSpec(data=1), [device])
+
+
+def factor_mesh_axis(
+    mesh: Mesh, axis: str, factors: Mapping[str, int]
+) -> Mesh:
+    """Split one named mesh axis into ordered sub-axes (outer → inner).
+
+    This is the API form of "structural subgroups get their own mesh axis"
+    (SURVEY.md §5.8; the TPU-native descendant of NCCL communicator
+    subgroups / CrossReplicaSum ``group_assignment``, $TF tpu_ops.py:32-40):
+    a collective over ONE sub-axis compiles to a true subgroup collective —
+    XLA emits an all-reduce over just those replica groups, no full-axis
+    gather — unlike the mask-emulated ``groups=`` path in
+    parallel/collectives.py, whose wire cost is the whole axis.
+
+    >>> sub = factor_mesh_axis(mesh, "data", {"replica": 2, "shard": 4})
+    >>> # inside shard_map over `sub`: lax.psum(x, "shard") reduces within
+    >>> # each group of 4; lax.psum(x, ("replica", "shard")) == old axis.
+
+    Device placement is unchanged — only the naming is refined, so
+    sub-axis groups are exactly the contiguous index blocks the emulated
+    path expresses as ``groups=[[0..k-1], [k..2k-1], ...]``.
+    """
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no axis {axis!r}: {mesh.axis_names}")
+    for name in factors:
+        if name in mesh.axis_names:
+            raise ValueError(f"sub-axis name {name!r} already in mesh")
+    size = mesh.shape[axis]
+    if math.prod(factors.values()) != size:
+        raise ValueError(
+            f"factors {dict(factors)} do not multiply to {axis}={size}"
+        )
+    idx = mesh.axis_names.index(axis)
+    new_shape = (
+        mesh.devices.shape[:idx]
+        + tuple(factors.values())
+        + mesh.devices.shape[idx + 1:]
+    )
+    new_names = (
+        mesh.axis_names[:idx] + tuple(factors) + mesh.axis_names[idx + 1:]
+    )
+    return Mesh(mesh.devices.reshape(new_shape), new_names)
 
 
 def mesh_axis_size(mesh: Mesh, axes: str | Sequence[str]) -> int:

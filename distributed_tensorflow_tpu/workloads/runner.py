@@ -59,6 +59,7 @@ class TrainSection:
 class RunConfig:
     workload: str = "mnist_mlp"
     model: Any = None  # workload-specific config dataclass, set by preset
+    cluster: cluster.ClusterConfig = cluster.ClusterConfig()
     mesh: MeshSpec = MeshSpec()
     data: DataConfig = DataConfig()
     optimizer: OptimizerConfig = OptimizerConfig()
@@ -97,7 +98,7 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
     """``build(cfg, mesh) -> WorkloadParts``: every workload takes the mesh
     (models embedding collective schedules — seq-parallel attention,
     pipeline stages — need it at construction; others ignore it)."""
-    cluster.initialize()
+    cluster.initialize(cfg.cluster)
     mesh = build_mesh(cfg.mesh)
     if cluster.is_chief():
         logger.info("mesh: %s", describe(mesh))
@@ -209,7 +210,7 @@ def evaluate_from_checkpoint(
     over the eval split, returns the metric dict."""
     if not cfg.checkpoint.directory:
         raise ValueError("evaluate_from_checkpoint needs checkpoint.directory")
-    cluster.initialize()
+    cluster.initialize(cfg.cluster)
     mesh = build_mesh(cfg.mesh)
     parts = build(cfg, mesh)
     if parts.eval_fn is None or parts.eval_dataset_fn is None:

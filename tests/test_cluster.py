@@ -1,0 +1,88 @@
+"""Cluster bootstrap: pod auto-detection + config plumbing (SURVEY.md §2b
+'Cluster bootstrap' row; VERDICT round-1 item 5 — the TPUClusterResolver
+analog must engage without hand-exported env vars)."""
+
+import pytest
+
+from distributed_tensorflow_tpu.parallel import cluster
+
+
+@pytest.fixture
+def fresh_cluster(monkeypatch):
+    """Reset the idempotence latch and capture initialize calls."""
+    calls = []
+
+    def fake_init(*a, **kw):
+        calls.append((a, kw))
+
+    monkeypatch.setattr(cluster, "_initialized", False)
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
+    yield calls
+    monkeypatch.setattr(cluster, "_initialized", False)
+
+
+def test_single_process_no_init(fresh_cluster):
+    cluster.initialize()
+    assert fresh_cluster == []
+
+
+def test_explicit_coordinator(fresh_cluster):
+    cluster.initialize(cluster.ClusterConfig(
+        coordinator_address="10.0.0.1:1234", num_processes=2, process_id=1,
+    ))
+    (a, kw), = fresh_cluster
+    assert kw["coordinator_address"] == "10.0.0.1:1234"
+    assert kw["num_processes"] == 2 and kw["process_id"] == 1
+
+
+def test_pod_markers_trigger_argless_init(fresh_cluster, monkeypatch):
+    """Multi-host TPU pod: TPU_WORKER_HOSTNAMES lists >1 peer → argless
+    initialize (metadata autodetection)."""
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-0,host-1,host-2,host-3")
+    cluster.initialize()
+    assert fresh_cluster == [((), {})]
+
+
+def test_single_host_tpu_vm_no_init(fresh_cluster, monkeypatch):
+    """One hostname (single-host TPU VM): no distributed init needed."""
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-0")
+    cluster.initialize()
+    assert fresh_cluster == []
+
+
+def test_megascale_marker_triggers_init(fresh_cluster, monkeypatch):
+    monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS", "coord:8080")
+    cluster.initialize()
+    assert fresh_cluster == [((), {})]
+
+
+def test_auto_detect_never(fresh_cluster, monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-0,host-1")
+    cluster.initialize(cluster.ClusterConfig(auto_detect="never"))
+    assert fresh_cluster == []
+
+
+def test_cluster_config_plumbed_from_cli(fresh_cluster):
+    """--cluster.* and --mesh.dcn_* reach their destinations through the
+    workload config tree (round-1 Weak #7: ClusterConfig was unreachable)."""
+    from distributed_tensorflow_tpu.utils import config as config_lib
+    from distributed_tensorflow_tpu.workloads import mnist_mlp
+
+    cfg = config_lib.apply_overrides(
+        mnist_mlp.default_config(),
+        ["--cluster.coordinator_address=10.1.1.1:9",
+         "--cluster.num_processes=4",
+         "--cluster.process_id=0",
+         "--mesh.dcn_data=2"],
+    )
+    assert cfg.cluster.coordinator_address == "10.1.1.1:9"
+    assert cfg.mesh.dcn_data == 2 and cfg.mesh.num_slices == 2
+    cluster.initialize(cfg.cluster)
+    (a, kw), = fresh_cluster
+    assert kw["coordinator_address"] == "10.1.1.1:9"
+    assert kw["num_processes"] == 4

@@ -143,3 +143,67 @@ def test_subgroup_collective_on_2d_mesh(mesh_dp4_tp2):
     )(x)
     expected = np.asarray(x).reshape(4, 2).sum(1, keepdims=True).repeat(2, 1)
     np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_factor_mesh_axis_numerics(mesh8):
+    """Factored sub-axis psum == emulated grouped all_reduce with the
+    matching contiguous groups (mesh.factor_mesh_axis API, VERDICT item 10)."""
+    from distributed_tensorflow_tpu.parallel import factor_mesh_axis
+
+    x = jnp.arange(8.0)
+    groups = [[0, 1], [2, 3], [4, 5], [6, 7]]
+    emulated = shard_map(
+        lambda v: col.all_reduce(v, "data", groups=groups),
+        mesh=mesh8, in_specs=P("data"), out_specs=P("data"),
+    )(x)
+
+    sub = factor_mesh_axis(mesh8, "data", {"outer": 4, "inner": 2})
+    factored = shard_map(
+        lambda v: col.all_reduce(v, "inner"),
+        mesh=sub, in_specs=P(("outer", "inner")), out_specs=P(("outer", "inner")),
+    )(x)
+    np.testing.assert_allclose(np.asarray(factored), np.asarray(emulated))
+
+
+def test_factored_axis_avoids_full_gather(mesh8):
+    """The factored path must compile to a subgroup all-reduce with NO
+    full-axis all-gather; the emulated path provably contains one."""
+    from distributed_tensorflow_tpu.parallel import factor_mesh_axis
+
+    x = jnp.arange(8.0)
+    sub = factor_mesh_axis(mesh8, "data", {"outer": 4, "inner": 2})
+    factored = jax.jit(shard_map(
+        lambda v: col.all_reduce(v, "inner"),
+        mesh=sub, in_specs=P(("outer", "inner")), out_specs=P(("outer", "inner")),
+    ))
+    hlo = factored.lower(x).compile().as_text()
+    assert "all-reduce" in hlo, hlo[:2000]
+    assert "all-gather" not in hlo, "factored subgroup reduce gathered the full axis"
+    # replica groups of size 2, not 8
+    import re
+
+    m = re.search(r"replica_groups=\{(\{[\d,]+\})", hlo)
+    assert m is not None, hlo[:2000]
+    first_group = m.group(1)
+    assert len(first_group.strip("{}").split(",")) == 2, first_group
+
+    groups = [[0, 1], [2, 3], [4, 5], [6, 7]]
+    emulated = jax.jit(shard_map(
+        lambda v: col.all_reduce(v, "data", groups=groups),
+        mesh=mesh8, in_specs=P("data"), out_specs=P("data"),
+    ))
+    hlo_e = emulated.lower(x).compile().as_text()
+    assert "all-gather" in hlo_e  # documents why factoring is the fast path
+
+
+def test_factor_mesh_axis_validation(mesh8):
+    from distributed_tensorflow_tpu.parallel import factor_mesh_axis
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="no axis"):
+        factor_mesh_axis(mesh8, "nope", {"a": 2})
+    with _pytest.raises(ValueError, match="multiply"):
+        factor_mesh_axis(mesh8, "data", {"a": 3})
+    with _pytest.raises(ValueError, match="already in mesh"):
+        factor_mesh_axis(mesh8, "data", {"model": 8})

@@ -91,6 +91,15 @@ def test_distributed_psum():
 
 
 @pytest.mark.slow
+def test_hybrid_mesh_two_process_step():
+    """2-process ICI×DCN hybrid mesh trains one step with agreeing loss
+    (VERDICT round-1 item 5)."""
+    outs = run_cluster("hybrid")
+    for pid, out in enumerate(outs):
+        assert f"HYBRID-OK {pid}" in out, out
+
+
+@pytest.mark.slow
 def test_cross_host_divergence_detection():
     outs = run_cluster("divergence")
     for pid, out in enumerate(outs):
