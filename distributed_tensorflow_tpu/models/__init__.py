@@ -8,7 +8,6 @@ from . import common  # noqa: F401
 from .mlp import MLP, MLPConfig  # noqa: F401
 from .cnn import CNN, CNNConfig  # noqa: F401
 from .resnet import ResNet, ResNet50, ResNetConfig  # noqa: F401
-from . import pipelined_lm  # noqa: F401
 from .wide_deep import WideDeep, WideDeepConfig  # noqa: F401
 from .transformer import (  # noqa: F401
     Transformer,

@@ -273,6 +273,206 @@ class Transformer(nn.Module):
 
 
 # ---------------------------------------------------------------------------
+# Pipeline-parallel path (parallel/pipeline.py): same family, pipe layout
+# ---------------------------------------------------------------------------
+#
+# The flax param tree keeps one subtree per layer (layer_0..layer_{L-1});
+# the SPMD pipeline schedule instead wants every block leaf stacked with a
+# leading [n_stages, layers_per_stage] dim sharded P('pipe'). The two
+# layouts are pure transposes of each other (to/from_pipeline_params — an
+# exact round trip, so dense checkpoints load into the pipelined layout and
+# back). The stage function applies the SAME ``Block`` module that the
+# dense ``Transformer.__call__`` uses, so the math is shared by
+# construction — no twin implementation. Constraints: homogeneous blocks
+# only (no MoE interleave — MoE layers break the stacked layout), and the
+# pipelined path is deterministic (dropout off; pipelined pretraining at
+# this scale regularizes with data, matching the dense path at
+# ``train=False``).
+
+
+def _layer_keys(cfg: TransformerConfig) -> list[str]:
+    return [f"layer_{i}" for i in range(cfg.num_layers)]
+
+
+def _check_pipelineable(cfg: TransformerConfig, n_stages: int) -> None:
+    if cfg.num_experts > 0:
+        raise ValueError(
+            "pipelined Transformer requires homogeneous blocks; "
+            "num_experts > 0 interleaves MoE layers (stack would be ragged)"
+        )
+    if cfg.num_layers % n_stages:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} not divisible by n_stages={n_stages}"
+        )
+
+
+def to_pipeline_params(params: Any, cfg: TransformerConfig, n_stages: int):
+    """Dense flax tree -> {"ends": non-block params, "blocks": every leaf
+    [n_stages, layers_per_stage, ...]}."""
+    _check_pipelineable(cfg, n_stages)
+    layers = [params[k] for k in _layer_keys(cfg)]
+    blocks = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    lps = cfg.num_layers // n_stages
+    blocks = jax.tree.map(
+        lambda x: x.reshape(n_stages, lps, *x.shape[1:]), blocks
+    )
+    ends = {k: v for k, v in params.items() if not k.startswith("layer_")}
+    return {"ends": ends, "blocks": blocks}
+
+
+def from_pipeline_params(pparams: Any, cfg: TransformerConfig):
+    """Inverse of :func:`to_pipeline_params` (for eval/checkpoint interop
+    with the dense family)."""
+    blocks = jax.tree.map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
+        pparams["blocks"],
+    )
+    out = dict(pparams["ends"])
+    for i, k in enumerate(_layer_keys(cfg)):
+        out[k] = jax.tree.map(lambda x: x[i], blocks)
+    return out
+
+
+def pipeline_param_specs(pparams: Any) -> Any:
+    """blocks → P('pipe', ...); ends pipe-replicated (compose TP/FSDP on the
+    ends separately if needed — out of scope for the PP demo)."""
+    from ..parallel.pipeline import stage_param_specs
+
+    return {
+        "ends": jax.tree.map(lambda _: P(), pparams["ends"]),
+        "blocks": stage_param_specs(pparams["blocks"]),
+    }
+
+
+def pipelined_apply(
+    pparams: Any,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None,
+    cfg: TransformerConfig,
+    mesh: Any,
+    n_microbatches: int,
+) -> jax.Array:
+    """input_ids [B,S] -> logits [B,S,vocab] (f32, pipe-replicated), same
+    math as ``Transformer.apply(..., train=False)`` with blocks run through
+    the parallel/pipeline.py microbatch schedule."""
+    from ..parallel.pipeline import microbatch, pipeline_apply, unmicrobatch
+
+    dtype = jnp.dtype(cfg.dtype)
+    ends = pparams["ends"]
+    B, S = input_ids.shape
+    embed_tbl = ends["tok_embed"]["embedding"]
+    x = embed_tbl[input_ids] + ends["pos_embed"][None, :S]
+    x = x.astype(dtype)
+    if not cfg.pre_ln:
+        x = nn.LayerNorm(dtype=jnp.float32).apply(
+            {"params": ends["embed_ln"]}, x
+        ).astype(dtype)
+
+    stage_cfg = dataclasses.replace(cfg, dropout=0.0, seq_impl=None)
+    block = Block(stage_cfg, None, False)
+
+    x_mb = microbatch(x, n_microbatches)
+    if attention_mask is not None:
+        mask_mb = microbatch(attention_mask.astype(bool), n_microbatches)
+
+        def stage_fn(stage_params, x, mask):
+            def layer(x, p):
+                return block.apply({"params": p}, x, mask, train=False), None
+
+            y, _ = jax.lax.scan(layer, x, stage_params)
+            return y
+
+        y = pipeline_apply(stage_fn, pparams["blocks"], x_mb, mesh,
+                           aux_mb=mask_mb)
+    else:
+
+        def stage_fn(stage_params, x):
+            def layer(x, p):
+                return block.apply({"params": p}, x, None, train=False), None
+
+            y, _ = jax.lax.scan(layer, x, stage_params)
+            return y
+
+        y = pipeline_apply(stage_fn, pparams["blocks"], x_mb, mesh)
+    y = unmicrobatch(y)
+
+    if cfg.pre_ln:
+        y = nn.LayerNorm(dtype=jnp.float32).apply(
+            {"params": ends["final_ln"]}, y
+        ).astype(dtype)
+    if not cfg.causal:
+        y = nn.Dense(cfg.d_model, dtype=dtype).apply(
+            {"params": ends["mlm_transform"]}, y
+        )
+        y = nn.gelu(y)
+        y = nn.LayerNorm(dtype=jnp.float32).apply(
+            {"params": ends["mlm_ln"]}, y
+        ).astype(dtype)
+    logits = y.astype(jnp.float32) @ embed_tbl.astype(jnp.float32).T
+    return logits + ends["mlm_bias"]
+
+
+def make_pipelined_init_fn(cfg: TransformerConfig, n_stages: int,
+                           seq_len: int):
+    """init_fn(rng) -> (pipeline-layout params, {}): init the dense family,
+    transpose into the pipe layout."""
+    _check_pipelineable(cfg, n_stages)
+    base = make_init_fn(
+        Transformer(dataclasses.replace(cfg, seq_impl=None)), seq_len
+    )
+
+    def init_fn(rng):
+        params, _ = base(rng)
+        return to_pipeline_params(params, cfg, n_stages), {}
+
+    return init_fn
+
+
+def pipelined_lm_loss_fn(cfg: TransformerConfig, mesh: Any,
+                         n_microbatches: int):
+    """Engine LossFn: next-token loss through the pipelined forward."""
+
+    def loss_fn(params, model_state, batch, rng):
+        del rng  # deterministic (see pipelined-path notes above)
+        ids = batch["input_ids"]
+        logits = pipelined_apply(
+            params, ids, batch.get("attention_mask"), cfg, mesh,
+            n_microbatches,
+        )
+        labels = jnp.concatenate(
+            [ids[:, 1:], jnp.full_like(ids[:, :1], IGNORE_INDEX)], axis=1
+        )
+        if "attention_mask" in batch:
+            # same label-validity rule as lm_loss_fn: label[t] = ids[t+1],
+            # valid iff the mask at t+1 is real
+            mask = batch["attention_mask"]
+            label_valid = jnp.concatenate(
+                [mask[:, 1:] > 0, jnp.zeros_like(mask[:, :1], bool)], axis=1
+            )
+            labels = jnp.where(label_valid, labels, IGNORE_INDEX)
+        loss, acc = _masked_xent(logits, labels)
+        return loss, (model_state, {"accuracy": acc})
+
+    return loss_fn
+
+
+def pipelined_mlm_loss_fn(cfg: TransformerConfig, mesh: Any,
+                          n_microbatches: int):
+    """Engine LossFn: masked-LM loss through the pipelined forward."""
+
+    def loss_fn(params, model_state, batch, rng):
+        del rng
+        logits = pipelined_apply(
+            params, batch["input_ids"], batch.get("attention_mask"), cfg,
+            mesh, n_microbatches,
+        )
+        loss, acc = _masked_xent(logits, batch["labels"])
+        return loss, (model_state, {"accuracy": acc})
+
+    return loss_fn
+
+
+# ---------------------------------------------------------------------------
 # Loss adapters (train-engine LossFn contract, cf. models/common.py)
 # ---------------------------------------------------------------------------
 

@@ -59,11 +59,10 @@ def moe_rules() -> list[tuple[str, P]]:
     mounted — bare, or under any parent scope — instead of silently
     returning replicated specs when the parent isn't literally called
     'moe' (round-1 advisor finding). CAVEAT: these leaf names are not
-    globally unique — ``models/pipelined_lm.py`` uses the same names for
-    its (2-D) FFN weights. That model builds its specs directly (never via
-    path rules), so there is no live collision; but do NOT apply moe_rules
-    to a tree containing a pipelined_lm-style FFN — the 3-axis expert spec
-    would mis-rank onto the 2-D weights."""
+    globally unique — do NOT apply moe_rules to a tree whose dense FFN
+    weights use the same leaf names (2-D) — the 3-axis expert spec would
+    mis-rank onto them. In-tree models either use flax ``mlp_in/mlp_out``
+    names or build their specs directly, so there is no live collision."""
     return [
         (r"(^|/)w_in$", P(mesh_lib.EXPERT, None, mesh_lib.MODEL)),
         (r"(^|/)b_in$", P(mesh_lib.EXPERT, mesh_lib.MODEL)),

@@ -30,8 +30,8 @@ pipelining chapter and praxis' LayerwiseShardablePipelined):
 
 Constraints (documented, standard): stage_fn must be shape-preserving
 ([mb, ...] -> [mb, ...]); heterogeneous ends (embedding lookup, output
-head) run OUTSIDE the pipeline, pipe-replicated — see
-models/pipelined_lm.py. Composes with data/fsdp (batch dim sharded inside
+head) run OUTSIDE the pipeline, pipe-replicated — see the pipelined
+path in models/transformer.py (to_pipeline_params/pipelined_apply). Composes with data/fsdp (batch dim sharded inside
 the same shard_map); tensor parallelism inside a stage would need manual
 collectives and is out of scope here.
 """
@@ -62,25 +62,33 @@ def stack_stages(per_stage: list) -> Any:
 
 
 def pipeline_apply(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[..., jax.Array],
     stage_params: Any,
     x_mb: jax.Array,
     mesh: Mesh,
+    aux_mb: Any = None,
 ) -> jax.Array:
     """Run ``x_mb`` through the S-stage pipeline.
 
     stage_fn: (params_slice, x [mb, ...]) -> y [mb, ...] — shape-preserving.
+        With ``aux_mb``, (params_slice, x, aux) -> y.
     stage_params: every leaf [S, ...], to be sharded P('pipe').
     x_mb: [M, mb, ...] microbatches; mb dim is sharded over (data, fsdp),
         the microbatch dim M is replicated. Returns [M, mb, ...] outputs,
         pipe-replicated.
+    aux_mb: optional pytree of [M, mb, ...] per-microbatch side inputs
+        (e.g. attention masks) that do NOT hop the ring: every rank holds
+        all microbatches' aux (they are small), and the schedule indexes
+        the slice for the microbatch currently at this stage (t - stage).
     """
     n_stages = mesh.shape[mesh_lib.PIPE]
     M = x_mb.shape[0]
     if n_stages == 1:
         # degenerate: no pipe axis — just scan the single stage's params
         sq = jax.tree.map(lambda p: p[0], stage_params)
-        return jax.vmap(lambda x: stage_fn(sq, x))(x_mb)
+        if aux_mb is None:
+            return jax.vmap(lambda x: stage_fn(sq, x))(x_mb)
+        return jax.vmap(lambda x, a: stage_fn(sq, x, a))(x_mb, aux_mb)
     if M < n_stages:
         raise ValueError(
             f"need at least as many microbatches ({M}) as stages "
@@ -97,7 +105,11 @@ def pipeline_apply(
         )
 
     param_specs = stage_param_specs(stage_params)
-    x_spec = P(None, mesh_lib.BATCH_AXES, *([None] * (x_mb.ndim - 2)))
+    mb_spec = lambda leaf: P(
+        None, mesh_lib.BATCH_AXES, *([None] * (jnp.ndim(leaf) - 2))
+    )
+    x_spec = mb_spec(x_mb)
+    aux_specs = jax.tree.map(mb_spec, aux_mb)
 
     body = functools.partial(
         _pipeline_body, stage_fn, n_stages=n_stages, n_microbatches=M,
@@ -105,13 +117,14 @@ def pipeline_apply(
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(param_specs, x_spec),
+        in_specs=(param_specs, x_spec, aux_specs),
         out_specs=x_spec,
         check_vma=False,
-    )(stage_params, x_mb)
+    )(stage_params, x_mb, aux_mb)
 
 
-def _pipeline_body(stage_fn, stage_params, x_mb, *, n_stages, n_microbatches):
+def _pipeline_body(stage_fn, stage_params, x_mb, aux_mb, *, n_stages,
+                   n_microbatches):
     """Per-device schedule; runs inside shard_map. stage_params leaves are
     [1, ...] local slices; x_mb is [M, mb_local, ...]."""
     stage = jax.lax.axis_index(mesh_lib.PIPE)
@@ -129,7 +142,19 @@ def _pipeline_body(stage_fn, stage_params, x_mb, *, n_stages, n_microbatches):
             x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
         )
         inp = jnp.where(stage == 0, x_t, buf)
-        y = fn(params_local, inp)
+        if aux_mb is None:
+            y = fn(params_local, inp)
+        else:
+            # the microbatch at stage s on tick t is t - s (injected at
+            # tick t-s, hopped s rings); clamp covers warmup/drain garbage
+            mb_here = jnp.clip(t - stage, 0, M - 1)
+            aux_t = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, mb_here, 0, keepdims=False
+                ),
+                aux_mb,
+            )
+            y = fn(params_local, inp, aux_t)
         # collect this tick's result for microbatch t-(S-1); only stage
         # S-1's buffer survives the masked psum below, so the per-tick
         # guard only needs to protect index 0 from pre-warmup clamping

@@ -9,7 +9,7 @@ import optax
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distributed_tensorflow_tpu.models import pipelined_lm as plm
+from distributed_tensorflow_tpu.models import transformer as tfm
 from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh
 from distributed_tensorflow_tpu.parallel import sharding as sh
 from distributed_tensorflow_tpu.parallel.pipeline import (
@@ -95,40 +95,83 @@ def test_pipeline_rejects_too_few_microbatches(devices):
         pipeline_apply(_toy_stage_fn, params, x, mesh)
 
 
-def _tiny_lm_cfg(**kw):
+def _tiny_cfg(**kw):
     base = dict(vocab_size=64, max_len=16, num_layers=4, d_model=32,
-                num_heads=4, d_ff=64, n_stages=2, n_microbatches=4,
-                dtype="float32")
+                num_heads=4, d_ff=64, causal=True, pre_ln=True,
+                dtype="float32", dropout=0.0)
     base.update(kw)
-    return plm.PipelinedLMConfig(**base)
+    return tfm.TransformerConfig(**base)
 
 
-def test_pipelined_lm_matches_reference(devices):
-    cfg = _tiny_lm_cfg(n_stages=4)
+def test_pipeline_params_roundtrip():
+    cfg = _tiny_cfg()
+    params, _ = tfm.make_init_fn(tfm.Transformer(cfg), 16)(
+        jax.random.PRNGKey(0)
+    )
+    pparams = tfm.to_pipeline_params(params, cfg, n_stages=2)
+    assert pparams["blocks"]["attn"]["query"]["kernel"].shape[:2] == (2, 2)
+    back = tfm.from_pipeline_params(pparams, cfg)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        params, back,
+    )
+
+
+def test_pipelined_transformer_rejects_moe():
+    cfg = _tiny_cfg(num_experts=4)
+    with pytest.raises(ValueError, match="homogeneous"):
+        tfm.make_pipelined_init_fn(cfg, n_stages=2, seq_len=16)
+
+
+@pytest.mark.parametrize("family", ["gpt", "bert"])
+def test_pipelined_transformer_matches_dense(devices, family):
+    """Same weights through the pipeline schedule == the dense flax
+    forward (the family shares the Block module, so this is an exact
+    schedule-correctness oracle — including the masked/aux path for
+    BERT)."""
+    cfg = (
+        _tiny_cfg()
+        if family == "gpt"
+        else _tiny_cfg(causal=False, pre_ln=False)
+    )
     mesh = build_mesh(MeshSpec(pipe=4, data=2), devices[:8])
-    params = plm.init_params(jax.random.PRNGKey(0), cfg)
-    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16))
-    ids = jnp.asarray(ids, jnp.int32)
-    want = plm.reference_apply(params, ids, cfg)
-    got = jax.jit(lambda p, i: plm.apply(p, i, cfg, mesh))(params, ids)
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 16)(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)), jnp.int32)
+    mask = None
+    if family == "bert":
+        mask = jnp.asarray(rng.rand(8, 16) < 0.9, jnp.int32)
+    want = model.apply({"params": params}, ids, mask, train=False)
+    pparams = tfm.to_pipeline_params(params, cfg, n_stages=4)
+    got = jax.jit(
+        lambda p, i: tfm.pipelined_apply(p, i, mask, cfg, mesh,
+                                         n_microbatches=4)
+    )(pparams, ids)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
 
 
-def test_pipelined_lm_trains(devices):
+def test_pipelined_transformer_trains(devices):
     """Full train-engine integration on a pipe=2 × data=2 × fsdp=2 mesh:
     loss decreases on the deterministic-walk corpus."""
-    cfg = _tiny_lm_cfg()
+    cfg = _tiny_cfg()
     mesh = build_mesh(MeshSpec(pipe=2, data=2, fsdp=2), devices[:8])
     tx = optax.adam(3e-3)
+    init_fn = tfm.make_pipelined_init_fn(cfg, n_stages=2, seq_len=16)
     state, specs = init_train_state(
-        plm.make_init_fn(cfg), tx, mesh, jax.random.PRNGKey(0),
-        param_specs=plm.param_specs(
-            jax.eval_shape(plm.make_init_fn(cfg), jax.random.PRNGKey(0))[0]
+        init_fn, tx, mesh, jax.random.PRNGKey(0),
+        param_specs=tfm.pipeline_param_specs(
+            jax.eval_shape(init_fn, jax.random.PRNGKey(0))[0]
         ),
     )
-    assert state.params["blocks"]["wqkv"].sharding.spec[0] == "pipe"
+    assert (
+        state.params["blocks"]["attn"]["query"]["kernel"].sharding.spec[0]
+        == "pipe"
+    )
     step = jit_train_step(
-        make_train_step(plm.lm_loss_fn(cfg, mesh), tx,
+        make_train_step(tfm.pipelined_lm_loss_fn(cfg, mesh, 4), tx,
                         StepOptions(check_grads_finite=True)),
         mesh, specs,
     )
