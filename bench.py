@@ -70,11 +70,19 @@ def main() -> None:
     # harness instead of duplicating it.
     stem = os.environ.get("BENCH_STEM", "space_to_depth" if on_tpu else "conv")
     norm_dtype = os.environ.get("BENCH_NORM_DTYPE") or None
+    # Fused Pallas conv1x1+BN blocks (ops/fused_conv_bn.py) by default on
+    # TPU — the BN-pass traffic they remove is the bandwidth roofline
+    # (PERF_NOTES.md).
+    block_impl = os.environ.get(
+        "BENCH_BLOCK_IMPL", "fused" if on_tpu else "standard"
+    )
     cfg = (
-        ResNetConfig(stem=stem, norm_dtype=norm_dtype) if on_tpu
+        ResNetConfig(stem=stem, norm_dtype=norm_dtype, block_impl=block_impl)
+        if on_tpu
         else ResNetConfig(
             stage_sizes=(1, 1, 1, 1), width=16, num_classes=100,
             dtype="float32", stem=stem, norm_dtype=norm_dtype,
+            block_impl=block_impl,
         )
     )
     global_batch = per_chip_batch * n_chips
@@ -82,7 +90,7 @@ def main() -> None:
     mesh = build_mesh(MeshSpec(data=-1))
     log(f"mesh: {describe(mesh)}  global_batch={global_batch}  image={image}")
 
-    model = ResNet50(cfg)
+    model = ResNet50(cfg, mesh)
     loss_fn = common.classification_loss_fn(model)
     # the exact optimizer the resnet50_imagenet workload uses (coupled L2
     # on kernels, fused into the update pass)

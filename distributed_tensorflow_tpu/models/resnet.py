@@ -12,9 +12,12 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
+from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,6 +40,12 @@ class ResNetConfig:
     # the MXU's contracting dimension 4x better than 3 (the MLPerf TPU
     # ResNet conv0 optimization).
     stem: str = "conv"
+    # "standard": flax Conv/BatchNorm bottlenecks. "fused": Pallas
+    # conv1x1+BN kernels (ops/fused_conv_bn.py) — the 1x1 convs absorb the
+    # adjacent BN normalize/stats passes (prologue/epilogue), cutting the
+    # HBM traffic that bounds the step (PERF_NOTES.md roofline). Same
+    # param/batch_stats tree as "standard" (checkpoints interoperate).
+    block_impl: str = "standard"
 
 
 def space_to_depth(x, block: int):
@@ -82,8 +91,199 @@ class BottleneckBlock(nn.Module):
         return nn.relu(residual.astype(y.dtype) + y)
 
 
+# ---------------------------------------------------------------------------
+# Fused-kernel bottleneck (ops/fused_conv_bn.py): same params, same math,
+# 1x1 convs absorb the adjacent BN passes
+# ---------------------------------------------------------------------------
+
+
+class _ConvKernel(nn.Module):
+    """Parameter-only scope so the fused block's tree matches the standard
+    block's (``conv1/kernel`` etc. — checkpoints interoperate)."""
+
+    shape: tuple
+
+    @nn.compact
+    def __call__(self):
+        return self.param("kernel", nn.initializers.he_normal(), self.shape)
+
+
+class _BNState(nn.Module):
+    """scale/bias params + batch_stats mean/var, flax BatchNorm naming."""
+
+    features: int
+    zero_scale: bool = False
+
+    @nn.compact
+    def __call__(self):
+        init_scale = (
+            nn.initializers.zeros if self.zero_scale else nn.initializers.ones
+        )
+        scale = self.param("scale", init_scale, (self.features,))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        mean = self.variable(
+            "batch_stats", "mean",
+            lambda: jnp.zeros((self.features,), jnp.float32),
+        )
+        var = self.variable(
+            "batch_stats", "var",
+            lambda: jnp.ones((self.features,), jnp.float32),
+        )
+        return scale, bias, mean, var
+
+
+class FusedBottleneckBlock(nn.Module):
+    """BottleneckBlock with the 1x1 convs running through the fused
+    Pallas conv+BN kernels (train mode): conv1/conv3/proj_conv emit their
+    output BN's statistics from the kernel epilogue, and conv3 applies
+    bn2+ReLU in its prologue — the normalized tensor between bn2 and conv3
+    and all three stats read-passes never touch HBM. BatchNorm statistics
+    reduce over the *global* batch (psum over data/fsdp inside a shard_map
+    island when a mesh is given) — the same sync-BN-under-GSPMD semantics
+    as the standard block. Eval uses plain XLA ops with running stats."""
+
+    filters: int
+    strides: int
+    cfg: ResNetConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        from ..ops.fused_conv_bn import (
+            bn_scale_shift, conv1x1_bn_act, moments_from_sums,
+        )
+        from ..parallel import mesh as mesh_lib
+
+        cfg = self.cfg
+        f, s = self.filters, self.strides
+        cin = x.shape[-1]
+        dtype = jnp.dtype(cfg.dtype)
+        out_dtype = jnp.dtype(cfg.norm_dtype or cfg.dtype)
+        eps, mom = cfg.bn_epsilon, cfg.bn_momentum
+
+        w1 = _ConvKernel((1, 1, cin, f), name="conv1")()
+        g1, b1, m1, v1 = _BNState(f, name="bn1")()
+        w2 = _ConvKernel((3, 3, f, f), name="conv2")()
+        g2, b2, m2, v2 = _BNState(f, name="bn2")()
+        w3 = _ConvKernel((1, 1, f, 4 * f), name="conv3")()
+        g3, b3, m3, v3 = _BNState(4 * f, zero_scale=True, name="bn3")()
+        need_proj = cin != 4 * f or s != 1
+        if need_proj:
+            wp = _ConvKernel((1, 1, cin, 4 * f), name="proj_conv")()
+            gp, bp, mp, vp = _BNState(4 * f, name="proj_bn")()
+
+        conv3x3 = lambda h: jax.lax.conv_general_dilated(
+            h, w2.astype(dtype), (s, s), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+        if not train:
+            # eval: running stats, plain XLA (perf-uncritical path)
+            def aff(y, g, b, m, v):
+                sc, sh = bn_scale_shift(m, v, g, b, eps)
+                return y.astype(jnp.float32) * sc + sh
+            dot1x1 = lambda h, w: jnp.einsum(
+                "bhwc,cd->bhwd", h, w.reshape(w.shape[2], w.shape[3]).astype(dtype)
+            )
+            h1 = nn.relu(aff(dot1x1(x, w1), g1, b1, m1.value, v1.value)).astype(dtype)
+            y2 = conv3x3(h1)
+            h2 = nn.relu(aff(y2, g2, b2, m2.value, v2.value)).astype(dtype)
+            y3 = aff(dot1x1(h2, w3), g3, b3, m3.value, v3.value)
+            if need_proj:
+                xs = x[:, ::s, ::s, :]
+                res = aff(dot1x1(xs, wp), gp, bp, mp.value, vp.value)
+            else:
+                res = x.astype(jnp.float32)
+            return nn.relu(y3 + res).astype(out_dtype)
+
+        axis_names = None
+        if self.mesh is not None:
+            axis_names = tuple(
+                a for a in mesh_lib.BATCH_AXES if a in self.mesh.shape
+            )
+
+        def block_fn(x, w1, w2f, w3, wp_, g1, b1, g2, b2, g3, b3, gp_, bp_):
+            psum = (
+                (lambda t: jax.lax.psum(t, axis_names))
+                if axis_names else (lambda t: t)
+            )
+            B, H, W, _ = x.shape
+            x2 = x.reshape(-1, cin)
+            w1_2 = w1.reshape(cin, f).astype(dtype)
+            y1, s1, q1 = conv1x1_bn_act(
+                x2, w1_2, emit_stats=True, out_dtype=out_dtype
+            )
+            n1 = psum(jnp.float32(y1.shape[0]))
+            mu1, var1 = moments_from_sums(psum(s1), psum(q1), n1)
+            sc1, sh1 = bn_scale_shift(mu1, var1, g1, b1, eps)
+            h1 = nn.relu(y1.astype(jnp.float32) * sc1 + sh1).astype(dtype)
+            y2 = jax.lax.conv_general_dilated(
+                h1.reshape(B, H, W, f), w2f.astype(dtype), (s, s), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            y2_2 = y2.astype(out_dtype).reshape(-1, f)
+            st2 = y2_2.astype(jnp.float32)
+            n2 = psum(jnp.float32(y2_2.shape[0]))
+            mu2, var2 = moments_from_sums(
+                psum(st2.sum(0)), psum((st2 * st2).sum(0)), n2
+            )
+            sc2, sh2 = bn_scale_shift(mu2, var2, g2, b2, eps)
+            y3, s3, q3 = conv1x1_bn_act(
+                y2_2, w3.reshape(f, 4 * f).astype(dtype), sc2, sh2,
+                relu=True, emit_stats=True, out_dtype=out_dtype,
+            )
+            mu3, var3 = moments_from_sums(psum(s3), psum(q3), n2)
+            sc3, sh3 = bn_scale_shift(mu3, var3, g3, b3, eps)
+            out = y3.astype(jnp.float32) * sc3 + sh3
+            stats = [mu1, var1, mu2, var2, mu3, var3]
+            if need_proj:
+                xs = x[:, ::s, ::s, :].reshape(-1, cin)
+                yp, sp, qp = conv1x1_bn_act(
+                    xs, wp_.reshape(cin, 4 * f).astype(dtype),
+                    emit_stats=True, out_dtype=out_dtype,
+                )
+                mup, varp = moments_from_sums(psum(sp), psum(qp), n2)
+                scp, shp = bn_scale_shift(mup, varp, gp_, bp_, eps)
+                res = yp.astype(jnp.float32) * scp + shp
+                stats += [mup, varp]
+            else:
+                res = x.reshape(-1, 4 * f).astype(jnp.float32)
+            out = nn.relu(out + res).astype(out_dtype)
+            Ho, Wo = H // s, W // s
+            return out.reshape(B, Ho, Wo, 4 * f), tuple(stats)
+
+        wp_in = wp if need_proj else jnp.zeros((1, 1, cin, 4 * f), w1.dtype)
+        gp_in = gp if need_proj else jnp.zeros((4 * f,), g1.dtype)
+        bp_in = bp if need_proj else jnp.zeros((4 * f,), b1.dtype)
+        args = (x, w1, w2, w3, wp_in, g1, b1, g2, b2, g3, b3, gp_in, bp_in)
+        if axis_names:
+            bspec = P(axis_names, None, None, None)
+            fn = jax.shard_map(
+                block_fn,
+                mesh=self.mesh,
+                in_specs=(bspec,) + (P(),) * 12,
+                out_specs=(bspec, tuple(P() for _ in range(8 if need_proj else 6))),
+                check_vma=False,
+            )
+            out, stats = fn(*args)
+        else:
+            out, stats = block_fn(*args)
+
+        if not self.is_initializing():
+            upd = lambda var, new: setattr(
+                var, "value", mom * var.value + (1.0 - mom) * new
+            )
+            upd(m1, stats[0]); upd(v1, stats[1])
+            upd(m2, stats[2]); upd(v2, stats[3])
+            upd(m3, stats[4]); upd(v3, stats[5])
+            if need_proj:
+                upd(mp, stats[6]); upd(vp, stats[7])
+        return out
+
+
 class ResNet(nn.Module):
     cfg: ResNetConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -110,17 +310,25 @@ class ResNet(nn.Module):
         for stage, blocks in enumerate(cfg.stage_sizes):
             for block in range(blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
-                x = BottleneckBlock(
-                    cfg.width * 2**stage, strides, cfg,
-                    name=f"stage{stage}_block{block}",
-                )(x, train=train)
+                name = f"stage{stage}_block{block}"
+                if cfg.block_impl == "fused":
+                    x = FusedBottleneckBlock(
+                        cfg.width * 2**stage, strides, cfg, self.mesh,
+                        name=name,
+                    )(x, train=train)
+                elif cfg.block_impl == "standard":
+                    x = BottleneckBlock(
+                        cfg.width * 2**stage, strides, cfg, name=name,
+                    )(x, train=train)
+                else:
+                    raise ValueError(f"Unknown block_impl {cfg.block_impl!r}")
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         # head in f32: the last matmul is tiny; keep logits stable
         return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x)
 
 
-def ResNet50(cfg: ResNetConfig | None = None) -> ResNet:
-    return ResNet(cfg or ResNetConfig())
+def ResNet50(cfg: ResNetConfig | None = None, mesh: Any = None) -> ResNet:
+    return ResNet(cfg or ResNetConfig(), mesh)
 
 
 def flops_per_example(cfg: ResNetConfig, image_size: int = 224) -> float:

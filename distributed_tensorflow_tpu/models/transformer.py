@@ -372,28 +372,20 @@ def pipelined_apply(
     block = Block(stage_cfg, None, False)
 
     x_mb = microbatch(x, n_microbatches)
-    if attention_mask is not None:
-        mask_mb = microbatch(attention_mask.astype(bool), n_microbatches)
 
-        def stage_fn(stage_params, x, mask):
-            def layer(x, p):
-                return block.apply({"params": p}, x, mask, train=False), None
+    def stage_fn(stage_params, x, mask=None):
+        def layer(x, p):
+            return block.apply({"params": p}, x, mask, train=False), None
 
-            y, _ = jax.lax.scan(layer, x, stage_params)
-            return y
+        y, _ = jax.lax.scan(layer, x, stage_params)
+        return y
 
-        y = pipeline_apply(stage_fn, pparams["blocks"], x_mb, mesh,
-                           aux_mb=mask_mb)
-    else:
-
-        def stage_fn(stage_params, x):
-            def layer(x, p):
-                return block.apply({"params": p}, x, None, train=False), None
-
-            y, _ = jax.lax.scan(layer, x, stage_params)
-            return y
-
-        y = pipeline_apply(stage_fn, pparams["blocks"], x_mb, mesh)
+    mask_mb = (
+        microbatch(attention_mask.astype(bool), n_microbatches)
+        if attention_mask is not None else None
+    )
+    y = pipeline_apply(stage_fn, pparams["blocks"], x_mb, mesh,
+                       aux_mb=mask_mb)
     y = unmicrobatch(y)
 
     if cfg.pre_ln:

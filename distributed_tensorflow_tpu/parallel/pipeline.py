@@ -83,6 +83,12 @@ def pipeline_apply(
     """
     n_stages = mesh.shape[mesh_lib.PIPE]
     M = x_mb.shape[0]
+    for leaf in jax.tree.leaves(aux_mb):
+        if jnp.ndim(leaf) < 2 or leaf.shape[0] != M:
+            raise ValueError(
+                f"aux_mb leaves must be [M={M}, mb, ...] microbatched "
+                f"(use microbatch()); got shape {jnp.shape(leaf)}"
+            )
     if n_stages == 1:
         # degenerate: no pipe axis — just scan the single stage's params
         sq = jax.tree.map(lambda p: p[0], stage_params)

@@ -43,7 +43,7 @@ def default_config() -> RunConfig:
 
 
 def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
-    model = ResNet50(cfg.model)
+    model = ResNet50(cfg.model, mesh)
     input_shape = (cfg.data.image_size, cfg.data.image_size, cfg.data.channels)
     return WorkloadParts(
         init_fn=common.make_init_fn(model, input_shape),

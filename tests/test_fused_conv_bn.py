@@ -1,0 +1,114 @@
+"""Fused 1x1-conv + BN kernel tests (interpret mode on CPU): forward and
+gradient parity against the pure-jnp oracle, for every prologue/stats
+combination the ResNet integration uses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.ops.fused_conv_bn import (
+    bn_scale_shift,
+    conv1x1_bn_act,
+    conv1x1_bn_act_reference,
+    moments_from_sums,
+)
+
+
+def _mk(M=64, cin=32, cout=48, dtype=jnp.float32, seed=0):
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(M, cin), dtype)
+    w = jnp.asarray(r.randn(cin, cout) * 0.1, dtype)
+    gamma = jnp.asarray(r.rand(cin) + 0.5, jnp.float32)
+    beta = jnp.asarray(r.randn(cin) * 0.1, jnp.float32)
+    mean = jnp.asarray(r.randn(cin) * 0.2, jnp.float32)
+    var = jnp.asarray(r.rand(cin) + 0.3, jnp.float32)
+    scale, shift = bn_scale_shift(mean, var, gamma, beta, 1e-5)
+    return x, w, scale, shift
+
+
+@pytest.mark.parametrize("prologue", [False, True])
+@pytest.mark.parametrize("relu", [False, True])
+@pytest.mark.parametrize("emit_stats", [False, True])
+def test_forward_matches_reference(prologue, relu, emit_stats):
+    x, w, scale, shift = _mk()
+    kw = dict(relu=relu, emit_stats=emit_stats)
+    args = (x, w, scale, shift) if prologue else (x, w)
+    got = conv1x1_bn_act(*args, **kw)
+    want = conv1x1_bn_act_reference(*args, **kw)
+    if emit_stats:
+        for g, wnt in zip(got, want):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(wnt), rtol=1e-5, atol=1e-4
+            )
+    else:
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-4
+        )
+
+
+@pytest.mark.parametrize("prologue", [False, True])
+def test_gradients_match_reference(prologue):
+    """Full-pathway gradient check: the loss consumes y AND the emitted
+    stats (through moments, like the next BN does), so the stats-output
+    cotangent path into dy is exercised."""
+    x, w, scale, shift = _mk(M=48, cin=24, cout=40)
+
+    def loss(fn):
+        def go(x, w, scale, shift):
+            args = (x, w, scale, shift) if prologue else (x, w)
+            y, s, ssq = fn(*args, relu=True, emit_stats=True)
+            mean, var = moments_from_sums(s, ssq, y.shape[0])
+            return (
+                (y * y).mean()
+                + (mean * mean).sum()
+                + jnp.sqrt(var + 1e-3).sum()
+            )
+
+        return go
+
+    got = jax.grad(loss(conv1x1_bn_act), argnums=(0, 1, 2, 3))(
+        x, w, scale, shift
+    )
+    want = jax.grad(loss(conv1x1_bn_act_reference), argnums=(0, 1, 2, 3))(
+        x, w, scale, shift
+    )
+    names = ["dx", "dw", "dscale", "dshift"]
+    n_checked = 4 if prologue else 2
+    for name, g, wnt in list(zip(names, got, want))[:n_checked]:
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(wnt), rtol=2e-4, atol=2e-4,
+            err_msg=name,
+        )
+
+
+def test_bf16_io_f32_accumulation():
+    x, w, scale, shift = _mk(M=128, cin=64, cout=64, dtype=jnp.bfloat16)
+    y, s, ssq = conv1x1_bn_act(x, w, scale, shift)
+    assert y.dtype == jnp.bfloat16
+    assert s.dtype == jnp.float32 and ssq.dtype == jnp.float32
+    yr, sr, ssqr = conv1x1_bn_act_reference(x, w, scale, shift)
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(yr, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+    # stats are computed on the quantized output -> exact match vs oracle
+    np.testing.assert_allclose(np.asarray(s), np.asarray(sr), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ssq), np.asarray(ssqr), rtol=1e-5)
+
+
+def test_moments_and_affine_helpers_match_batchnorm():
+    r = np.random.RandomState(0)
+    y = jnp.asarray(r.randn(256, 16), jnp.float32)
+    s, ssq = y.sum(0), (y * y).sum(0)
+    mean, var = moments_from_sums(s, ssq, y.shape[0])
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(y.mean(0)),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(y.var(0)),
+                               rtol=1e-4, atol=1e-5)
+    gamma = jnp.asarray(r.rand(16) + 0.5, jnp.float32)
+    beta = jnp.asarray(r.randn(16), jnp.float32)
+    scale, shift = bn_scale_shift(mean, var, gamma, beta, 1e-5)
+    want = (y - mean) * gamma * jax.lax.rsqrt(var + 1e-5) + beta
+    np.testing.assert_allclose(np.asarray(y * scale + shift),
+                               np.asarray(want), rtol=1e-4, atol=1e-4)
