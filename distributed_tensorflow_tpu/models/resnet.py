@@ -249,7 +249,9 @@ class FusedBottleneckBlock(nn.Module):
             else:
                 res = x.reshape(-1, 4 * f).astype(jnp.float32)
             out = nn.relu(out + res).astype(out_dtype)
-            Ho, Wo = H // s, W // s
+            # SAME-padded stride-s conv (and the ::s residual slice) emit
+            # ceil(H/s), not floor
+            Ho, Wo = -(-H // s), -(-W // s)
             return out.reshape(B, Ho, Wo, 4 * f), tuple(stats)
 
         wp_in = wp if need_proj else jnp.zeros((1, 1, cin, 4 * f), w1.dtype)

@@ -52,14 +52,17 @@ def _on_tpu() -> bool:
 def _pick_block_m(M: int, cin: int, cout: int) -> int:
     """Largest M-tile (multiple of 8, divides M) fitting the VMEM budget:
     x [bm, cin] bf16 + y [bm, cout] out + f32 compute temps, double-buffered."""
-    for bm in (1024, 512, 256, 128, 64, 32, 16, 8):
+    # largest divisor of M within the budget (sublane-aligned multiples of
+    # 8 first by construction of the descent; a non-8-multiple divisor is
+    # still correct — Mosaic pads sublanes internally)
+    for bm in range(min(M, 1024), 0, -1):
         if M % bm:
             continue
         # 2 buffers on x and y, one f32 temp each for prologue/matmul acc
         need = 2 * bm * (2 * cin + 2 * cout) + 4 * bm * (cin + cout)
         if need <= _VMEM_BUDGET:
             return bm
-    return M  # tiny/odd M: one block (Mosaic pads sublanes internally)
+    return 1  # unreachable for any real budget; divisor 1 always fits
 
 
 def _pick_block_n(cin: int, cout: int) -> int:

@@ -48,6 +48,13 @@ class MoEConfig:
     # dimension): capacity is per-group, so dispatch/combine memory is
     # O(T·group) instead of O(T²). 0 = auto (largest divisor of T ≤ 1024).
     group_size: int = 0
+    # "einsum": one-hot dispatch/combine contractions (Mesh-TF/Switch) —
+    #   MXU-dense, and GSPMD lowers the sharded-E einsum to the EP
+    #   all_to_all; FLOPs O(G²·top_k·cf·D) per group.
+    # "scatter": position-indexed scatter/gather into the expert buffers —
+    #   FLOPs/memory linear in G (the sorted-dispatch style every
+    #   large-scale MoE eventually needs); same routing, same drops.
+    dispatch_impl: str = "einsum"
 
 
 def moe_rules() -> list[tuple[str, P]]:
@@ -92,16 +99,17 @@ def resolve_group_size(num_tokens: int, cfg: MoEConfig) -> int:
     return g
 
 
-def top_k_routing(probs: jax.Array, capacity: int, top_k: int):
-    """probs [T, E] → (dispatch [T, E, C] 0/1, combine [T, E, C] weights,
-    aux_loss scalar). Greedy per-slot routing: slot j sends each token to
-    its j-th choice expert if that expert still has capacity (position =
-    running count of tokens already routed there, across slots)."""
+def _greedy_slots(probs: jax.Array, capacity: int, top_k: int):
+    """Shared routing decision for both dispatch impls. probs [T, E] →
+    per-slot arrays (choice [k,T] int, pos [k,T] int, keep [k,T] bool,
+    gate [k,T] f32) and the aux loss. Greedy per-slot: slot j sends each
+    token to its j-th choice expert if that expert still has capacity
+    (position = running count of tokens already routed there, across
+    slots — so (expert, position) pairs are unique across ALL slots)."""
     T, E = probs.shape
     remaining = probs
     fill = jnp.zeros((E,), jnp.int32)  # tokens assigned per expert so far
-    dispatch = jnp.zeros((T, E, capacity), probs.dtype)
-    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    choices, positions, keeps, gates = [], [], [], []
     for _ in range(top_k):
         choice = jnp.argmax(remaining, axis=-1)  # [T]
         onehot = jax.nn.one_hot(choice, E, dtype=probs.dtype)  # [T, E]
@@ -109,30 +117,71 @@ def top_k_routing(probs: jax.Array, capacity: int, top_k: int):
         pos = fill[None, :] + (jnp.cumsum(onehot, axis=0) - onehot).astype(
             jnp.int32
         )
-        keep = (pos < capacity).astype(probs.dtype) * onehot
-        pos_oh = jax.nn.one_hot(
-            jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), capacity,
-            dtype=probs.dtype,
-        )
-        d = keep[:, :, None] * pos_oh[:, None, :]
-        dispatch = dispatch + d
+        my_pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T]
+        keep = my_pos < capacity
         gate = jnp.sum(probs * onehot, axis=-1)  # [T]
-        combine = combine + gate[:, None, None] * d
-        fill = fill + jnp.sum(keep, axis=0).astype(jnp.int32)
+        choices.append(choice); positions.append(my_pos)
+        keeps.append(keep); gates.append(gate)
+        kept_oh = onehot * keep[:, None].astype(probs.dtype)
+        fill = fill + jnp.sum(kept_oh, axis=0).astype(jnp.int32)
         remaining = remaining * (1.0 - onehot)
+    choice = jnp.stack(choices); pos = jnp.stack(positions)
+    keep = jnp.stack(keeps); gate = jnp.stack(gates)
     if top_k > 1:
-        # renormalize combine over the chosen experts (top-k gates sum to 1)
-        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
-        combine = combine / jnp.maximum(denom, 1e-9)
+        # renormalize gates over the KEPT choices (top-k gates sum to 1)
+        denom = jnp.sum(gate * keep, axis=0, keepdims=True)
+        gate = gate / jnp.maximum(denom, 1e-9)
     # top_k == 1 keeps the RAW gate probability (Switch Transformer §2.1):
     # renormalizing would make the gate exactly 1.0 and cut the router off
     # from the main-loss gradient (round-1 advisor finding).
     # Switch load-balance loss on first-choice statistics
     first = jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=probs.dtype)
-    frac_tokens = first.mean(axis=0)
-    mean_probs = probs.mean(axis=0)
-    aux = E * jnp.sum(frac_tokens * mean_probs)
+    aux = E * jnp.sum(first.mean(axis=0) * probs.mean(axis=0))
+    return choice, pos, keep, gate, aux
+
+
+def top_k_routing(probs: jax.Array, capacity: int, top_k: int):
+    """probs [T, E] → (dispatch [T, E, C] 0/1, combine [T, E, C] weights,
+    aux_loss scalar) — the one-hot ("einsum") form of :func:`_greedy_slots`."""
+    T, E = probs.shape
+    choice, pos, keep, gate, aux = _greedy_slots(probs, capacity, top_k)
+    dispatch = jnp.zeros((T, E, capacity), probs.dtype)
+    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    for j in range(top_k):
+        d = (
+            jax.nn.one_hot(choice[j], E, dtype=probs.dtype)[:, :, None]
+            * jax.nn.one_hot(pos[j], capacity, dtype=probs.dtype)[:, None, :]
+            * keep[j][:, None, None].astype(probs.dtype)
+        )
+        dispatch = dispatch + d
+        combine = combine + gate[j][:, None, None] * d
     return dispatch, combine, aux
+
+
+def _scatter_expert_ffn(tokens, probs, capacity, top_k, apply_ffn, dtype):
+    """Linear-memory dispatch: scatter tokens into [E*C, D] expert buffers
+    at their (expert, position) slot, run the FFN, gather back weighted by
+    the gates. (expert, position) uniqueness across slots (see
+    _greedy_slots) makes the scatter collision-free; dropped tokens target
+    a sentinel row that is sliced off."""
+    T, D = tokens.shape
+    E = probs.shape[-1]
+    choice, pos, keep, gate, aux = _greedy_slots(probs, capacity, top_k)
+    flat_idx = jnp.where(keep, choice * capacity + pos, E * capacity)  # [k,T]
+    buf = jnp.zeros((E * capacity + 1, D), dtype)
+    for j in range(top_k):
+        buf = buf.at[flat_idx[j]].add(tokens)
+    expert_in = buf[:-1].reshape(E, capacity, D)
+    out = apply_ffn(expert_in)  # [E, C, D]
+    out_flat = jnp.concatenate(
+        [out.reshape(E * capacity, D), jnp.zeros((1, D), out.dtype)], axis=0
+    )
+    y = jnp.zeros((T, D), dtype)
+    for j in range(top_k):
+        y = y + out_flat[flat_idx[j]] * (
+            gate[j] * keep[j].astype(gate.dtype)
+        )[:, None].astype(dtype)
+    return y, aux
 
 
 class MoEMLP(nn.Module):
@@ -164,15 +213,6 @@ class MoEMLP(nn.Module):
         n_groups = T // G
         probs_g = probs.reshape(n_groups, G, cfg.num_experts)
         C = expert_capacity(G, cfg)
-        dispatch, combine, aux = jax.vmap(
-            lambda p: top_k_routing(p, C, cfg.top_k)
-        )(probs_g)  # [n, G, E, C] ×2, aux [n]
-        aux = aux.mean()
-        self.sow(
-            "losses", "moe_aux", cfg.router_aux_weight * aux,
-            init_fn=lambda: jnp.zeros((), jnp.float32),
-            reduce_fn=lambda a, b: a + b,
-        )
 
         w_in = self.param(
             "w_in", nn.initializers.normal(0.02),
@@ -189,17 +229,44 @@ class MoEMLP(nn.Module):
         b_out = self.param(
             "b_out", nn.initializers.zeros, (cfg.num_experts, D), jnp.float32,
         )
-
-        # dispatch: [n,G,E,C] × [n,G,D] → expert buffers [n,E,C,D]
         tokens_g = tokens.reshape(n_groups, G, D).astype(dtype)
-        expert_in = jnp.einsum("ngec,ngd->necd", dispatch.astype(dtype),
-                               tokens_g)
-        h = jnp.einsum("necd,edf->necf", expert_in, w_in.astype(dtype))
-        h = nn.gelu(h + b_in[None, :, None, :].astype(dtype))
-        out = jnp.einsum("necf,efd->necd", h, w_out.astype(dtype))
-        out = out + b_out[None, :, None, :].astype(dtype)
-        # combine: [n,G,E,C] × [n,E,C,D] → [n,G,D]; dropped tokens get zeros
-        y = jnp.einsum("ngec,necd->ngd", combine.astype(dtype), out)
+
+        if cfg.dispatch_impl == "einsum":
+            dispatch, combine, aux = jax.vmap(
+                lambda p: top_k_routing(p, C, cfg.top_k)
+            )(probs_g)  # [n, G, E, C] ×2, aux [n]
+            aux = aux.mean()
+            # dispatch: [n,G,E,C] × [n,G,D] → expert buffers [n,E,C,D]
+            expert_in = jnp.einsum("ngec,ngd->necd", dispatch.astype(dtype),
+                                   tokens_g)
+            h = jnp.einsum("necd,edf->necf", expert_in, w_in.astype(dtype))
+            h = nn.gelu(h + b_in[None, :, None, :].astype(dtype))
+            out = jnp.einsum("necf,efd->necd", h, w_out.astype(dtype))
+            out = out + b_out[None, :, None, :].astype(dtype)
+            # combine: [n,G,E,C] × [n,E,C,D] → [n,G,D]; dropped → zeros
+            y = jnp.einsum("ngec,necd->ngd", combine.astype(dtype), out)
+        elif cfg.dispatch_impl == "scatter":
+
+            def ffn(expert_in):  # [E, C, D] → [E, C, D]
+                h = jnp.einsum("ecd,edf->ecf", expert_in, w_in.astype(dtype))
+                h = nn.gelu(h + b_in[:, None, :].astype(dtype))
+                out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(dtype))
+                return out + b_out[:, None, :].astype(dtype)
+
+            y, aux_g = jax.vmap(
+                lambda t, p: _scatter_expert_ffn(
+                    t, p, C, cfg.top_k, ffn, dtype
+                )
+            )(tokens_g, probs_g)
+            aux = aux_g.mean()
+        else:
+            raise ValueError(f"Unknown dispatch_impl {cfg.dispatch_impl!r}")
+
+        self.sow(
+            "losses", "moe_aux", cfg.router_aux_weight * aux,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+            reduce_fn=lambda a, b: a + b,
+        )
         return y.reshape(B, S, D)
 
 

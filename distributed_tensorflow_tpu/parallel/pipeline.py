@@ -26,7 +26,22 @@ pipelining chapter and praxis' LayerwiseShardablePipelined):
   stage fn keeps activation memory at O(layers_per_stage) per tick.
 - Output collection: only stage S-1 holds real outputs; they are
   broadcast to all pipe ranks with a masked ``psum`` so downstream global
-  code (loss over the full batch) sees a pipe-replicated array.
+  code (loss over the full batch) sees a pipe-replicated array. Traffic
+  analysis (why this is kept): the psum moves ~2(S-1)/S of the output
+  bytes once per step, and its *transpose is communication-free* (the
+  cotangent arrives already pipe-replicated from the replicated loss and
+  is masked locally). The alternatives measure the same or worse:
+  all_gather+index is (S-1)/S forward but its transpose is a
+  psum_scatter of the same order, and riding outputs around the existing
+  ppermute ring for S-1 extra drain ticks moves exactly the same bytes
+  as the psum while adding S-1 ticks of garbage compute.
+- Memory schedule: ``jax.checkpoint`` on the stage fn bounds live
+  activations at one stage-IO buffer per in-flight microbatch — O(M)
+  per device (GPipe), not 1F1B's O(S). True 1F1B needs hand-interleaved
+  forward/backward ticks (a custom VJP over the whole schedule) because
+  autodiff-through-scan replays the forward schedule before starting the
+  backward one; documented as the known delta vs Megatron-style
+  schedulers rather than half-built.
 
 Constraints (documented, standard): stage_fn must be shape-preserving
 ([mb, ...] -> [mb, ...]); heterogeneous ends (embedding lookup, output

@@ -198,3 +198,21 @@ def test_fused_block_impl_through_dp_mesh(devices):
         ),
         bs_got, bs_want,
     )
+
+    # gradients through shard_map + psum'd BN stats + the Pallas
+    # custom_vjp — the exact path bench.py defaults to on TPU
+    def loss(model, xin):
+        def go(p):
+            out, _ = model.apply(
+                {"params": p, **mstate}, xin, train=True,
+                mutable=["batch_stats"],
+            )
+            return (out.astype(jnp.float32) ** 2).mean()
+        return go
+
+    g_std = jax.jit(jax.grad(loss(m_std, x)))(params)
+    g_fused = jax.jit(jax.grad(loss(m_fused, xs)))(params)
+    flat_s, _ = jax.flatten_util.ravel_pytree(jax.device_get(g_std))
+    flat_f, _ = jax.flatten_util.ravel_pytree(jax.device_get(g_fused))
+    np.testing.assert_allclose(np.asarray(flat_f), np.asarray(flat_s),
+                               rtol=5e-3, atol=5e-3)
