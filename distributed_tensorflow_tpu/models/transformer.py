@@ -294,39 +294,62 @@ def _layer_keys(cfg: TransformerConfig) -> list[str]:
     return [f"layer_{i}" for i in range(cfg.num_layers)]
 
 
-def _check_pipelineable(cfg: TransformerConfig, n_stages: int) -> None:
+def _check_pipelineable(cfg: TransformerConfig, n_stages: int,
+                        n_virtual: int = 1) -> None:
     if cfg.num_experts > 0:
         raise ValueError(
             "pipelined Transformer requires homogeneous blocks; "
             "num_experts > 0 interleaves MoE layers (stack would be ragged)"
         )
-    if cfg.num_layers % n_stages:
+    if cfg.num_layers % (n_stages * n_virtual):
         raise ValueError(
-            f"num_layers={cfg.num_layers} not divisible by n_stages={n_stages}"
+            f"num_layers={cfg.num_layers} not divisible by "
+            f"n_stages*n_virtual={n_stages}*{n_virtual}"
         )
 
 
-def to_pipeline_params(params: Any, cfg: TransformerConfig, n_stages: int):
+def to_pipeline_params(params: Any, cfg: TransformerConfig, n_stages: int,
+                       n_virtual: int = 1):
     """Dense flax tree -> {"ends": non-block params, "blocks": every leaf
-    [n_stages, layers_per_stage, ...]}."""
-    _check_pipelineable(cfg, n_stages)
+    [n_stages, layers_per_stage, ...]}. With ``n_virtual`` > 1 the layout
+    is [n_stages, n_virtual, layers_per_chunk, ...]: device d's v-th
+    chunk is the contiguous layer range of global chunk v·S+d (the
+    interleaved schedule of parallel/pipeline.py)."""
+    _check_pipelineable(cfg, n_stages, n_virtual)
     layers = [params[k] for k in _layer_keys(cfg)]
     blocks = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
-    lps = cfg.num_layers // n_stages
-    blocks = jax.tree.map(
-        lambda x: x.reshape(n_stages, lps, *x.shape[1:]), blocks
-    )
+    S, V = n_stages, n_virtual
+    lc = cfg.num_layers // (S * V)
+    if V == 1:
+        blocks = jax.tree.map(
+            lambda x: x.reshape(S, lc, *x.shape[1:]), blocks
+        )
+    else:
+        # [L, ...] -> chunks [V, S, lc, ...] (chunk c = v*S + d) -> [S, V, lc]
+        blocks = jax.tree.map(
+            lambda x: x.reshape(V, S, lc, *x.shape[1:]).swapaxes(0, 1),
+            blocks,
+        )
     ends = {k: v for k, v in params.items() if not k.startswith("layer_")}
     return {"ends": ends, "blocks": blocks}
 
 
-def from_pipeline_params(pparams: Any, cfg: TransformerConfig):
+def from_pipeline_params(pparams: Any, cfg: TransformerConfig,
+                         n_virtual: int = 1):
     """Inverse of :func:`to_pipeline_params` (for eval/checkpoint interop
     with the dense family)."""
-    blocks = jax.tree.map(
-        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
-        pparams["blocks"],
-    )
+    if n_virtual == 1:
+        blocks = jax.tree.map(
+            lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
+            pparams["blocks"],
+        )
+    else:
+        blocks = jax.tree.map(
+            lambda x: x.swapaxes(0, 1).reshape(
+                x.shape[0] * x.shape[1] * x.shape[2], *x.shape[3:]
+            ),
+            pparams["blocks"],
+        )
     out = dict(pparams["ends"])
     for i, k in enumerate(_layer_keys(cfg)):
         out[k] = jax.tree.map(lambda x: x[i], blocks)
@@ -351,6 +374,7 @@ def pipelined_apply(
     cfg: TransformerConfig,
     mesh: Any,
     n_microbatches: int,
+    n_virtual: int = 1,
 ) -> jax.Array:
     """input_ids [B,S] -> logits [B,S,vocab] (f32, pipe-replicated), same
     math as ``Transformer.apply(..., train=False)`` with blocks run through
@@ -385,7 +409,7 @@ def pipelined_apply(
         if attention_mask is not None else None
     )
     y = pipeline_apply(stage_fn, pparams["blocks"], x_mb, mesh,
-                       aux_mb=mask_mb)
+                       aux_mb=mask_mb, n_virtual=n_virtual)
     y = unmicrobatch(y)
 
     if cfg.pre_ln:
@@ -405,23 +429,23 @@ def pipelined_apply(
 
 
 def make_pipelined_init_fn(cfg: TransformerConfig, n_stages: int,
-                           seq_len: int):
+                           seq_len: int, n_virtual: int = 1):
     """init_fn(rng) -> (pipeline-layout params, {}): init the dense family,
     transpose into the pipe layout."""
-    _check_pipelineable(cfg, n_stages)
+    _check_pipelineable(cfg, n_stages, n_virtual)
     base = make_init_fn(
         Transformer(dataclasses.replace(cfg, seq_impl=None)), seq_len
     )
 
     def init_fn(rng):
         params, _ = base(rng)
-        return to_pipeline_params(params, cfg, n_stages), {}
+        return to_pipeline_params(params, cfg, n_stages, n_virtual), {}
 
     return init_fn
 
 
 def pipelined_lm_loss_fn(cfg: TransformerConfig, mesh: Any,
-                         n_microbatches: int):
+                         n_microbatches: int, n_virtual: int = 1):
     """Engine LossFn: next-token loss through the pipelined forward."""
 
     def loss_fn(params, model_state, batch, rng):
@@ -429,7 +453,7 @@ def pipelined_lm_loss_fn(cfg: TransformerConfig, mesh: Any,
         ids = batch["input_ids"]
         logits = pipelined_apply(
             params, ids, batch.get("attention_mask"), cfg, mesh,
-            n_microbatches,
+            n_microbatches, n_virtual,
         )
         labels = jnp.concatenate(
             [ids[:, 1:], jnp.full_like(ids[:, :1], IGNORE_INDEX)], axis=1
@@ -449,14 +473,14 @@ def pipelined_lm_loss_fn(cfg: TransformerConfig, mesh: Any,
 
 
 def pipelined_mlm_loss_fn(cfg: TransformerConfig, mesh: Any,
-                          n_microbatches: int):
+                          n_microbatches: int, n_virtual: int = 1):
     """Engine LossFn: masked-LM loss through the pipelined forward."""
 
     def loss_fn(params, model_state, batch, rng):
         del rng
         logits = pipelined_apply(
             params, batch["input_ids"], batch.get("attention_mask"), cfg,
-            mesh, n_microbatches,
+            mesh, n_microbatches, n_virtual,
         )
         loss, acc = _masked_xent(logits, batch["labels"])
         return loss, (model_state, {"accuracy": acc})

@@ -52,17 +52,24 @@ def _on_tpu() -> bool:
 def _pick_block_m(M: int, cin: int, cout: int) -> int:
     """Largest M-tile (multiple of 8, divides M) fitting the VMEM budget:
     x [bm, cin] bf16 + y [bm, cout] out + f32 compute temps, double-buffered."""
-    # largest divisor of M within the budget (sublane-aligned multiples of
-    # 8 first by construction of the descent; a non-8-multiple divisor is
-    # still correct — Mosaic pads sublanes internally)
-    for bm in range(min(M, 1024), 0, -1):
-        if M % bm:
-            continue
-        # 2 buffers on x and y, one f32 temp each for prologue/matmul acc
-        need = 2 * bm * (2 * cin + 2 * cout) + 4 * bm * (cin + cout)
-        if need <= _VMEM_BUDGET:
+    # A block's sublane dim must be 8-aligned unless the block covers the
+    # whole dim (then Mosaic pads the array edge itself). Largest aligned
+    # divisor of M within the VMEM budget, scanning all multiples of 8:
+    fits = lambda bm: (
+        2 * bm * (2 * cin + 2 * cout) + 4 * bm * (cin + cout)
+        <= _VMEM_BUDGET
+    )  # 2 buffers on x and y + one f32 temp each for prologue/matmul acc
+    for bm in range(min(M, 1024) // 8 * 8, 7, -8):
+        if M % bm == 0 and fits(bm):
             return bm
-    return 1  # unreachable for any real budget; divisor 1 always fits
+    if fits(M):
+        return M  # single whole-M block (tiny/odd M)
+    raise ValueError(
+        f"fused conv1x1 kernel: M={M} has no 8-aligned tile under the "
+        f"VMEM budget for cin={cin}, cout={cout}; make the per-shard "
+        "batch*H*W divisible by a multiple of 8, or use the standard "
+        "(unfused) block impl"
+    )
 
 
 def _pick_block_n(cin: int, cout: int) -> int:
@@ -141,9 +148,13 @@ def _fwd_call(x, w, scale, shift, *, prologue, relu, emit_stats, out_dtype,
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dx_kernel(x_ref, y_ref, dy_ref, w_ref, scale_ref, shift_ref,
-                   dsum_ref, dssq_ref, dx_ref, dscale_ref, dshift_ref,
-                   *, prologue, relu, emit_stats):
+def _bwd_dx_kernel(*refs, prologue, relu, emit_stats):
+    if prologue:
+        (x_ref, y_ref, dy_ref, w_ref, scale_ref, shift_ref,
+         dsum_ref, dssq_ref, dx_ref, dscale_ref, dshift_ref) = refs
+    else:
+        # no prologue: x/scale/shift are neither read nor streamed
+        (y_ref, dy_ref, w_ref, dsum_ref, dssq_ref, dx_ref) = refs
     g = dy_ref[:].astype(jnp.float32)
     if emit_stats:
         # stats outputs' cotangents fold back into the output gradient:
@@ -183,33 +194,33 @@ def _bwd_dx_call(x, y, dy, w, scale, shift, dsum, dssq, *, prologue, relu,
     kernel = functools.partial(
         _bwd_dx_kernel, prologue=prologue, relu=relu, emit_stats=emit_stats,
     )
-    dx, dscale, dshift = pl.pallas_call(
+    row = lambda bq, cq: pl.BlockSpec((bq, cq), lambda i: (i, 0))
+    const = lambda r, cq: pl.BlockSpec((r, cq), lambda i: (0, 0))
+    in_specs = [row(bm, cout), row(bm, cout), const(cin, cout),
+                const(1, cout), const(1, cout)]
+    inputs = [y, dy, w, dsum, dssq]
+    out_specs = [row(bm, cin)]
+    out_shape = [jax.ShapeDtypeStruct((M, cin), x.dtype)]
+    if prologue:
+        in_specs = [row(bm, cin)] + in_specs[:3] + [
+            const(1, cin), const(1, cin)] + in_specs[3:]
+        inputs = [x, y, dy, w, scale, shift, dsum, dssq]
+        out_specs += [const(1, cin), const(1, cin)]
+        out_shape += [jax.ShapeDtypeStruct((1, cin), jnp.float32)] * 2
+    out = pl.pallas_call(
         kernel,
-        grid=(M // bm,),
-        in_specs=[
-            pl.BlockSpec((bm, cin), lambda i: (i, 0)),
-            pl.BlockSpec((bm, cout), lambda i: (i, 0)),
-            pl.BlockSpec((bm, cout), lambda i: (i, 0)),
-            pl.BlockSpec((cin, cout), lambda i: (0, 0)),
-            pl.BlockSpec((1, cin), lambda i: (0, 0)),
-            pl.BlockSpec((1, cin), lambda i: (0, 0)),
-            pl.BlockSpec((1, cout), lambda i: (0, 0)),
-            pl.BlockSpec((1, cout), lambda i: (0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((bm, cin), lambda i: (i, 0)),
-            pl.BlockSpec((1, cin), lambda i: (0, 0)),
-            pl.BlockSpec((1, cin), lambda i: (0, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((M, cin), x.dtype),
-            jax.ShapeDtypeStruct((1, cin), jnp.float32),
-            jax.ShapeDtypeStruct((1, cin), jnp.float32),
-        ],
+        grid=(M // bm,),  # _pick_block_m guarantees bm | M (or bm == M)
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
         name="conv1x1_bn_bwd_dx",
-    )(x, y, dy, w, scale, shift, dsum, dssq)
-    return dx, dscale[0], dshift[0]
+    )(*inputs)
+    if prologue:
+        dx, dscale, dshift = out
+        return dx, dscale[0], dshift[0]
+    (dx,) = out
+    return dx, None, None  # no-prologue zero cotangents built by bwd()
 
 
 # ---------------------------------------------------------------------------

@@ -82,39 +82,75 @@ def pipeline_apply(
     x_mb: jax.Array,
     mesh: Mesh,
     aux_mb: Any = None,
+    n_virtual: int = 1,
 ) -> jax.Array:
-    """Run ``x_mb`` through the S-stage pipeline.
+    """Run ``x_mb`` through the S-stage (optionally interleaved) pipeline.
 
     stage_fn: (params_slice, x [mb, ...]) -> y [mb, ...] — shape-preserving.
         With ``aux_mb``, (params_slice, x, aux) -> y.
-    stage_params: every leaf [S, ...], to be sharded P('pipe').
+    stage_params: every leaf [S, ...] (``n_virtual == 1``) or
+        [S, V, ...] (interleaved: device d holds chunks v·S+d for
+        v in [0, V)), to be sharded P('pipe') on the leading dim.
     x_mb: [M, mb, ...] microbatches; mb dim is sharded over (data, fsdp),
         the microbatch dim M is replicated. Returns [M, mb, ...] outputs,
         pipe-replicated.
     aux_mb: optional pytree of [M, mb, ...] per-microbatch side inputs
         (e.g. attention masks) that do NOT hop the ring: every rank holds
         all microbatches' aux (they are small), and the schedule indexes
-        the slice for the microbatch currently at this stage (t - stage).
+        the slice for the microbatch currently at this stage.
+    n_virtual: V > 1 runs the Megatron-style interleaved (circular)
+        schedule — the network is cut into S·V chunks of L/(S·V) layers,
+        each device owns V non-contiguous chunks, and the bubble shrinks
+        V-fold to (S-1)/(M·V+S-1) at the cost of retaining ~V× more
+        per-tick activations for the backward (the scan is V× longer).
+        Requires M % S == 0.
     """
     n_stages = mesh.shape[mesh_lib.PIPE]
     M = x_mb.shape[0]
+    V = n_virtual
     for leaf in jax.tree.leaves(aux_mb):
         if jnp.ndim(leaf) < 2 or leaf.shape[0] != M:
             raise ValueError(
                 f"aux_mb leaves must be [M={M}, mb, ...] microbatched "
                 f"(use microbatch()); got shape {jnp.shape(leaf)}"
             )
+    if V == 1:
+        # canonical internal layout has the virtual-chunk dim: [S, 1, ...]
+        stage_params = jax.tree.map(lambda p: p[:, None], stage_params)
+    else:
+        for leaf in jax.tree.leaves(stage_params):
+            if jnp.ndim(leaf) < 2 or leaf.shape[1] != V:
+                raise ValueError(
+                    f"n_virtual={V} needs stage_params leaves laid out "
+                    f"[S, V, ...]; got shape {jnp.shape(leaf)} (build with "
+                    "to_pipeline_params(..., n_virtual=V) or stack chunks "
+                    "v*S+d at [d, v])"
+                )
     if n_stages == 1:
-        # degenerate: no pipe axis — just scan the single stage's params
-        sq = jax.tree.map(lambda p: p[0], stage_params)
+        # degenerate: no pipe axis — scan this device's chunks in order
+        sq = jax.tree.map(lambda p: p.reshape(-1, *p.shape[2:]), stage_params)
+
+        def through_chunks(x, aux=None):
+            def chunk(x, p):
+                return (stage_fn(p, x) if aux is None
+                        else stage_fn(p, x, aux)), None
+
+            y, _ = jax.lax.scan(chunk, x, sq)
+            return y
+
         if aux_mb is None:
-            return jax.vmap(lambda x: stage_fn(sq, x))(x_mb)
-        return jax.vmap(lambda x, a: stage_fn(sq, x, a))(x_mb, aux_mb)
+            return jax.vmap(through_chunks)(x_mb)
+        return jax.vmap(through_chunks)(x_mb, aux_mb)
     if M < n_stages:
         raise ValueError(
             f"need at least as many microbatches ({M}) as stages "
             f"({n_stages}) — bubble would dominate and the schedule "
             "below assumes M >= S"
+        )
+    if V > 1 and M % n_stages:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({M}) divisible by "
+            f"stages ({n_stages})"
         )
 
     batch_shards = mesh_lib.mesh_axis_size(mesh, mesh_lib.BATCH_AXES)
@@ -134,6 +170,7 @@ def pipeline_apply(
 
     body = functools.partial(
         _pipeline_body, stage_fn, n_stages=n_stages, n_microbatches=M,
+        n_virtual=V,
     )
     return jax.shard_map(
         body,
@@ -145,52 +182,63 @@ def pipeline_apply(
 
 
 def _pipeline_body(stage_fn, stage_params, x_mb, aux_mb, *, n_stages,
-                   n_microbatches):
+                   n_microbatches, n_virtual):
     """Per-device schedule; runs inside shard_map. stage_params leaves are
-    [1, ...] local slices; x_mb is [M, mb_local, ...]."""
+    [1, V, ...] local slices; x_mb is [M, mb_local, ...].
+
+    One unified schedule covers GPipe (V=1) and interleaved (V>1): chunk
+    c = v·S + d lives on device d; every tick runs ONE chunk per device
+    and hops the ring once. Device d at tick t is at local time
+    λ = t - d; with (g, r) = divmod(λ, S·V), (v, j) = divmod(r, S), it
+    runs chunk v on microbatch m = g·S + j. Producer-consumer timing is
+    exact by construction: chunk c's output for m (tick m + c) arrives at
+    chunk c+1 exactly when that chunk processes m (tick m + c + 1) — the
+    wraparound d = S-1 → d = 0 lands on v+1 with the same algebra."""
     stage = jax.lax.axis_index(mesh_lib.PIPE)
-    params_local = jax.tree.map(lambda p: p[0], stage_params)
-    M, S = n_microbatches, n_stages
+    params_local = jax.tree.map(lambda p: p[0], stage_params)  # [V, ...]
+    M, S, V = n_microbatches, n_stages, n_virtual
     perm = [(i, (i + 1) % S) for i in range(S)]
 
     fn = jax.checkpoint(stage_fn)
 
     def tick(carry, t):
         buf, outputs = carry
-        # stage 0 injects microbatch t (clamped; past-M ticks feed garbage
-        # that never reaches a collected output)
-        x_t = jax.lax.dynamic_index_in_dim(
-            x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
+        lam = t - stage
+        active = (lam >= 0) & (lam < M * V)
+        g, r = jnp.divmod(jnp.maximum(lam, 0), S * V)
+        v, j = jnp.divmod(r, S)
+        m = jnp.clip(g * S + j, 0, M - 1)
+        params_v = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, v, 0, keepdims=False),
+            params_local,
         )
-        inp = jnp.where(stage == 0, x_t, buf)
+        # device 0 injects a fresh microbatch whenever it starts chunk 0
+        x_t = jax.lax.dynamic_index_in_dim(x_mb, m, 0, keepdims=False)
+        inp = jnp.where((stage == 0) & (v == 0) & active, x_t, buf)
         if aux_mb is None:
-            y = fn(params_local, inp)
+            y = fn(params_v, inp)
         else:
-            # the microbatch at stage s on tick t is t - s (injected at
-            # tick t-s, hopped s rings); clamp covers warmup/drain garbage
-            mb_here = jnp.clip(t - stage, 0, M - 1)
             aux_t = jax.tree.map(
                 lambda a: jax.lax.dynamic_index_in_dim(
-                    a, mb_here, 0, keepdims=False
+                    a, m, 0, keepdims=False
                 ),
                 aux_mb,
             )
-            y = fn(params_local, inp, aux_t)
-        # collect this tick's result for microbatch t-(S-1); only stage
-        # S-1's buffer survives the masked psum below, so the per-tick
-        # guard only needs to protect index 0 from pre-warmup clamping
-        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            y = fn(params_v, inp, aux_t)
+        # the last device finishing the last chunk holds microbatch m's
+        # final output; collect it (only stage S-1's buffer survives the
+        # masked psum below, so garbage writes on other ranks are inert)
         updated = jax.lax.dynamic_update_index_in_dim(
-            outputs, y.astype(outputs.dtype), out_idx, 0
+            outputs, y.astype(outputs.dtype), m, 0
         )
-        outputs = jnp.where(t >= S - 1, updated, outputs)
+        outputs = jnp.where(active & (v == V - 1), updated, outputs)
         buf = jax.lax.ppermute(y, mesh_lib.PIPE, perm)
         return (buf, outputs), None
 
     buf0 = jnp.zeros_like(x_mb[0])
     out0 = jnp.zeros_like(x_mb)
     (_, outputs), _ = jax.lax.scan(
-        tick, (buf0, out0), jnp.arange(M + S - 1)
+        tick, (buf0, out0), jnp.arange(M * V + S - 1)
     )
     # broadcast stage S-1's outputs to every pipe rank (masked psum); the
     # other ranks' buffers hold zeros/garbage masked to zero above

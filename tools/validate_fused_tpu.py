@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Compiled-mode (Mosaic) validation of the fused conv+BN kernels on the
+real chip: small-shape forward + gradient parity vs the jnp oracle for
+every static config the ResNet integration uses, then one fused
+bottleneck block vs the standard flax block. Fast (<2 min warm) and
+read-only — run this before any fused bench.
+
+Exit code 0 = every check passed.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# Honor an explicit JAX_PLATFORMS even though the site plugin pre-set the
+# config at import (bench.py / parallel/cluster.py note).
+_env_platforms = os.environ.get("JAX_PLATFORMS")
+if _env_platforms and jax.config.jax_platforms != _env_platforms:
+    jax.config.update("jax_platforms", _env_platforms)
+
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.ops.fused_conv_bn import (
+    bn_scale_shift, conv1x1_bn_act, conv1x1_bn_act_reference,
+    moments_from_sums,
+)
+
+
+def check(name, got, want, tol):
+    g, w = np.asarray(got, np.float32), np.asarray(want, np.float32)
+    err = float(np.max(np.abs(g - w) / (np.abs(w) + 1.0)))
+    ok = err <= tol
+    print(f"{'ok ' if ok else 'FAIL'} {name}: rel_err={err:.2e} (tol {tol})")
+    return ok
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    r = np.random.RandomState(0)
+    M, cin, cout = 512, 64, 128
+    x = jnp.asarray(r.randn(M, cin), jnp.bfloat16)
+    w = jnp.asarray(r.randn(cin, cout) * 0.1, jnp.bfloat16)
+    gamma = jnp.asarray(r.rand(cin) + 0.5, jnp.float32)
+    beta = jnp.asarray(r.randn(cin) * 0.1, jnp.float32)
+    mean = jnp.asarray(r.randn(cin) * 0.2, jnp.float32)
+    var = jnp.asarray(r.rand(cin) + 0.3, jnp.float32)
+    scale, shift = bn_scale_shift(mean, var, gamma, beta, 1e-5)
+    ok = True
+
+    for prologue in (False, True):
+        args = (x, w, scale, shift) if prologue else (x, w)
+        got = jax.jit(
+            lambda *a: conv1x1_bn_act(*a, relu=True, emit_stats=True)
+        )(*args)
+        want = conv1x1_bn_act_reference(*args, relu=True, emit_stats=True)
+        for nm, g, wn in zip(("y", "sum", "ssq"), got, want):
+            ok &= check(f"fwd prologue={prologue} {nm}", g, wn, 3e-2)
+
+        def loss(fn):
+            def go(x, w, scale, shift):
+                a = (x, w, scale, shift) if prologue else (x, w)
+                y, s, q = fn(*a, relu=True, emit_stats=True)
+                mu, v = moments_from_sums(s, q, y.shape[0])
+                return ((y.astype(jnp.float32) ** 2).mean()
+                        + (mu * mu).sum() + jnp.sqrt(v + 1e-3).sum())
+            return go
+
+        got_g = jax.jit(jax.grad(loss(conv1x1_bn_act), argnums=(0, 1, 2, 3))
+                        )(x, w, scale, shift)
+        want_g = jax.grad(loss(conv1x1_bn_act_reference),
+                          argnums=(0, 1, 2, 3))(x, w, scale, shift)
+        n = 4 if prologue else 2
+        for nm, g, wn in list(zip(("dx", "dw", "dscale", "dshift"),
+                                  got_g, want_g))[:n]:
+            ok &= check(f"grad prologue={prologue} {nm}", g, wn, 5e-2)
+
+    # one fused bottleneck vs the standard flax block, train fwd + grad
+    from distributed_tensorflow_tpu.models import common
+    from distributed_tensorflow_tpu.models.resnet import ResNet50, ResNetConfig
+
+    kw = dict(stage_sizes=(1,), width=16, num_classes=10, dtype="bfloat16")
+    m_std = ResNet50(ResNetConfig(**kw))
+    m_f = ResNet50(ResNetConfig(block_impl="fused", **kw))
+    params, mstate = common.make_init_fn(m_std, (32, 32, 3))(
+        jax.random.PRNGKey(0)
+    )
+    xb = jnp.asarray(r.randn(8, 32, 32, 3), jnp.float32)
+
+    def loss_model(m):
+        def go(p):
+            out, _ = m.apply({"params": p, **mstate}, xb, train=True,
+                             mutable=["batch_stats"])
+            return (out.astype(jnp.float32) ** 2).mean()
+        return go
+
+    ok &= check("block fwd", jax.jit(loss_model(m_f))(params),
+                jax.jit(loss_model(m_std))(params), 3e-2)
+    gf = jax.jit(jax.grad(loss_model(m_f)))(params)
+    gs = jax.jit(jax.grad(loss_model(m_std)))(params)
+    ff, _ = jax.flatten_util.ravel_pytree(jax.device_get(gf))
+    fs, _ = jax.flatten_util.ravel_pytree(jax.device_get(gs))
+    ok &= check("block grad", ff, fs, 5e-2)
+
+    print("ALL OK" if ok else "FAILURES", flush=True)
+    raise SystemExit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
