@@ -7,6 +7,7 @@ from .pipeline import (  # noqa: F401
     make_dataset,
 )
 from .recsys import RecsysConfig, SyntheticCTR  # noqa: F401
+from . import tfdata  # noqa: F401  (TF imported lazily inside)
 from .text import (  # noqa: F401
     SyntheticLM,
     SyntheticMLM,

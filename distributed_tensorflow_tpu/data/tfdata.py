@@ -1,0 +1,79 @@
+"""tf.data adapter: drive the host-batch contract from a tf.data.Dataset.
+
+SURVEY.md §7 keeps tf.data as the input-pipeline *option* (the reference's
+own input path was per-worker ``tf.data`` with
+``Dataset.shard(num_workers, task_index)``, §2a). This adapter maps that
+world onto this framework's contract — an iterable of per-host numpy dict
+batches of size ``global_batch / process_count`` (data/pipeline.py) — so
+existing tf.data input pipelines (TFRecord readers, tf.image augmentation,
+interleave trees) port without rewriting:
+
+    parts = WorkloadParts(...,
+        dataset_fn=lambda start: tfdata.host_stream(
+            make_ds, cfg.data.global_batch_size, start_index=start),
+    )
+
+TensorFlow is imported lazily — the framework never requires it unless
+this adapter is used.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import jax
+import numpy as np
+
+from .pipeline import local_batch_size
+
+
+def shard_for_host(ds):
+    """The `Dataset.shard(num_workers, task_index)` of the reference,
+    keyed by JAX process topology: each host reads a disjoint 1/n slice.
+    Apply at the FILE or example level, before batching."""
+    return ds.shard(jax.process_count(), jax.process_index())
+
+
+def host_stream(
+    make_dataset: Callable[[], Any],
+    global_batch_size: int,
+    *,
+    start_index: int = 0,
+    shuffle_buffer: int = 0,
+    seed: int = 0,
+    repeat: bool = True,
+    shard: bool = True,
+) -> Iterator[dict]:
+    """Element-level tf.data factory -> per-host numpy dict batch stream.
+
+    make_dataset: returns an UNBATCHED tf.data.Dataset of dict elements
+        (e.g. {"image": ..., "label": ...}). Called once per stream.
+    start_index: number of BATCHES to skip — the resume offset the runner
+        passes (workloads/runner.py calls dataset_fn(start_step)).
+    shuffle_buffer: >0 enables per-epoch shuffling with a per-host seed
+        (disjoint host slices stay disjoint).
+    shard: set False when make_dataset already shards per host (a ported
+        pipeline with its own Dataset.shard, or file-level shard_for_host
+        inside the factory) — sharding twice would silently drop data.
+    """
+    import tensorflow as tf  # lazy: only adapter users need TF
+
+    local_bs = local_batch_size(global_batch_size)
+    ds = make_dataset()
+    if shard:
+        ds = shard_for_host(ds)
+    if shuffle_buffer > 0:
+        # shuffle BEFORE repeat so each epoch reshuffles and epoch
+        # boundaries aren't blended through the buffer
+        ds = ds.shuffle(
+            shuffle_buffer, seed=seed * 1_000_003 + jax.process_index(),
+            reshuffle_each_iteration=True,
+        )
+    if repeat:
+        ds = ds.repeat()
+    ds = ds.batch(local_bs, drop_remainder=True)
+    if start_index:
+        ds = ds.skip(start_index)
+    ds = ds.prefetch(tf.data.AUTOTUNE)
+    for elem in ds.as_numpy_iterator():
+        yield {k: np.asarray(v) for k, v in elem.items()}

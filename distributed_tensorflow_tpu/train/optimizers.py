@@ -67,25 +67,89 @@ def make_schedule(cfg: OptimizerConfig) -> optax.Schedule:
     return base
 
 
-def _l1_subgradient(l1: float) -> optax.GradientTransformation:
-    """Add l1·sign(w) to the gradient — subgradient L1, standing in for
-    FTRL's proximal L1 shrinkage (close for dense TPU updates; the exact
-    proximal form matters mainly in the sparse PS regime)."""
+def ftrl(
+    learning_rate,
+    lr_power: float = -0.5,
+    l1: float = 0.0,
+    l2: float = 0.0,
+    initial_accumulator_value: float = 0.1,
+) -> optax.GradientTransformation:
+    """Exact FTRL-Proximal (McMahan et al. 2013) — the same per-coordinate
+    update as the reference's `tf.train.FtrlOptimizer`
+    ($TF/python/training/ftrl.py → ApplyFtrl kernel), as an optax
+    transformation. Per coordinate, with accumulators z (adjusted
+    gradient) and n (sum of squared gradients):
+
+        n+ = n + g²;  σ = (n+^{-p} − n^{-p}) / α;  z+ = z + g − σ·w
+        w+ = 0                                   if |z+| ≤ λ1
+           = −(z+ − sign(z+)·λ1) / (n+^{-p}/α + 2λ2)   otherwise
+
+    Dense updates (every coordinate's n grows every step) — the TPU
+    regime; the reference used FTRL's sparse form on PS embeddings.
+    ``initial_accumulator_value`` matches the TF default (0.1)."""
+    import jax
+    import jax.numpy as jnp
+
+    sched = (
+        learning_rate if callable(learning_rate)
+        else (lambda _: learning_rate)
+    )
 
     def init(params):
-        del params
-        return optax.EmptyState()
+        # accumulators always f32 (the update math is f32 regardless of
+        # param dtype — state dtype must not change across steps)
+        return {
+            "z": jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            ),
+            "n": jax.tree.map(
+                lambda p: jnp.full(p.shape, initial_accumulator_value,
+                                   jnp.float32),
+                params,
+            ),
+            "count": jnp.zeros((), jnp.int32),
+        }
 
     def update(updates, state, params=None):
         if params is None:
-            raise ValueError("l1 regularization requires params")
-        import jax
-        import jax.numpy as jnp
+            raise ValueError("ftrl requires params")
+        lr = sched(state["count"])
+        # lr == 0 (e.g. warmup step 0) must be a no-op step, not a NaN:
+        # the update divides by lr, so compute with a stand-in and mask
+        live = lr > 0.0
+        lr_safe = jnp.where(live, lr, 1.0)
+        p = lr_power
 
-        updates = jax.tree.map(
-            lambda g, p: g + l1 * jnp.sign(p), updates, params
-        )
-        return updates, state
+        def one(g, z, n, w):
+            g = g.astype(jnp.float32)
+            w32 = w.astype(jnp.float32)
+            n_new = n + g * g
+            sigma = (jnp.power(n_new, -p) - jnp.power(n, -p)) / lr_safe
+            z_new = z + g - sigma * w32
+            quad = jnp.power(n_new, -p) / lr_safe + 2.0 * l2
+            w_new = jnp.where(
+                jnp.abs(z_new) <= l1,
+                0.0,
+                -(z_new - jnp.sign(z_new) * l1) / quad,
+            )
+            delta = jnp.where(live, w_new - w32, 0.0).astype(w.dtype)
+            return (delta, jnp.where(live, z_new, z),
+                    jnp.where(live, n_new, n))
+
+        # flatten/unflatten (not a tuple-leaved tree.map): params pytrees
+        # may themselves contain tuples
+        leaves_g, treedef = jax.tree.flatten(updates)
+        out = [
+            one(g, z, n, w)
+            for g, z, n, w in zip(
+                leaves_g, jax.tree.leaves(state["z"]),
+                jax.tree.leaves(state["n"]), jax.tree.leaves(params),
+            )
+        ]
+        unflat = lambda i: jax.tree.unflatten(treedef, [o[i] for o in out])
+        return unflat(0), {
+            "z": unflat(1), "n": unflat(2), "count": state["count"] + 1,
+        }
 
     return optax.GradientTransformation(init, update)
 
@@ -118,15 +182,10 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     elif name == "adagrad":
         tx = optax.adagrad(sched, eps=cfg.eps)
     elif name == "ftrl":
-        # optax has no ftrl; compose adagrad (FTRL's per-coordinate adaptive
-        # lr) + L1 subgradient + L2 decay. The reference used FTRL for the
-        # sparse/PS Wide&Deep regime; on TPU updates are dense.
-        parts = []
-        if cfg.l1:
-            parts.append(_l1_subgradient(cfg.l1))
-        if cfg.l2:
-            parts.append(optax.add_decayed_weights(cfg.l2))
-        tx = optax.chain(*parts, optax.adagrad(sched, eps=cfg.eps))
+        # exact FTRL-Proximal (optax ships none); parity-tested against
+        # tf.train.FtrlOptimizer
+        # (tests/test_loop_checkpoint.py::test_ftrl_matches_tf_reference)
+        tx = ftrl(sched, lr_power=cfg.lr_power, l1=cfg.l1, l2=cfg.l2)
     elif name == "rmsprop":
         tx = optax.rmsprop(sched, momentum=cfg.momentum, eps=cfg.eps)
     elif name == "lamb":

@@ -359,3 +359,74 @@ def test_manifest_async_save(mesh8, tmp_path):
     assert (tmp_path / "a" / "0" / "MANIFEST.dtf").exists()
     assert ckpt.verify_manifest(0) is True
     ckpt.close()
+
+
+def test_ftrl_matches_tf_reference():
+    """Exact-FTRL parity oracle: our optax ftrl() tracks
+    tf.compat.v1.train.FtrlOptimizer ($TF/python/training/ftrl.py) step
+    for step on the same gradient sequence, including L1 sparsification
+    and L2 shrinkage."""
+    import optax
+    tf = pytest.importorskip("tensorflow")
+
+    from distributed_tensorflow_tpu.train.optimizers import ftrl
+
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(12).astype(np.float32)
+    grads = [rng.randn(12).astype(np.float32) * 0.5 for _ in range(6)]
+    lr, l1, l2 = 0.1, 0.5, 0.02
+
+    # TF reference trajectory
+    var = tf.Variable(w0)
+    opt = tf.compat.v1.train.FtrlOptimizer(
+        learning_rate=lr, learning_rate_power=-0.5,
+        l1_regularization_strength=l1, l2_regularization_strength=l2,
+    )
+    tf_traj = []
+    for g in grads:
+        opt.apply_gradients([(tf.constant(g), var)])
+        tf_traj.append(var.numpy().copy())
+
+    # ours
+    tx = ftrl(lr, lr_power=-0.5, l1=l1, l2=l2)
+    params = jnp.asarray(w0)
+    state = tx.init(params)
+    for g, want in zip(grads, tf_traj):
+        upd, state = tx.update(jnp.asarray(g), state, params)
+        params = optax.apply_updates(params, upd)
+        np.testing.assert_allclose(np.asarray(params), want,
+                                   rtol=1e-5, atol=1e-6)
+    # L1 actually sparsifies
+    assert (np.asarray(params) == 0).sum() > 0
+
+
+def test_ftrl_warmup_and_bf16_and_tuple_trees():
+    """Regressions: lr=0 warmup step is a no-op (not NaN); accumulator
+    dtypes are stable f32 for bf16 params; tuple-containing pytrees work."""
+    import optax
+
+    from distributed_tensorflow_tpu.train.optimizers import ftrl
+
+    # warmup: step 0 has lr=0
+    sched = optax.linear_schedule(0.0, 0.1, 3)
+    tx = ftrl(sched)
+    params = jnp.ones((4,), jnp.bfloat16)
+    state = tx.init(params)
+    assert state["z"].dtype == jnp.float32
+    assert state["n"].dtype == jnp.float32
+    upd, state = tx.update(jnp.ones((4,), jnp.bfloat16), state, params)
+    assert np.all(np.asarray(upd, np.float32) == 0), "lr=0 must be a no-op"
+    assert state["z"].dtype == jnp.float32  # unchanged across steps
+    params = optax.apply_updates(params, upd)
+    for _ in range(3):
+        upd, state = tx.update(jnp.ones((4,), jnp.bfloat16), state, params)
+        params = optax.apply_updates(params, upd)
+    assert np.all(np.isfinite(np.asarray(params, np.float32)))
+
+    # tuple-structured param tree
+    tx2 = ftrl(0.1)
+    pt = ({"w": jnp.ones((2,))}, {"b": jnp.zeros((3,))})
+    st = tx2.init(pt)
+    g = ({"w": jnp.ones((2,))}, {"b": jnp.ones((3,))})
+    upd, st = tx2.update(g, st, pt)
+    assert upd[0]["w"].shape == (2,) and upd[1]["b"].shape == (3,)
