@@ -62,9 +62,13 @@ class WideDeepConfig:
 
 
 def embedding_rules() -> list[tuple[str, P]]:
-    """Path rules: vocab-shard every table over `model`; MLP replicated
-    (recommender MLPs are small — DP/fsdp handles them)."""
-    return [(r"table_\d+", P(mesh_lib.MODEL, None))]
+    """Path rules: vocab-shard every table (deep embeddings AND wide
+    linear columns) over `model`; MLP replicated (recommender MLPs are
+    small — DP/fsdp handles them)."""
+    return [
+        (r"table_\d+", P(mesh_lib.MODEL, None)),
+        (r"wide_table_\d+", P(mesh_lib.MODEL, None)),
+    ]
 
 
 class WideDeep(nn.Module):
@@ -78,25 +82,36 @@ class WideDeep(nn.Module):
         n_feat = len(cfg.vocab_sizes)
         assert cat.shape[-1] == n_feat, (cat.shape, n_feat)
 
-        def table_init(key, shape, dtype_):
-            # cols [:embed_dim] = deep embedding (normal); col [-1] = wide
-            # linear weight (zeros, like the reference's linear path)
-            v, d1 = shape
-            embed = nn.initializers.normal(
-                stddev=1.0 / jnp.sqrt(cfg.embed_dim)
-            )(key, (v, d1 - 1), dtype_)
-            return jnp.concatenate([embed, jnp.zeros((v, 1), dtype_)], axis=-1)
-
+        # Deep embedding tables and the wide linear weights are SEPARATE
+        # params (table_i [v, embed_dim] / wide_table_i [v, 1]): the
+        # reference trains the sparse wide weights with FTRL and the deep
+        # tables with AdaGrad (DNNLinearCombinedClassifier defaults), and
+        # optimizer grouping is per-leaf (workloads/wide_deep.py
+        # _canonical_tx) — a packed [v, embed_dim+1] table could not split.
         tables = [
-            self.param(f"table_{i}", table_init, (v, cfg.embed_dim + 1),
+            self.param(
+                f"table_{i}",
+                nn.initializers.normal(stddev=1.0 / jnp.sqrt(cfg.embed_dim)),
+                (v, cfg.embed_dim), jnp.float32,
+            )
+            for i, v in enumerate(cfg.vocab_sizes)
+        ]
+        wide_tables = [
+            # zeros, like the reference's linear path
+            self.param(f"wide_table_{i}", nn.initializers.zeros, (v, 1),
                        jnp.float32)
             for i, v in enumerate(cfg.vocab_sizes)
         ]
 
         lookup = self._make_lookup()
-        rows = [lookup(cat[..., i], t) for i, t in enumerate(tables)]
-        embeds = [r[..., : cfg.embed_dim].astype(dtype) for r in rows]
-        wide_logit = sum(r[..., cfg.embed_dim].astype(jnp.float32) for r in rows)
+        embeds = [
+            lookup(cat[..., i], t).astype(dtype)
+            for i, t in enumerate(tables)
+        ]
+        wide_logit = sum(
+            lookup(cat[..., i], t)[..., 0].astype(jnp.float32)
+            for i, t in enumerate(wide_tables)
+        )
         wide_logit = wide_logit + nn.Dense(
             1, dtype=jnp.float32, name="wide_dense"
         )(dense)[..., 0]

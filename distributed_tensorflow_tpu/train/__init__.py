@@ -16,7 +16,13 @@ from .step import (  # noqa: F401
     opt_state_specs,
     state_specs,
 )
-from .optimizers import OptimizerConfig, make_optimizer, make_schedule  # noqa: F401
+from .optimizers import (  # noqa: F401
+    OptimizerConfig,
+    ftrl,
+    make_multi_optimizer,
+    make_optimizer,
+    make_schedule,
+)
 from .loop import Trainer  # noqa: F401
 from . import callbacks  # noqa: F401
 from .checkpoint import (  # noqa: F401

@@ -154,6 +154,44 @@ def ftrl(
     return optax.GradientTransformation(init, update)
 
 
+def make_multi_optimizer(
+    rules, default: OptimizerConfig
+) -> optax.GradientTransformation:
+    """Per-parameter-group optimizers by path regex, first-match-wins —
+    the same path-rule idiom as parallel/sharding.py placement rules.
+
+    rules: ((path_regex, OptimizerConfig), ...); parameters whose
+    '/'-joined path matches no rule use ``default``. The canonical use is
+    the reference's Wide&Deep split — FTRL on the wide/linear columns,
+    AdaGrad on the deep net ($TF DNNLinearCombinedClassifier defaults,
+    linear_optimizer='Ftrl'/dnn_optimizer='Adagrad') — see
+    workloads/wide_deep.py.
+    """
+    import re
+
+    import jax
+
+    from ..parallel.sharding import _path_str
+
+    # string labels only: optax state holds them as dict keys, and jax
+    # pytrees cannot sort mixed-type keys
+    compiled = [(re.compile(pat), f"rule{i}") for i, (pat, _) in enumerate(rules)]
+    txs = {f"rule{i}": make_optimizer(c) for i, (_, c) in enumerate(rules)}
+    txs["default"] = make_optimizer(default)
+
+    def label_fn(params):
+        def lab(path, _leaf):
+            name = _path_str(path)
+            for rx, key in compiled:
+                if rx.search(name):
+                    return key
+            return "default"
+
+        return jax.tree_util.tree_map_with_path(lab, params)
+
+    return optax.multi_transform(txs, label_fn)
+
+
 def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     sched = make_schedule(cfg)
     name = cfg.name.lower()

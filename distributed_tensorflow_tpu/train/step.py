@@ -62,11 +62,24 @@ def opt_state_specs(opt_state: Any, params: Any, param_specs: Any) -> Any:
     This is the weight-update-sharding hook (arXiv:2004.13336): pass fsdp-
     sharded param_specs and the optimizer state shards with them."""
     param_treedef = jax.tree.structure(params)
+    masked_leaf = lambda x: isinstance(x, optax.MaskedNode)
 
     def rec(node):
         try:
             if jax.tree.structure(node) == param_treedef:
                 return param_specs
+        except (ValueError, TypeError):
+            pass
+        # optax.masked (the building block of multi_transform) replaces
+        # out-of-group params with empty MaskedNode containers; such a
+        # sub-tree still inherits the in-group param specs — mirror the
+        # MaskedNodes into the spec tree so treedefs stay identical
+        try:
+            if jax.tree.structure(node, is_leaf=masked_leaf) == param_treedef:
+                return jax.tree.map(
+                    lambda n, s: n if masked_leaf(n) else s,
+                    node, param_specs, is_leaf=masked_leaf,
+                )
         except (ValueError, TypeError):
             pass
         if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple

@@ -80,6 +80,9 @@ class WorkloadParts:
     eval_dataset_fn: Callable[[int], Iterable] | None = None
     flops_per_step: float | None = None  # analytic, for MFU
     param_rules: Any = None  # sharding path rules
+    # workload-supplied optimizer (e.g. a make_multi_optimizer split);
+    # None = runner builds one from cfg.optimizer
+    tx: Any = None
     fsdp: bool = False
     batch_size: int | None = None  # examples/step for throughput logs
     _jit_eval: Callable | None = dataclasses.field(default=None, repr=False)
@@ -105,7 +108,7 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
         logger.info("config:\n%s", config_lib.to_json(cfg))
 
     parts = build(cfg, mesh)
-    tx = make_optimizer(cfg.optimizer)
+    tx = parts.tx if parts.tx is not None else make_optimizer(cfg.optimizer)
     rng = jax.random.PRNGKey(cfg.train.seed)
 
     ckpt = None
@@ -216,7 +219,9 @@ def evaluate_from_checkpoint(
     if parts.eval_fn is None or parts.eval_dataset_fn is None:
         raise ValueError(f"workload {cfg.workload!r} has no eval surface")
 
-    tx = make_optimizer(cfg.optimizer)
+    # same tx resolution as run(): the restored opt_state's structure
+    # must match the workload's optimizer (e.g. wide_deep's multi split)
+    tx = parts.tx if parts.tx is not None else make_optimizer(cfg.optimizer)
     ckpt = Checkpointer(cfg.checkpoint, mesh)
     try:
         state, _, restored = init_or_restore(

@@ -26,7 +26,12 @@ def default_config() -> RunConfig:
         # embedding-parallel over `model`, DP over the rest
         mesh=MeshSpec(data=-1, model=2),
         data=DataConfig(dataset="synthetic_ctr", global_batch_size=256),
-        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        # name="auto" selects the workload-canonical split below (FTRL on
+        # the wide linear part, AdaGrad on the deep net + tables — the
+        # reference's DNNLinearCombinedClassifier defaults,
+        # $TF/python/estimator linear_optimizer='Ftrl'/dnn_optimizer=
+        # 'Adagrad'); any explicit --optimizer.name overrides it wholesale.
+        optimizer=OptimizerConfig(name="auto", learning_rate=0.02),
         train=TrainSection(num_steps=500, log_every=50),
     )
 
@@ -40,10 +45,29 @@ def _recsys_cfg(cfg: RunConfig) -> RecsysConfig:
     )
 
 
+def _canonical_tx(cfg: RunConfig):
+    """FTRL(wide) + AdaGrad(deep/tables) when optimizer.name == "auto"."""
+    if cfg.optimizer.name != "auto":
+        return None
+    from ..train import make_multi_optimizer
+
+    # matches wide_table_* (sparse linear weights) and wide_dense; user
+    # l1/l2/lr from the config carry through, defaulting l1 on if unset
+    ftrl_cfg = dataclasses.replace(
+        cfg.optimizer, name="ftrl",
+        l1=cfg.optimizer.l1 if cfg.optimizer.l1 > 0 else 1e-4,
+    )
+    return make_multi_optimizer(
+        rules=((r"(^|/)wide_", ftrl_cfg),),
+        default=dataclasses.replace(cfg.optimizer, name="adagrad"),
+    )
+
+
 def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
     model = wd.WideDeep(cfg.model, mesh)
     rcfg = _recsys_cfg(cfg)
     return WorkloadParts(
+        tx=_canonical_tx(cfg),
         init_fn=wd.make_init_fn(cfg.model, mesh),
         loss_fn=wd.ctr_loss_fn(model),
         eval_fn=wd.ctr_eval_fn(model),

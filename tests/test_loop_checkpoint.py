@@ -430,3 +430,25 @@ def test_ftrl_warmup_and_bf16_and_tuple_trees():
     g = ({"w": jnp.ones((2,))}, {"b": jnp.ones((3,))})
     upd, st = tx2.update(g, st, pt)
     assert upd[0]["w"].shape == (2,) and upd[1]["b"].shape == (3,)
+
+
+def test_multi_optimizer_path_rules():
+    """make_multi_optimizer routes params to per-group transforms by path
+    regex (first match wins) and falls through to the default."""
+    import optax
+
+    from distributed_tensorflow_tpu.train import make_multi_optimizer
+
+    tx = make_multi_optimizer(
+        rules=((r"(^|/)wide_", OptimizerConfig(name="sgd", learning_rate=1.0)),),
+        default=OptimizerConfig(name="sgd", learning_rate=0.1),
+    )
+    params = {"wide_dense": jnp.ones((3,)), "deep_0": jnp.ones((3,))}
+    state = tx.init(params)
+    grads = {"wide_dense": jnp.ones((3,)), "deep_0": jnp.ones((3,))}
+    upd, _ = tx.update(grads, state, params)
+    # wide gets lr 1.0, deep gets lr 0.1
+    np.testing.assert_allclose(np.asarray(upd["wide_dense"]), -np.ones(3),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(upd["deep_0"]), -0.1 * np.ones(3),
+                               rtol=1e-6)
