@@ -64,6 +64,13 @@ class TransformerConfig:
     moe_every: int = 2
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Fuse LayerNorm into the following matmul's prologue via the Pallas
+    # kernel (ops/fused_ln_matmul.py): the normalized tensor between
+    # ln1→q/k/v and ln2→mlp_in never hits HBM. Pre-LN only (post-LN's
+    # LayerNorm output IS the residual stream — it must materialize), and
+    # incompatible with a model-axis (TP) sharded mesh (the kernel isn't
+    # shard_map-wrapped here). Same param tree as the unfused path.
+    fused_ln_matmul: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -116,25 +123,72 @@ def tp_rules():
 # ---------------------------------------------------------------------------
 
 
+class _LNParams(nn.Module):
+    """LayerNorm scale/bias params only (flax naming) — the fused
+    ln_matmul path owns the math, this scope owns the tree."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        return (
+            self.param("scale", nn.initializers.ones, (self.features,)),
+            self.param("bias", nn.initializers.zeros, (self.features,)),
+        )
+
+
+class _DenseParams(nn.Module):
+    """nn.Dense-compatible kernel/bias params (same shapes, inits, tree)."""
+
+    features: int
+    in_features: int
+
+    @nn.compact
+    def __call__(self):
+        return (
+            self.param("kernel", nn.initializers.normal(0.02),
+                       (self.in_features, self.features)),
+            self.param("bias", nn.initializers.zeros, (self.features,)),
+        )
+
+
 class SelfAttention(nn.Module):
     cfg: TransformerConfig
     mesh: Any = None  # jax.sharding.Mesh or None; static module metadata
 
     @nn.compact
-    def __call__(self, x, mask, *, train: bool):
+    def __call__(self, x, mask, *, train: bool, ln_params=None):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         H, D = cfg.num_heads, cfg.head_dim
         B, S, _ = x.shape
-        dense = lambda name: nn.Dense(
-            H * D, dtype=dtype, name=name,
-            kernel_init=nn.initializers.normal(0.02),
-        )
         # [B,S,Hd] -> [B,H,S,D] (ops/ layout convention)
         split = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-        q = split(dense("query")(x))
-        k = split(dense("key")(x))
-        v = split(dense("value")(x))
+        if ln_params is not None:
+            # fused path: x is the RAW residual stream; q/k/v matmuls
+            # apply the block's LayerNorm in their kernel prologue
+            from ..ops.fused_ln_matmul import ln_matmul
+
+            ls, lb = ln_params
+            x2 = x.reshape(B * S, cfg.d_model)
+
+            def proj(name):
+                w, b = _DenseParams(H * D, cfg.d_model, name=name)()
+                return ln_matmul(
+                    x2, ls, lb, w.astype(dtype), b, out_dtype=dtype
+                ).reshape(B, S, H * D)
+
+            q = split(proj("query"))
+            k = split(proj("key"))
+            v = split(proj("value"))
+        else:
+            dense = lambda name: nn.Dense(
+                H * D, dtype=dtype, name=name,
+                kernel_init=nn.initializers.normal(0.02),
+            )
+            q = split(dense("query")(x))
+            k = split(dense("key")(x))
+            v = split(dense("value")(x))
 
         seq_shards = self.mesh.shape[mesh_lib.SEQ] if self.mesh is not None else 1
         if cfg.seq_impl is not None and seq_shards > 1:
@@ -205,17 +259,50 @@ class Block(nn.Module):
             def mlp(h):
                 h = moe(h, train=train)
                 return nn.Dropout(cfg.dropout, deterministic=not train)(h)
+
+            mlp_tail = None
         else:
 
-            def mlp(h):
-                h = nn.Dense(cfg.d_ff, dtype=dtype, name="mlp_in",
-                             kernel_init=nn.initializers.normal(0.02))(h)
+            def mlp_tail(h):
+                # everything after the mlp_in matmul — shared by the
+                # plain and fused-LN paths so they cannot drift
                 h = nn.gelu(h)
                 h = nn.Dense(cfg.d_model, dtype=dtype, name="mlp_out",
                              kernel_init=nn.initializers.normal(0.02))(h)
                 return nn.Dropout(cfg.dropout, deterministic=not train)(h)
 
-        if cfg.pre_ln:
+            def mlp(h):
+                h = nn.Dense(cfg.d_ff, dtype=dtype, name="mlp_in",
+                             kernel_init=nn.initializers.normal(0.02))(h)
+                return mlp_tail(h)
+
+        use_fused_ln = cfg.fused_ln_matmul and not self.use_moe
+        if use_fused_ln:
+            if not cfg.pre_ln:
+                raise ValueError(
+                    "fused_ln_matmul requires pre_ln=True (a post-LN "
+                    "LayerNorm output is the residual stream itself and "
+                    "must materialize)"
+                )
+            if self.mesh is not None and self.mesh.shape.get(
+                    mesh_lib.MODEL, 1) > 1:
+                raise ValueError(
+                    "fused_ln_matmul is incompatible with a model-axis "
+                    "(TP) sharded mesh; disable one of the two"
+                )
+            from ..ops.fused_ln_matmul import ln_matmul
+
+            B, S, d = x.shape
+            ln1 = _LNParams(d, name="ln1")()
+            x = x + attn(x, mask, train=train, ln_params=ln1)
+            ls2, lb2 = _LNParams(d, name="ln2")()
+            wi, bi = _DenseParams(cfg.d_ff, d, name="mlp_in")()
+            h = ln_matmul(
+                x.reshape(B * S, d), ls2, lb2, wi.astype(dtype), bi,
+                out_dtype=dtype,
+            ).reshape(B, S, cfg.d_ff)
+            x = x + mlp_tail(h)
+        elif cfg.pre_ln:
             x = x + attn(ln("ln1")(x).astype(dtype), mask, train=train)
             x = x + mlp(ln("ln2")(x).astype(dtype))
         else:  # post-LN (BERT)

@@ -1,0 +1,46 @@
+"""Shared VMEM-budget tile selection for the fused Pallas matmul kernels
+(ops/fused_conv_bn.py, ops/fused_ln_matmul.py)."""
+
+from __future__ import annotations
+
+import jax
+
+VMEM_BUDGET = 10 * 1024 * 1024  # leave headroom under ~16 MB/core
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def pick_block_m(M: int, k: int, n: int, *, name: str) -> int:
+    """Largest 8-aligned divisor of M whose [bm, k]/[bm, n] streaming
+    tiles fit the budget; a single whole-M block for tiny/odd M. A
+    block's sublane dim must be 8-aligned unless it covers the whole dim
+    (then Mosaic pads the array edge itself)."""
+    fits = lambda bm: (
+        2 * bm * (2 * k + 2 * n) + 4 * bm * (k + n) <= VMEM_BUDGET
+    )  # 2 buffers on the streamed operands + one f32 temp each
+    for bm in range(min(M, 1024) // 8 * 8, 7, -8):
+        if M % bm == 0 and fits(bm):
+            return bm
+    if fits(M):
+        return M
+    raise ValueError(
+        f"{name}: M={M} has no 8-aligned tile under the VMEM budget for "
+        f"k={k}, n={n}; make the row count divisible by a multiple of 8"
+    )
+
+
+def pick_block_n(k: int, n: int, *, name: str) -> int:
+    """Output-column tile for the dw kernels: the [k, bn] f32 accumulator
+    stays resident, so k*bn*4 is capped. bn must divide n and be
+    lane-aligned (multiple of 128, or the whole dim)."""
+    for bn in (n, *range(2048, 127, -128)):
+        if bn > n or n % bn:
+            continue
+        if k * bn * 4 <= 4 * 1024 * 1024:
+            return bn
+    raise ValueError(
+        f"{name}: n={n} has no lane-aligned tile whose [k={k}, bn] f32 "
+        "accumulator fits VMEM; pad n to a multiple of 128"
+    )

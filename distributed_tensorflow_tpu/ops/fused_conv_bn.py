@@ -40,46 +40,20 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
-_VMEM_BUDGET = 10 * 1024 * 1024  # leave headroom under ~16 MB/core
-
-
-def _on_tpu() -> bool:
-    return jax.default_backend() == "tpu"
+from . import _tiling
 
 
 def _pick_block_m(M: int, cin: int, cout: int) -> int:
-    """Largest M-tile (multiple of 8, divides M) fitting the VMEM budget:
-    x [bm, cin] bf16 + y [bm, cout] out + f32 compute temps, double-buffered."""
-    # A block's sublane dim must be 8-aligned unless the block covers the
-    # whole dim (then Mosaic pads the array edge itself). Largest aligned
-    # divisor of M within the VMEM budget, scanning all multiples of 8:
-    fits = lambda bm: (
-        2 * bm * (2 * cin + 2 * cout) + 4 * bm * (cin + cout)
-        <= _VMEM_BUDGET
-    )  # 2 buffers on x and y + one f32 temp each for prologue/matmul acc
-    for bm in range(min(M, 1024) // 8 * 8, 7, -8):
-        if M % bm == 0 and fits(bm):
-            return bm
-    if fits(M):
-        return M  # single whole-M block (tiny/odd M)
-    raise ValueError(
-        f"fused conv1x1 kernel: M={M} has no 8-aligned tile under the "
-        f"VMEM budget for cin={cin}, cout={cout}; make the per-shard "
-        "batch*H*W divisible by a multiple of 8, or use the standard "
-        "(unfused) block impl"
-    )
+    return _tiling.pick_block_m(M, cin, cout, name="fused conv1x1 kernel")
 
 
 def _pick_block_n(cin: int, cout: int) -> int:
-    """Cout tile for the dw kernel: [cin, bn] f32 accumulator resident."""
-    for bn in (cout, *range(2048, 127, -128)):
-        if cout % bn or bn > cout:
-            continue
-        if cin * bn * 4 <= 4 * 1024 * 1024:
-            return bn
-    return min(cout, 128)
+    return _tiling.pick_block_n(cin, cout, name="fused conv1x1 kernel")
+
+
+_on_tpu = _tiling.on_tpu
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,276 @@
+"""Pallas-TPU fused LayerNorm + matmul (+bias) — transformer hot path.
+
+Companion to ops/fused_conv_bn.py for the transformer family: every
+pre-LN block applies LayerNorm and immediately feeds a Dense matmul
+(qkv, mlp_in). XLA materializes the normalized tensor between them
+(write + read over [tokens, d_model]); here the matmul kernel normalizes
+its input tile in VMEM instead — LayerNorm statistics are ROW-local
+(mean/var over d_model, fully resident in a [bm, d] tile), so unlike
+BatchNorm no cross-tile stats pass exists at all. Per LN→matmul edge
+this removes the LN output write and its read(s); the backward kernels
+recompute x̂ per tile and fold the coupled LayerNorm backward (row
+means of dx̂ and dx̂·x̂) into the same pass that computes dx.
+
+Reference analog: the reference's BERT ran LayerNorm as separate
+CUDA/cuDNN ops around its matmuls; this is the TPU-native "native
+kernel" tier (SURVEY.md §5.8 native-code policy).
+
+Numerics: f32 statistics and accumulation, bf16 (or f32) IO; stats use
+eps inside rsqrt like flax LayerNorm. Interpret mode runs the same
+kernels on CPU (tests, SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import _tiling
+
+
+def _pick_block_m(M: int, d: int, n: int) -> int:
+    return _tiling.pick_block_m(M, d, n, name="fused ln_matmul")
+
+
+_on_tpu = _tiling.on_tpu
+
+
+def _ln(x32, gamma, beta, eps):
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    xhat = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return xhat, xhat * gamma + beta
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(x_ref, g_ref, b_ref, w_ref, bias_ref, y_ref, *, eps):
+    x32 = x_ref[:].astype(jnp.float32)
+    _, h = _ln(x32, g_ref[:], b_ref[:], eps)
+    y = jnp.dot(h.astype(x_ref.dtype), w_ref[:],
+                preferred_element_type=jnp.float32)
+    y_ref[:] = (y + bias_ref[:]).astype(y_ref.dtype)
+
+
+def _fwd_call(x, gamma, beta, w, bias, *, eps, out_dtype, interpret):
+    M, d = x.shape
+    n = w.shape[1]
+    bm = _pick_block_m(M, d, n)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(M // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((d, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, n), out_dtype),
+        interpret=interpret,
+        name="ln_matmul_fwd",
+    )(x, gamma, beta, w, bias)
+
+
+# ---------------------------------------------------------------------------
+# Backward A: dx (+ dgamma/dbeta/dbias) streaming the M grid
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dx_kernel(x_ref, g_ref, w_ref, dy_ref,
+                   dx_ref, dg_ref, db_ref, dbias_ref, *, eps):
+    x32 = x_ref[:].astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = (x32 - mu) * inv
+    dy = dy_ref[:].astype(jnp.float32)
+    # dh = dy @ w^T (contract over n)
+    dh = jax.lax.dot_general(
+        dy.astype(dy_ref.dtype), w_ref[:],
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dxhat = dh * g_ref[:]
+    # coupled LayerNorm backward, all row-local
+    m1 = dxhat.mean(-1, keepdims=True)
+    m2 = (dxhat * xhat).mean(-1, keepdims=True)
+    dx_ref[:] = ((dxhat - m1 - xhat * m2) * inv).astype(dx_ref.dtype)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        dg_ref[:] = jnp.zeros_like(dg_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+        dbias_ref[:] = jnp.zeros_like(dbias_ref)
+
+    dg_ref[:] += (dh * xhat).sum(0, keepdims=True)
+    db_ref[:] += dh.sum(0, keepdims=True)
+    dbias_ref[:] += dy.sum(0, keepdims=True)
+
+
+def _bwd_dx_call(x, gamma, w, dy, *, eps, interpret):
+    # beta is not an operand: dx/dgamma/dbeta/dbias are all independent
+    # of it (it only shifts the forward's h, which dw alone consumes)
+    M, d = x.shape
+    n = w.shape[1]
+    bm = _pick_block_m(M, d, n)
+    dx, dg, db, dbias = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, eps=eps),
+        grid=(M // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((d, n), lambda i: (0, 0)),
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, d), x.dtype),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=interpret,
+        name="ln_matmul_bwd_dx",
+    )(x, gamma, w, dy)
+    return dx, dg[0], db[0], dbias[0]
+
+
+# ---------------------------------------------------------------------------
+# Backward B: dw = h^T @ dy with a [d, bn]-tile accumulator
+# ---------------------------------------------------------------------------
+
+
+def _pick_block_n(d: int, n: int) -> int:
+    return _tiling.pick_block_n(d, n, name="fused ln_matmul")
+
+
+def _bwd_dw_kernel(x_ref, g_ref, b_ref, dy_ref, dw_ref, *, eps):
+    x32 = x_ref[:].astype(jnp.float32)
+    _, h = _ln(x32, g_ref[:], b_ref[:], eps)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+
+    dw_ref[:] += jax.lax.dot_general(
+        h.astype(x_ref.dtype), dy_ref[:],
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _bwd_dw_call(x, gamma, beta, dy, *, eps, interpret):
+    M, d = x.shape
+    n = dy.shape[1]
+    bm = _pick_block_m(M, d, n)
+    bn = _pick_block_n(d, n)
+    return pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, eps=eps),
+        grid=(n // bn, M // bm),  # M innermost: dw tile revisited
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, d), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, d), lambda j, i: (0, 0)),
+            pl.BlockSpec((bm, bn), lambda j, i: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((d, bn), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((d, n), jnp.float32),
+        interpret=interpret,
+        name="ln_matmul_bwd_dw",
+    )(x, gamma, beta, dy)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp composite + reference
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _make_op(eps, out_dtype, interpret):
+    @jax.custom_vjp
+    def op(x, gamma, beta, w, bias):
+        return _fwd_call(x, gamma, beta, w, bias, eps=eps,
+                         out_dtype=out_dtype, interpret=interpret)
+
+    def fwd(x, gamma, beta, w, bias):
+        y = _fwd_call(x, gamma, beta, w, bias, eps=eps,
+                      out_dtype=out_dtype, interpret=interpret)
+        return y, (x, gamma, beta, w)
+
+    def bwd(res, dy):
+        x, gamma, beta, w = res
+        dy = dy.astype(jnp.dtype(out_dtype))
+        dx, dg, db, dbias = _bwd_dx_call(
+            x, gamma, w, dy, eps=eps, interpret=interpret
+        )
+        dw = _bwd_dw_call(
+            x, gamma, beta, dy, eps=eps, interpret=interpret
+        ).astype(w.dtype)
+        # cotangent shapes match op's (1, d)/(1, n) operands; the public
+        # wrapper's reshape transposes them back to the caller's [d]/[n]
+        return (dx, dg.reshape(1, -1), db.reshape(1, -1), dw,
+                dbias.reshape(1, -1))
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def ln_matmul(
+    x: jax.Array,
+    gamma: jax.Array,
+    beta: jax.Array,
+    w: jax.Array,
+    bias: jax.Array | None = None,
+    *,
+    eps: float = 1e-6,
+    out_dtype=None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``LayerNorm(x; gamma, beta) @ w + bias`` in one kernel.
+
+    x: [M, d]; gamma/beta: [d] f32; w: [d, n]; bias: [n] or None.
+    Returns [M, n] in ``out_dtype`` (default: x.dtype).
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    M, d = x.shape
+    n = w.shape[1]
+    if bias is None:
+        bias = jnp.zeros((n,), jnp.float32)
+    out_dtype = jnp.dtype(out_dtype or x.dtype)
+    op = _make_op(float(eps), out_dtype.name, bool(interpret))
+    return op(
+        x,
+        gamma.reshape(1, d).astype(jnp.float32),
+        beta.reshape(1, d).astype(jnp.float32),
+        w,
+        bias.reshape(1, n).astype(jnp.float32),
+    )
+
+
+def ln_matmul_reference(x, gamma, beta, w, bias=None, *, eps=1e-6,
+                        out_dtype=None):
+    """Pure-jnp oracle with the same numerics contract."""
+    out_dtype = jnp.dtype(out_dtype or x.dtype)
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    h = (x32 - mu) * jax.lax.rsqrt(var + eps) * gamma.reshape(1, -1)
+    h = (h + beta.reshape(1, -1)).astype(x.dtype)
+    y = jnp.dot(h, w, preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + bias.reshape(1, -1)
+    return y.astype(out_dtype)
