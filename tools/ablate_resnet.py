@@ -21,14 +21,14 @@ BENCH = os.path.join(REPO, "bench.py")
 # from the operator's shell), and the pinned values are echoed into the
 # output row, so a sweep can't be silently mislabeled.
 _KNOBS = ("BENCH_STEM", "BENCH_NORM_DTYPE", "BENCH_DEBUG_METRICS",
-          "BENCH_BATCH", "BENCH_STEPS")
+          "BENCH_BATCH", "BENCH_STEPS", "BENCH_BLOCK_IMPL")
 
 
 def _variant(stem="space_to_depth", norm="bfloat16", dbg="0", batch="256",
-             steps="20"):
+             steps="20", blocks="standard"):
     return {"BENCH_STEM": stem, "BENCH_NORM_DTYPE": norm,
             "BENCH_DEBUG_METRICS": dbg, "BENCH_BATCH": batch,
-            "BENCH_STEPS": steps}
+            "BENCH_STEPS": steps, "BENCH_BLOCK_IMPL": blocks}
 
 
 VARIANTS = {
@@ -40,6 +40,10 @@ VARIANTS = {
     "combo384": _variant(batch="384"),
     "combo512": _variant(batch="512"),
     "combo1024": _variant(batch="1024"),
+    # round-2b fused Pallas conv+BN blocks (ops/fused_conv_bn.py)
+    "fused256": _variant(blocks="fused"),
+    "fused384": _variant(blocks="fused", batch="384"),
+    "fused512": _variant(blocks="fused", batch="512"),
 }
 
 
