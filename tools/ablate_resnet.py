@@ -36,7 +36,7 @@ VARIANTS = {
     "no_metrics": _variant(stem="conv", norm="float32"),
     "bf16_bn": _variant(stem="conv"),
     "s2d_f32bn": _variant(norm="float32"),
-    "combo256": _variant(),  # == the bench default config
+    "combo256": _variant(),  # round-2a tuned config, standard blocks
     "combo384": _variant(batch="384"),
     "combo512": _variant(batch="512"),
     "combo1024": _variant(batch="1024"),
