@@ -1,9 +1,15 @@
 #!/usr/bin/env python
-"""Compiled-mode (Mosaic) validation of the fused conv+BN kernels on the
-real chip: small-shape forward + gradient parity vs the jnp oracle for
-every static config the ResNet integration uses, then one fused
-bottleneck block vs the standard flax block. Fast (<2 min warm) and
+"""Compiled-mode (Mosaic) validation of every fused Pallas kernel on the
+real chip: small-shape forward + gradient parity vs the jnp oracles for
+(a) the conv1x1+BN kernels at every static config the ResNet integration
+uses, (b) the LayerNorm+matmul kernel, then whole-model comparisons —
+a fused-LN pre-LN transformer and a fused bottleneck ResNet vs their
+standard flax twins (fwd + full grad pytree). Fast (<3 min warm) and
 read-only — run this before any fused bench.
+
+Gradient/model checks use a max-normalized error (err relative to the
+largest entry of the oracle tensor) so tiny-magnitude gradients cannot
+pass vacuously under the elementwise damped metric.
 
 Exit code 0 = every check passed.
 """
@@ -21,7 +27,6 @@ _env_platforms = os.environ.get("JAX_PLATFORMS")
 if _env_platforms and jax.config.jax_platforms != _env_platforms:
     jax.config.update("jax_platforms", _env_platforms)
 
-import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,6 +41,23 @@ def check(name, got, want, tol):
     err = float(np.max(np.abs(g - w) / (np.abs(w) + 1.0)))
     ok = err <= tol
     print(f"{'ok ' if ok else 'FAIL'} {name}: rel_err={err:.2e} (tol {tol})")
+    return ok
+
+
+def check_scaled(name, got, want, tol):
+    """Max-abs error relative to the oracle's own largest entry.
+
+    Unlike ``check`` this cannot be satisfied vacuously by a
+    small-magnitude tensor: an all-zero ``got`` scores err = 1.0.
+    """
+    g, w = np.asarray(got, np.float32), np.asarray(want, np.float32)
+    scale = float(np.max(np.abs(w)))
+    if scale == 0.0:  # not assert: must survive python -O
+        print(f"FAIL {name}: oracle is all-zero, check would be vacuous")
+        return False
+    err = float(np.max(np.abs(g - w))) / scale
+    ok = err <= tol
+    print(f"{'ok ' if ok else 'FAIL'} {name}: scaled_err={err:.2e} (tol {tol})")
     return ok
 
 
@@ -79,32 +101,134 @@ def main():
                                   got_g, want_g))[:n]:
             ok &= check(f"grad prologue={prologue} {nm}", g, wn, 5e-2)
 
+    # ---- fused LayerNorm+matmul (ops/fused_ln_matmul.py) ----------------
+    from distributed_tensorflow_tpu.ops.fused_ln_matmul import (
+        ln_matmul, ln_matmul_reference,
+    )
+
+    M2, d, nn = 1024, 768, 768
+    lx = jnp.asarray(r.randn(M2, d), jnp.bfloat16)
+    lg = jnp.asarray(r.rand(d) + 0.5, jnp.float32)
+    lb = jnp.asarray(r.randn(d) * 0.1, jnp.float32)
+    lw = jnp.asarray(r.randn(d, nn) * 0.02, jnp.bfloat16)
+    lbias = jnp.asarray(r.randn(nn) * 0.1, jnp.float32)
+
+    got = jax.jit(ln_matmul)(lx, lg, lb, lw, lbias)
+    want = ln_matmul_reference(lx, lg, lb, lw, lbias)
+    ok &= check("ln_matmul fwd", got, want, 3e-2)
+
+    def ln_loss(fn):
+        def go(x, g, b, w, bias):
+            y = fn(x, g, b, w, bias)
+            return (y.astype(jnp.float32) ** 2).mean()
+        return go
+
+    got_g = jax.jit(jax.grad(ln_loss(ln_matmul), argnums=(0, 1, 2, 3, 4))
+                    )(lx, lg, lb, lw, lbias)
+    want_g = jax.grad(ln_loss(ln_matmul_reference), argnums=(0, 1, 2, 3, 4)
+                      )(lx, lg, lb, lw, lbias)
+    for nm, g, wn in zip(("dx", "dgamma", "dbeta", "dw", "dbias"),
+                         got_g, want_g):
+        ok &= check_scaled(f"ln_matmul grad {nm}", jnp.reshape(g, (-1,)),
+                           jnp.reshape(wn, (-1,)), 5e-2)
+
+    def compare_models(tag, loss_f, loss_std, params, fwd_tol, grad_tol):
+        """Fused-vs-standard twin comparison: jitted scalar loss + the
+        gradient pytree compared PER LEAF under the max-normalized
+        metric — a globally-raveled comparison would let large embedding
+        grads mask a broken small-magnitude leaf (dgamma/dbeta)."""
+        lf_val, gf = jax.jit(jax.value_and_grad(loss_f))(params)
+        ls_val, gs = jax.jit(jax.value_and_grad(loss_std))(params)
+        res = check_scaled(f"{tag} fwd", lf_val, ls_val, fwd_tol)
+        gf, gs = jax.device_get((gf, gs))
+        # Per-leaf scale, floored at 1% of the global max: a broken leaf
+        # whose true magnitude is within 100x of the dominant one still
+        # fails loudly, while structurally-degenerate leaves (key biases —
+        # softmax is shift-invariant in k, so their true grad is pure
+        # cancellation noise) aren't amplified into false alarms.
+        global_max = max(
+            float(np.max(np.abs(np.asarray(l, np.float32))))
+            for l in jax.tree.leaves(gs)
+        )
+        if global_max == 0.0:
+            print(f"FAIL {tag} grad: every oracle leaf is all-zero "
+                  "(degenerate params?) — comparison would be vacuous")
+            return False
+        worst_err, worst_leaf, leaf_ok = 0.0, "?", True
+        for (path, lf), (_, ls) in zip(
+            jax.tree_util.tree_leaves_with_path(gf),
+            jax.tree_util.tree_leaves_with_path(gs),
+        ):
+            g, w = np.asarray(lf, np.float32), np.asarray(ls, np.float32)
+            scale = max(float(np.max(np.abs(w))), 1e-2 * global_max)
+            err = float(np.max(np.abs(g - w))) / scale
+            if err > worst_err:
+                worst_err, worst_leaf = err, jax.tree_util.keystr(path)
+            leaf_ok &= err <= grad_tol
+        print(f"{'ok ' if leaf_ok else 'FAIL'} {tag} grad: worst leaf "
+              f"{worst_leaf} scaled_err={worst_err:.2e} (tol {grad_tol})")
+        return res & leaf_ok
+
+    # fused vs unfused pre-LN transformer twins (compiled), fwd + grad.
+    # f32 is the correctness gate (a wrong backward shows up at O(1));
+    # bf16 is the integration smoke test — its loose tol absorbs
+    # rounding-path divergence (both paths correct to bf16, different
+    # rounding order) amplified by cancellation in small leaves.
+    from distributed_tensorflow_tpu.models import transformer as tfm
+
+    for tdt, tf_fwd, tf_grad in (("float32", 1e-2, 2e-2),
+                                 ("bfloat16", 3e-2, 2.5e-1)):
+        tkw = dict(vocab_size=256, max_len=128, num_layers=2, d_model=128,
+                   num_heads=4, d_ff=256, dropout=0.0, causal=True,
+                   pre_ln=True, dtype=tdt)
+        t_std = tfm.Transformer(tfm.TransformerConfig(**tkw))
+        t_f = tfm.Transformer(
+            tfm.TransformerConfig(fused_ln_matmul=True, **tkw))
+        ids = jnp.asarray(r.randint(0, 256, (4, 128)), jnp.int32)
+        tparams = t_std.init(jax.random.PRNGKey(1), ids,
+                             train=False)["params"]
+
+        def lm_loss(m):
+            def go(p):
+                logits = m.apply({"params": p}, ids, train=False)
+                return (logits.astype(jnp.float32) ** 2).mean()
+            return go
+
+        ok &= compare_models(f"transformer fused-LN [{tdt}]", lm_loss(t_f),
+                             lm_loss(t_std), tparams, tf_fwd, tf_grad)
+
     # one fused bottleneck vs the standard flax block, train fwd + grad
     from distributed_tensorflow_tpu.models import common
     from distributed_tensorflow_tpu.models.resnet import ResNet50, ResNetConfig
 
-    kw = dict(stage_sizes=(1,), width=16, num_classes=10, dtype="bfloat16")
-    m_std = ResNet50(ResNetConfig(**kw))
-    m_f = ResNet50(ResNetConfig(block_impl="fused", **kw))
-    params, mstate = common.make_init_fn(m_std, (32, 32, 3))(
-        jax.random.PRNGKey(0)
-    )
-    xb = jnp.asarray(r.randn(8, 32, 32, 3), jnp.float32)
+    for rdt, r_fwd, r_grad in (("float32", 1e-2, 2e-2),
+                               ("bfloat16", 3e-2, 2.5e-1)):
+        kw = dict(stage_sizes=(1,), width=16, num_classes=10, dtype=rdt)
+        m_std = ResNet50(ResNetConfig(**kw))
+        m_f = ResNet50(ResNetConfig(block_impl="fused", **kw))
+        params, mstate = common.make_init_fn(m_std, (32, 32, 3))(
+            jax.random.PRNGKey(0)
+        )
+        # Perturb away from init: the zero-init bn3 gamma (resnet.py:84)
+        # makes every upstream grad in the residual branch exactly zero at
+        # init, so the per-leaf comparison would be vacuous there.
+        leaves, treedef = jax.tree.flatten(params)
+        keys = jax.random.split(jax.random.PRNGKey(7), len(leaves))
+        params = jax.tree.unflatten(treedef, [
+            l + 0.05 * jax.random.normal(k, l.shape, l.dtype)
+            for l, k in zip(leaves, keys)
+        ])
+        xb = jnp.asarray(r.randn(8, 32, 32, 3), jnp.float32)
 
-    def loss_model(m):
-        def go(p):
-            out, _ = m.apply({"params": p, **mstate}, xb, train=True,
-                             mutable=["batch_stats"])
-            return (out.astype(jnp.float32) ** 2).mean()
-        return go
+        def loss_model(m):
+            def go(p):
+                out, _ = m.apply({"params": p, **mstate}, xb, train=True,
+                                 mutable=["batch_stats"])
+                return (out.astype(jnp.float32) ** 2).mean()
+            return go
 
-    ok &= check("block fwd", jax.jit(loss_model(m_f))(params),
-                jax.jit(loss_model(m_std))(params), 3e-2)
-    gf = jax.jit(jax.grad(loss_model(m_f)))(params)
-    gs = jax.jit(jax.grad(loss_model(m_std)))(params)
-    ff, _ = jax.flatten_util.ravel_pytree(jax.device_get(gf))
-    fs, _ = jax.flatten_util.ravel_pytree(jax.device_get(gs))
-    ok &= check("block grad", ff, fs, 5e-2)
+        ok &= compare_models(f"resnet fused-block [{rdt}]", loss_model(m_f),
+                             loss_model(m_std), params, r_fwd, r_grad)
 
     print("ALL OK" if ok else "FAILURES", flush=True)
     raise SystemExit(0 if ok else 1)
