@@ -16,7 +16,6 @@ All diagnostics go to stderr; stdout carries only the JSON line.
 import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
@@ -29,11 +28,9 @@ def log(*a):
 def main() -> None:
     import jax
 
-    # Honor an explicit JAX_PLATFORMS env var even if a site plugin
-    # overrode the config default at import (parallel/cluster.py note).
-    env_platforms = os.environ.get("JAX_PLATFORMS")
-    if env_platforms and jax.config.jax_platforms != env_platforms:
-        jax.config.update("jax_platforms", env_platforms)
+    from distributed_tensorflow_tpu.utils import benchmarking as bm
+
+    bm.honor_env_platform()
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -49,15 +46,10 @@ def main() -> None:
     )
     from distributed_tensorflow_tpu.utils import flops as flops_lib
 
-    devices = jax.devices()
-    n_chips = len(devices)
-    platform = devices[0].platform
-    kind = getattr(devices[0], "device_kind", "")
-    # Robust TPU detection: tunneled platforms (axon) expose platform="tpu"
-    # / device_kind="TPU v5 lite"; gate on either so an accelerator never
-    # silently gets the tiny-CPU fallback config.
-    on_tpu = platform == "tpu" or kind.upper().startswith("TPU")
-    log(f"bench devices: {devices} (platform={platform}, kind={kind})")
+    # Robust TPU detection for tunneled platforms lives in
+    # utils/benchmarking.py, shared with tools/bench_bert.py.
+    devices, n_chips, platform, on_tpu = bm.describe_devices()
+    log(f"bench devices: {devices} (platform={platform})")
 
     # Per-chip batch sized for a v5e (16 GiB HBM) bf16 train step; tiny on
     # CPU so the fallback run finishes fast.
@@ -126,29 +118,12 @@ def main() -> None:
         batch,
     )
 
-    # Timing sync MUST fetch a value: on tunneled platforms (axon relay)
-    # jax.block_until_ready returns before the computation runs, which
-    # inflates step rates ~40x. device_get of the chained loss forces every
-    # step in the dependency chain to have executed.
-    def sync(metrics) -> float:
-        return float(jax.device_get(metrics["loss"]))
-
-    warmup = 3
+    # Timing sync MUST fetch a value (tunneled platforms): see
+    # utils/benchmarking.timed_steps, shared with tools/bench_bert.py.
     measured = int(os.environ.get("BENCH_STEPS", "20"))
-    log("compiling + warmup...")
-    for _ in range(warmup):
-        state, metrics = step(state, batch)
-    sync(metrics)
-    log("measuring...")
-    t0 = time.perf_counter()
-    for _ in range(measured):
-        state, metrics = step(state, batch)
-    final_loss = sync(metrics)
-    dt = time.perf_counter() - t0
-    log(f"final loss {final_loss:.4f} (finite => really trained)")
-    assert np.isfinite(final_loss)
-
-    steps_per_sec = measured / dt
+    state, steps_per_sec, final_loss = bm.timed_steps(
+        step, state, lambda: batch, warmup=3, measured=measured, log=log,
+    )
     images_per_sec = steps_per_sec * global_batch
     images_per_sec_per_chip = images_per_sec / n_chips
 
@@ -181,16 +156,9 @@ def main() -> None:
     )
     put = lambda b: jax.tree.map(jax.device_put, b, shardings)
     fed = iter(Prefetcher(host_stream(), depth=2, transform=put))
-    for _ in range(2):  # warm the fed path (no recompile: same shapes)
-        state, metrics = step(state, next(fed))
-    sync(metrics)
-    t0 = time.perf_counter()
-    for _ in range(measured):
-        state, metrics = step(state, next(fed))
-    fed_loss = sync(metrics)
-    fed_dt = time.perf_counter() - t0
-    assert np.isfinite(fed_loss)
-    fed_steps_per_sec = measured / fed_dt
+    state, fed_steps_per_sec, _ = bm.timed_steps(
+        step, state, lambda: next(fed), warmup=2, measured=measured, log=log,
+    )
     fed_images_per_sec_per_chip = fed_steps_per_sec * global_batch / n_chips
     pipeline_efficiency = fed_steps_per_sec / steps_per_sec
     log(f"pipeline-fed: steps/sec={fed_steps_per_sec:.3f} "

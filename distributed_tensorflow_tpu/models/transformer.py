@@ -71,6 +71,12 @@ class TransformerConfig:
     # incompatible with a model-axis (TP) sharded mesh (the kernel isn't
     # shard_map-wrapped here). Same param tree as the unfused path.
     fused_ln_matmul: bool = False
+    # Rematerialize each Block on the backward pass (jax.checkpoint via
+    # nn.remat): activation memory drops from O(L) blocks to O(1) at the
+    # cost of one extra forward — the TPU-native descendant of TF's
+    # recompute_grad, and the standard lever for long-sequence/large-batch
+    # HBM pressure (task brief: trade FLOPs for memory).
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -238,7 +244,10 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, mask, *, train: bool):
+    def __call__(self, x, mask, train: bool):
+        # ``train`` is positional (not kw-only) so nn.remat can mark it
+        # static (static_argnums counts the module itself as arg 0) —
+        # but deliberately has no default: every call site must decide.
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
@@ -337,12 +346,18 @@ class Transformer(nn.Module):
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
 
         mask = attention_mask.astype(bool) if attention_mask is not None else None
+        # nn.remat-ed blocks recompute their forward during backward:
+        # O(1)-block activation memory (cfg.remat docstring). argnums:
+        # 0 = module, 1 = x, 2 = mask, 3 = train (static python bool).
+        block_cls = (
+            nn.remat(Block, static_argnums=(3,)) if cfg.remat else Block
+        )
         for i in range(cfg.num_layers):
             use_moe = (
                 cfg.num_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
             )
-            x = Block(cfg, self.mesh, use_moe, name=f"layer_{i}")(
-                x, mask, train=train
+            x = block_cls(cfg, self.mesh, use_moe, name=f"layer_{i}")(
+                x, mask, train
             )
         if cfg.pre_ln:
             x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x).astype(dtype)

@@ -1,0 +1,94 @@
+"""Shared benchmark-harness scaffolding (bench.py, tools/bench_bert.py).
+
+The load-bearing pieces every throughput harness in this repo must agree
+on, extracted so they cannot drift between benchmarks:
+
+- **Platform detection** that never mistakes a tunneled accelerator for
+  CPU: axon-relayed chips report ``platform="tpu"`` / ``device_kind="TPU
+  v5 lite"``, so both are checked (a miss would silently bench the tiny
+  CPU-fallback model and report it as the real number).
+- **Execution-forcing sync**: on tunneled platforms ``jax.block_until_
+  ready`` returns before the computation runs, inflating step rates
+  ~40x. Only fetching a VALUE that data-depends on every measured step
+  (the chained loss) proves the work happened.
+- **Warmup/measure loop** with the sync applied once at each boundary,
+  and a finite-loss assertion so a diverged/never-ran step can't post a
+  throughput number.
+
+Reference analog: the reference harness read its throughput off
+``StepCounterHook`` logs ($TF basic_session_run_hooks.py:674); the
+value-fetch discipline here is the TPU-async-dispatch replacement for
+TF-session's synchronous ``run()`` returning fetched tensors.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+__all__ = [
+    "honor_env_platform", "describe_devices", "sync_by_value",
+    "timed_steps",
+]
+
+
+def honor_env_platform() -> None:
+    """Make an explicit ``JAX_PLATFORMS`` env var win even though the
+    site plugin may have overridden the config default at import time
+    (parallel/cluster.py note)."""
+    import os
+
+    env = os.environ.get("JAX_PLATFORMS")
+    if env and jax.config.jax_platforms != env:
+        jax.config.update("jax_platforms", env)
+
+
+def describe_devices() -> tuple[list, int, str, bool]:
+    """(devices, n_chips, platform, on_tpu) — robust TPU detection for
+    tunneled platforms (see module docstring)."""
+    devices = jax.devices()
+    platform = devices[0].platform
+    kind = getattr(devices[0], "device_kind", "")
+    on_tpu = platform == "tpu" or kind.upper().startswith("TPU")
+    return devices, len(devices), platform, on_tpu
+
+
+def sync_by_value(metrics: dict) -> float:
+    """Force execution of every step the loss data-depends on by
+    fetching its value; returns the loss as a host float."""
+    return float(jax.device_get(metrics["loss"]))
+
+
+def timed_steps(
+    step: Callable[[Any, Any], tuple[Any, dict]],
+    state: Any,
+    next_batch: Callable[[], Any],
+    *,
+    warmup: int,
+    measured: int,
+    log: Callable[[str], None] = lambda s: None,
+) -> tuple[Any, float, float]:
+    """Warmup then time ``measured`` chained steps.
+
+    ``next_batch`` is called once per step (return the same resident
+    batch for a device-throughput window, or pull from a prefetcher for
+    a pipeline-fed window). Returns ``(state, steps_per_sec, loss)``;
+    asserts the final loss is finite so a broken run cannot post a rate.
+    """
+    log("compiling + warmup...")
+    metrics = None
+    for _ in range(warmup):
+        state, metrics = step(state, next_batch())
+    sync_by_value(metrics)
+    log("measuring...")
+    t0 = time.perf_counter()
+    for _ in range(measured):
+        state, metrics = step(state, next_batch())
+    loss = sync_by_value(metrics)
+    dt = time.perf_counter() - t0
+    log(f"final loss {loss:.4f} (finite => really trained)")
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+    return state, measured / dt, loss

@@ -239,3 +239,38 @@ def test_param_count_matches_analytic_moe():
     # active params: only top_k of num_experts FFNs per MoE block
     assert tfm.active_param_count(cfg) < tfm.param_count(cfg)
     assert tfm.active_param_count(tiny_cfg()) == tfm.param_count(tiny_cfg())
+
+
+def test_remat_preserves_forward_and_grads():
+    """cfg.remat wraps blocks in nn.remat (jax.checkpoint): identical
+    param tree, bit-equal-at-f32-tolerance forward, and matching grads —
+    only the backward's memory/recompute schedule may differ."""
+    cfg = tiny_cfg()
+    m_plain = tfm.Transformer(cfg)
+    m_remat = tfm.Transformer(dataclasses.replace(cfg, remat=True))
+    ids = (jnp.arange(4 * 16, dtype=jnp.int32).reshape(4, 16)
+           % cfg.vocab_size)
+    params, _ = tfm.make_init_fn(m_plain, 16)(jax.random.PRNGKey(0))
+    # same tree: remat is a lifted transform, not a reparameterization
+    p2, _ = tfm.make_init_fn(m_remat, 16)(jax.random.PRNGKey(0))
+    assert jax.tree_util.tree_structure(params) == \
+        jax.tree_util.tree_structure(p2)
+
+    def loss(m):
+        def go(p):
+            lg = m.apply({"params": p}, ids, train=False)
+            return (lg.astype(jnp.float32) ** 2).mean()
+        return go
+
+    np.testing.assert_allclose(
+        jax.jit(loss(m_remat))(params), jax.jit(loss(m_plain))(params),
+        rtol=1e-6)
+    g_r = jax.jit(jax.grad(loss(m_remat)))(params)
+    g_p = jax.jit(jax.grad(loss(m_plain)))(params)
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(g_r),
+        jax.tree_util.tree_leaves_with_path(g_p),
+    ):
+        np.testing.assert_allclose(
+            a, b, rtol=2e-5, atol=1e-7,
+            err_msg=jax.tree_util.keystr(path))

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Secondary benchmark: BERT-base MLM training throughput + MFU on the
+available chip(s) — the BASELINE.json:10 workload, same honest timing
+contract as the flagship bench.py (value-fetch sync, steady-state window
+after warmup). Transformers are matmul-dominated, so unlike bandwidth-
+bound ResNet-50 this measures how close the framework gets to the MXU
+roofline.
+
+Prints ONE JSON line to stdout; diagnostics to stderr.
+
+Env knobs:
+  BENCH_BATCH       PER-CHIP batch (default 128 on TPU, 8 on CPU) —
+                    same semantics as the flagship bench.py
+  BENCH_SEQ         sequence length (default 512, the reference's config)
+  BENCH_STEPS       measured steps (default 20)
+  BENCH_MODEL       "bert" (post-LN encoder MLM, default) | "gpt"
+                    (pre-LN causal LM — the fused-LN showcase)
+  BENCH_FUSED_LN    "1" to fuse LayerNorm into matmuls (pre-LN only,
+                    i.e. BENCH_MODEL=gpt)
+  BENCH_REMAT       "1" to jax.checkpoint each block (fit bigger batches)
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    from distributed_tensorflow_tpu.utils import benchmarking as bm
+
+    bm.honor_env_platform()
+    import dataclasses
+
+    import numpy as np
+
+    from distributed_tensorflow_tpu.data.text import IGNORE_INDEX
+    from distributed_tensorflow_tpu.models import transformer as tfm
+    from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh, describe
+    from distributed_tensorflow_tpu.parallel import sharding as sh
+    from distributed_tensorflow_tpu.train import (
+        OptimizerConfig, StepOptions, init_train_state, jit_train_step,
+        make_optimizer, make_train_step,
+    )
+    from distributed_tensorflow_tpu.utils import flops as flops_lib
+
+    devices, n_chips, platform, on_tpu = bm.describe_devices()
+    log(f"bench devices: {devices} (platform={platform})")
+
+    which = os.environ.get("BENCH_MODEL", "bert")
+    fused_ln = os.environ.get("BENCH_FUSED_LN", "0") == "1"
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    seq = int(os.environ.get("BENCH_SEQ", "512"))
+    # per-chip, like bench.py: the number scales with slice size instead
+    # of silently shrinking per chip
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", "128" if on_tpu else "8"))
+    global_batch = per_chip_batch * n_chips
+
+    if which == "bert":
+        cfg = tfm.bert_base()
+        if fused_ln:
+            raise SystemExit("BENCH_FUSED_LN needs BENCH_MODEL=gpt "
+                             "(BERT is post-LN; the kernel is pre-LN-only)")
+    elif which == "gpt":
+        cfg = tfm.gpt_small(causal_len=max(seq, 512))
+        cfg = dataclasses.replace(cfg, fused_ln_matmul=fused_ln)
+    else:
+        raise SystemExit(f"unknown BENCH_MODEL={which!r}")
+    if not on_tpu:  # tiny fallback so the CPU smoke run finishes fast
+        cfg = dataclasses.replace(
+            cfg, num_layers=2, d_model=128, num_heads=4, d_ff=256,
+            vocab_size=1024, max_len=max(seq, 128), dtype="float32",
+        )
+    cfg = dataclasses.replace(cfg, remat=remat)
+    if seq > cfg.max_len:
+        raise SystemExit(f"BENCH_SEQ={seq} > max_len={cfg.max_len}")
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    log(f"mesh: {describe(mesh)}  model={which} fused_ln={fused_ln} "
+        f"seq={seq} global_batch={global_batch}")
+
+    model = tfm.Transformer(cfg, mesh)
+    loss_fn = tfm.mlm_loss_fn(model) if which == "bert" \
+        else tfm.lm_loss_fn(model)
+    tx = make_optimizer(OptimizerConfig(
+        name="adamw", learning_rate=1e-4, weight_decay=0.01,
+    ))
+    state, specs = init_train_state(
+        tfm.make_init_fn(model, seq), tx, mesh, jax.random.PRNGKey(0),
+        param_rules=tfm.tp_rules(),
+    )
+    step = jit_train_step(
+        make_train_step(loss_fn, tx, StepOptions()), mesh, specs,
+    )
+
+    rng = np.random.RandomState(0)
+    from jax.sharding import NamedSharding
+
+    ids = rng.randint(0, cfg.vocab_size, (global_batch, seq)).astype(np.int32)
+    batch = {"input_ids": ids}
+    if which == "bert":
+        batch["labels"] = np.where(
+            rng.rand(global_batch, seq) < 0.15, ids, IGNORE_INDEX
+        ).astype(np.int32)
+    batch = jax.tree.map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, sh.batch_spec(np.ndim(x)))
+        ),
+        batch,
+    )
+
+    measured = int(os.environ.get("BENCH_STEPS", "20"))
+    state, steps_per_sec, final_loss = bm.timed_steps(
+        step, state, lambda: batch, warmup=3, measured=measured, log=log,
+    )
+    examples_per_sec_per_chip = steps_per_sec * global_batch / n_chips
+    model_flops = (tfm.flops_per_example(cfg, seq) * global_batch
+                   * flops_lib.train_flops_multiplier())
+    peak = flops_lib.peak_flops_per_chip(devices[0])
+    mfu = flops_lib.mfu(model_flops, steps_per_sec, n_chips, peak)
+    log(f"steps/sec={steps_per_sec:.3f} "
+        f"examples/sec/chip={examples_per_sec_per_chip:.1f} MFU={mfu:.3f}")
+
+    print(json.dumps({
+        "metric": f"{which}_examples_per_sec_per_chip",
+        "value": round(examples_per_sec_per_chip, 2),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "mfu": round(mfu, 4),
+        "platform": platform,
+        "n_chips": n_chips,
+        "global_batch": global_batch,
+        "seq_len": seq,
+        "model": which,
+        "fused_ln_matmul": fused_ln,
+        "full_size_model": bool(on_tpu),
+    }))
+
+
+if __name__ == "__main__":
+    main()
