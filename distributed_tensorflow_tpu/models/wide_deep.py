@@ -45,6 +45,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
+from ..utils import metrics as metrics_lib
 
 
 @dataclasses.dataclass(frozen=True)
@@ -176,6 +177,10 @@ def ctr_loss_fn(model: WideDeep):
 
 
 def ctr_eval_fn(model: WideDeep):
+    """Summed eval stats + streaming-AUC histograms (the reference's CTR
+    metric of record: $TF/python/ops/metrics_impl.py:809 tf.metrics.auc;
+    see utils/metrics.py for the mergeable-histogram formulation)."""
+
     def eval_fn(params, model_state, batch):
         logits = model.apply(
             {"params": params, **model_state}, batch["cat"], batch["dense"]
@@ -187,6 +192,7 @@ def ctr_eval_fn(model: WideDeep):
             "loss_sum": loss,
             "correct": correct,
             "count": jnp.asarray(labels.shape[0], jnp.float32),
+            **metrics_lib.auc_histograms(logits, labels),
         }
 
     return eval_fn

@@ -33,6 +33,7 @@ from ..train import (
     make_train_step,
 )
 from ..utils import config as config_lib
+from ..utils import metrics as metrics_lib
 
 logger = logging.getLogger(__name__)
 
@@ -182,18 +183,25 @@ def _run_eval(state: Any, put_batch: Callable, parts: WorkloadParts,
     if parts._jit_eval is None:
         parts._jit_eval = jax.jit(make_eval_step(parts.eval_fn))
     eval_step = parts._jit_eval
-    totals: dict[str, float] = {}
+    # Summed sufficient statistics: scalars AND fixed-size arrays (e.g.
+    # the AUC histograms, utils/metrics.py) merge by addition.
+    totals: dict[str, np.ndarray] = {}
     import itertools
 
     for batch in itertools.islice(parts.eval_dataset_fn(num_batches), num_batches):
         out = eval_step(state, put_batch(batch))
         for k, v in out.items():
-            totals[k] = totals.get(k, 0.0) + float(np.asarray(v))
-    result = dict(totals)
-    if "correct" in totals and totals.get("count"):
-        result["accuracy"] = totals["correct"] / totals["count"]
-    if "loss_sum" in totals and totals.get("count"):
-        result["loss"] = totals["loss_sum"] / totals["count"]
+            v = np.asarray(v, np.float64)
+            totals[k] = totals.get(k, 0.0) + v
+    result = {k: float(v) for k, v in totals.items() if np.ndim(v) == 0}
+    if "correct" in result and result.get("count"):
+        result["accuracy"] = result["correct"] / result["count"]
+    if "loss_sum" in result and result.get("count"):
+        result["loss"] = result["loss_sum"] / result["count"]
+    if "auc_pos_hist" in totals and "auc_neg_hist" in totals:
+        result["auc"] = metrics_lib.auc_from_histograms(
+            totals["auc_pos_hist"], totals["auc_neg_hist"]
+        )
     return result
 
 
