@@ -71,8 +71,16 @@ def classification_eval_fn(model) -> Callable:
             logits.astype(jnp.float32), labels
         ).sum()
         correct = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        # top-5: the reference's standard companion to the top-1 gate
+        # (ImageNet reporting convention); k clamps for tiny test heads
+        k = min(5, logits.shape[-1])
+        _, topk = jax.lax.top_k(logits.astype(jnp.float32), k)
+        top5 = jnp.sum(
+            jnp.any(topk == labels[:, None], axis=-1).astype(jnp.float32)
+        )
         count = jnp.asarray(labels.shape[0], jnp.float32)
-        return {"loss_sum": loss, "correct": correct, "count": count}
+        return {"loss_sum": loss, "correct": correct,
+                "top5_correct": top5, "count": count}
 
     return eval_fn
 

@@ -12,20 +12,29 @@ Here the sufficient statistic for AUC is a pair of fixed-size score
 histograms (positives, negatives) — fixed shapes, one scatter-add per
 batch, XLA-friendly — and the finalizer computes the exact rank-sum
 (Mann–Whitney) AUC of the bucketized scores, with half credit for ties
-inside a bucket. With B buckets the bucketization error is O(1/B);
-B=512 matches the substrate's default granularity (num_thresholds=200)
-with margin.
+inside a bucket.
+
+AUC is rank-based and sigmoid is monotone, so scores are bucketized in
+LOGIT space (uniform over [-LOGIT_RANGE, LOGIT_RANGE]), not probability
+space: a probability-space grid would collapse every confidently-scored
+example into the two end buckets (sigmoid(7.5) and sigmoid(9) differ by
+4e-4 — same bucket out of 512 — despite clean separability). In logit
+space the tie window is 2·LOGIT_RANGE/B ≈ 0.06 logits per bucket; only
+pairs whose logits BOTH saturate beyond ±LOGIT_RANGE (where sigmoid is
+flat to <3e-7) still tie. B=512 exceeds the substrate's default
+granularity (num_thresholds=200).
 """
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["auc_histograms", "auc_from_histograms", "AUC_BINS"]
+__all__ = ["auc_histograms", "auc_from_histograms", "AUC_BINS",
+           "LOGIT_RANGE"]
 
 AUC_BINS = 512
+LOGIT_RANGE = 15.0  # sigmoid is flat to <3e-7 beyond this
 
 
 def auc_histograms(logits, labels, bins: int = AUC_BINS):
@@ -33,9 +42,12 @@ def auc_histograms(logits, labels, bins: int = AUC_BINS):
 
     logits: [N] pre-sigmoid scores; labels: [N] {0,1}.
     Returns {"auc_pos_hist": [bins], "auc_neg_hist": [bins]} — summable
-    across batches and eval shards.
+    across batches and eval shards. Bucketized uniformly in logit space
+    (module docstring: rank-equivalent to sigmoid scores, no saturation
+    collapse).
     """
-    p = jax.nn.sigmoid(jnp.asarray(logits, jnp.float32))
+    x = jnp.asarray(logits, jnp.float32)
+    p = (x + LOGIT_RANGE) / (2.0 * LOGIT_RANGE)
     idx = jnp.clip((p * bins).astype(jnp.int32), 0, bins - 1)
     pos = jnp.asarray(labels, jnp.float32)
     pos_hist = jnp.zeros((bins,), jnp.float32).at[idx].add(pos)

@@ -194,10 +194,11 @@ def _run_eval(state: Any, put_batch: Callable, parts: WorkloadParts,
             v = np.asarray(v, np.float64)
             totals[k] = totals.get(k, 0.0) + v
     result = {k: float(v) for k, v in totals.items() if np.ndim(v) == 0}
-    if "correct" in result and result.get("count"):
-        result["accuracy"] = result["correct"] / result["count"]
-    if "loss_sum" in result and result.get("count"):
-        result["loss"] = result["loss_sum"] / result["count"]
+    for summed, ratio in (("correct", "accuracy"),
+                          ("top5_correct", "top5_accuracy"),
+                          ("loss_sum", "loss")):
+        if summed in result and result.get("count"):
+            result[ratio] = result[summed] / result["count"]
     if "auc_pos_hist" in totals and "auc_neg_hist" in totals:
         result["auc"] = metrics_lib.auc_from_histograms(
             totals["auc_pos_hist"], totals["auc_neg_hist"]

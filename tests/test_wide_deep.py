@@ -183,6 +183,11 @@ def test_auc_histogram_metric():
     h3 = m.auc_histograms(same, lab)
     assert m.auc_from_histograms(
         h3["auc_pos_hist"], h3["auc_neg_hist"]) == 0.5
+    # saturation regression: confidently-scored but separable pairs must
+    # NOT collapse to 0.5 (logit-space bucketing; sigmoid-space would)
+    h5 = m.auc_histograms(
+        jnp.asarray([7.5, 7.6, 9.0, 9.1]), jnp.asarray([0.0, 0.0, 1.0, 1.0]))
+    assert m.auc_from_histograms(h5["auc_pos_hist"], h5["auc_neg_hist"]) == 1.0
     # one-class batch: undefined -> NaN
     h4 = m.auc_histograms(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 1.0]))
     assert np.isnan(m.auc_from_histograms(h4["auc_pos_hist"], h4["auc_neg_hist"]))

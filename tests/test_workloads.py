@@ -33,6 +33,10 @@ def test_mnist_mlp_converges(tmp_path):
     assert hist[0]["loss"] > hist[-1]["loss"], "loss did not decrease"
     # linear-teacher task: must beat 10-class chance comfortably
     assert result.eval_metrics["accuracy"] > 0.3
+    # top-5 dominates top-1 and must beat it on a 10-class head
+    assert (result.eval_metrics["top5_accuracy"]
+            >= result.eval_metrics["accuracy"])
+    assert result.eval_metrics["top5_accuracy"] > 0.7
     assert int(result.state.step) == 60
     # checkpoint written and config serialized
     assert (tmp_path / "ck" / "config.json").exists()
@@ -154,6 +158,8 @@ def test_eval_from_checkpoint_matches_live(tmp_path):
     offline = workloads.eval_workload("mnist_mlp", args)
     assert offline["step"] == 3
     assert abs(offline["accuracy"] - live.eval_metrics["accuracy"]) < 1e-6
+    assert abs(offline["top5_accuracy"]
+               - live.eval_metrics["top5_accuracy"]) < 1e-6
     assert abs(offline["loss"] - live.eval_metrics["loss"]) < 1e-5
 
 
