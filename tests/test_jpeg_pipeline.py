@@ -1,0 +1,151 @@
+"""JPEG record container + decode/augment pipeline (data/jpeg_records.py,
+data/augment.py) — the real-ImageNet input path (SURVEY.md §7 hard part
+#1; reference analog: per-worker tf.data JPEG decode, SURVEY.md §2a).
+
+Covers: container roundtrip, eval-mode determinism, the train-mode
+resume contract (index_offset reproduces the exact augmented stream),
+epoch reshuffling, augment-op oracles, the `jpeg:` wiring through
+make_dataset, and a host-only decode-throughput probe (slow)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data import DataConfig, augment, make_dataset
+from distributed_tensorflow_tpu.data.jpeg_records import (
+    JpegClassificationDataset, make_jpeg_record_file,
+)
+
+
+def _images(n, h=48, w=40, seed=0):
+    rng = np.random.RandomState(seed)
+    # smooth gradients survive JPEG quality=90 nearly losslessly
+    base = np.linspace(0, 200, h * w * 3).reshape(h, w, 3)
+    return np.stack([
+        np.clip(base + rng.randint(0, 40), 0, 255).astype(np.uint8)
+        for _ in range(n)
+    ])
+
+
+@pytest.fixture()
+def jpeg_pair(tmp_path):
+    path = str(tmp_path / "train")
+    imgs = _images(24)
+    labels = np.arange(24) % 7
+    n = make_jpeg_record_file(path, imgs, labels)
+    assert n == 24
+    return path, imgs, labels
+
+
+def test_eval_batches_deterministic_and_decoded(jpeg_pair):
+    path, imgs, labels = jpeg_pair
+    ds = JpegClassificationDataset(path, 32, 8, train=False, num_batches=3)
+    batches = list(ds)
+    assert len(batches) == 3
+    b = batches[0]
+    assert b["image"].shape == (8, 32, 32, 3)
+    assert b["image"].dtype == np.float32
+    assert 0.0 <= b["image"].min() and b["image"].max() <= 1.0
+    # eval mode: no shuffle — labels stream in file order
+    np.testing.assert_array_equal(b["label"], labels[:8])
+    # deterministic: decoding the same batch twice is identical
+    np.testing.assert_array_equal(ds.batch(1)["image"], ds.batch(1)["image"])
+    # decode really round-trips the pixels (quality 90, smooth content)
+    dec = augment.resize_center_crop(imgs[0], 32) / 255.0
+    np.testing.assert_allclose(b["image"][0], dec, atol=0.05)
+
+
+def test_train_resume_contract_and_reshuffle(jpeg_pair):
+    path, _, _ = jpeg_pair
+    ds = JpegClassificationDataset(path, 32, 8, train=True, seed=3)
+    # resume contract: a fresh instance at index_offset=k reproduces
+    # batch k of the uninterrupted stream — images AND augmentations
+    resumed = JpegClassificationDataset(path, 32, 8, train=True, seed=3,
+                                        index_offset=2)
+    want = ds.batch(2)
+    got = resumed.batch(0)
+    np.testing.assert_array_equal(want["image"], got["image"])
+    np.testing.assert_array_equal(want["label"], got["label"])
+    # different global indices give different augmented batches
+    assert np.any(ds.batch(0)["image"] != ds.batch(1)["image"])
+    # epochs reshuffle: 24 imgs / batch 8 = 3 batches/epoch; epoch 0 vs 1
+    # see different label order almost surely
+    e0 = np.concatenate([ds.batch(i)["label"] for i in range(3)])
+    e1 = np.concatenate([ds.batch(i)["label"] for i in range(3, 6)])
+    assert sorted(e0.tolist()) == sorted(e1.tolist())  # same epoch content
+    assert np.any(e0 != e1)
+
+
+def test_make_dataset_jpeg_wiring(jpeg_pair):
+    path, _, _ = jpeg_pair
+    cfg = DataConfig(dataset=f"jpeg:{path}", global_batch_size=8,
+                     image_size=32, num_classes=7)
+    it = iter(make_dataset(cfg, num_batches=2))
+    b = next(it)
+    assert b["image"].shape == (8, 32, 32, 3)
+    assert set(np.unique(b["label"])) <= set(range(7))
+
+
+def test_augment_ops_oracles():
+    rng = np.random.RandomState(0)
+    img = _images(1, h=60, w=80)[0]
+    # random_resized_crop: exact output shape, uint8, content from source
+    out = augment.random_resized_crop(img, rng, 32)
+    assert out.shape == (32, 32, 3) and out.dtype == np.uint8
+    # resize_center_crop: shape + the 0.875 short-side recipe
+    out = augment.resize_center_crop(img, 32)
+    assert out.shape == (32, 32, 3)
+    # hflip: flips exactly half the time, exact mirror when it does
+    flipped = augment.hflip(img, np.random.RandomState(1))
+    either = (np.array_equal(flipped, img)
+              or np.array_equal(flipped, img[:, ::-1]))
+    assert either
+    # random_crop_flip (CIFAR batch recipe) matches a per-image oracle
+    batch = _images(6, h=32, w=32, seed=2).astype(np.float32)
+    rng1, rng2 = np.random.RandomState(5), np.random.RandomState(5)
+    got = augment.random_crop_flip(batch, rng1, padding=4)
+    ys = rng2.randint(0, 9, 6)
+    xs = rng2.randint(0, 9, 6)
+    padded = np.pad(batch, ((0, 0), (4, 4), (4, 4), (0, 0)))
+    want = np.stack([
+        padded[i, ys[i]:ys[i] + 32, xs[i]:xs[i] + 32] for i in range(6)
+    ])
+    flips = rng2.rand(6) < 0.5
+    want[flips] = want[flips, :, ::-1]
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.slow
+def test_decode_throughput_host_only(tmp_path):
+    """VERDICT round-1 item 6 'done' probe: the threaded decode+augment
+    path must sustain a real per-core rate (measured ~500 img/s/core at
+    256->224 on this container's single core — a 16-core TPU-VM host
+    extrapolates to ~8k img/s, past the ~2.5k img/s bench step rate).
+    Thread-pool scaling is asserted only where the host has cores to
+    scale onto; PIL releases the GIL during decode."""
+    import os
+
+    path = str(tmp_path / "tp")
+    n = 128
+    imgs = _images(n, h=256, w=256, seed=1)
+    make_jpeg_record_file(path, imgs, np.zeros(n, np.int64))
+    ds = JpegClassificationDataset(path, 224, 64, train=True)
+    ds.batch(0)  # warm the pool + caches
+    t0 = time.perf_counter()
+    for i in range(1, 5):
+        ds.batch(i)
+    dt = time.perf_counter() - t0
+    rate = 4 * 64 / dt
+    print(f"decode+augment throughput: {rate:.0f} images/sec "
+          f"({ds._pool._max_workers} threads, {os.cpu_count()} cores)")
+    assert rate > 100, rate  # an order under the single-core measurement
+    if (os.cpu_count() or 1) >= 4:
+        ds1 = JpegClassificationDataset(path, 224, 64, train=True,
+                                        n_threads=1)
+        ds1.batch(0)
+        t0 = time.perf_counter()
+        ds1.batch(1)
+        serial = 64 / (time.perf_counter() - t0)
+        print(f"single-thread: {serial:.0f} images/sec")
+        assert rate > 2 * serial, (rate, serial)
