@@ -18,6 +18,8 @@ Env knobs:
   BENCH_FUSED_LN    "1" to fuse LayerNorm into matmuls (pre-LN only,
                     i.e. BENCH_MODEL=gpt)
   BENCH_REMAT       "1" to jax.checkpoint each block (fit bigger batches)
+  BENCH_ATTN        attention impl: "auto" (flash on TPU) | "dense" |
+                    "blockwise" | "flash" — flash-vs-XLA-dense on chip
 """
 
 import json
@@ -79,13 +81,14 @@ def main() -> None:
             cfg, num_layers=2, d_model=128, num_heads=4, d_ff=256,
             vocab_size=1024, max_len=max(seq, 128), dtype="float32",
         )
-    cfg = dataclasses.replace(cfg, remat=remat)
+    attn = os.environ.get("BENCH_ATTN", "auto")
+    cfg = dataclasses.replace(cfg, remat=remat, attention_impl=attn)
     if seq > cfg.max_len:
         raise SystemExit(f"BENCH_SEQ={seq} > max_len={cfg.max_len}")
 
     mesh = build_mesh(MeshSpec(data=-1))
     log(f"mesh: {describe(mesh)}  model={which} fused_ln={fused_ln} "
-        f"seq={seq} global_batch={global_batch}")
+        f"attn={attn} seq={seq} global_batch={global_batch}")
 
     model = tfm.Transformer(cfg, mesh)
     loss_fn = tfm.mlm_loss_fn(model) if which == "bert" \
@@ -141,6 +144,7 @@ def main() -> None:
         "seq_len": seq,
         "model": which,
         "fused_ln_matmul": fused_ln,
+        "attention_impl": attn,
         "full_size_model": bool(on_tpu),
     }))
 
