@@ -23,6 +23,7 @@ choices here:
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any
 
 import flax.linen as nn
@@ -161,12 +162,28 @@ class _DenseParams(nn.Module):
 class SelfAttention(nn.Module):
     cfg: TransformerConfig
     mesh: Any = None  # jax.sharding.Mesh or None; static module metadata
+    # >1 = MANUAL megatron tensor parallelism for shard_map islands (the
+    # pipelined path): this instance sees LOCAL column slices of q/k/v
+    # (H/tp heads) and a LOCAL row slice of attn_out, and reduces the
+    # out-projection with an explicit psum over the `model` axis. Mutually
+    # exclusive with GSPMD TP (tp_rules), which shards the SAME math from
+    # outside jit. Param tree paths/full shapes are identical either way.
+    tp_shards: int = 1
 
     @nn.compact
     def __call__(self, x, mask, *, train: bool, ln_params=None):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
-        H, D = cfg.num_heads, cfg.head_dim
+        if cfg.num_heads % self.tp_shards:
+            raise ValueError(
+                f"num_heads={cfg.num_heads} not divisible by "
+                f"tp_shards={self.tp_shards}"
+            )
+        if self.tp_shards > 1 and ln_params is not None:
+            raise ValueError(
+                "fused_ln_matmul is incompatible with manual TP islands"
+            )
+        H, D = cfg.num_heads // self.tp_shards, cfg.head_dim
         B, S, _ = x.shape
         # [B,S,Hd] -> [B,H,S,D] (ops/ layout convention)
         split = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
@@ -233,8 +250,19 @@ class SelfAttention(nn.Module):
                 out = attention_reference(q, k, v, causal=cfg.causal, kv_mask=mask)
 
         out = out.transpose(0, 2, 1, 3).reshape(B, S, H * D)
-        out = nn.Dense(cfg.d_model, dtype=dtype, name="attn_out",
-                       kernel_init=nn.initializers.normal(0.02))(out)
+        if self.tp_shards > 1:
+            # row-parallel out-projection: local [H_local·D, d] slice
+            # contributes a partial sum; reduce over `model`, add the
+            # (replicated) bias ONCE after the reduce. _DenseParams keeps
+            # the exact nn.Dense param tree ('attn_out/{kernel,bias}').
+            w, b = _DenseParams(cfg.d_model, H * D, name="attn_out")()
+            out = jnp.dot(out, w.astype(dtype),
+                          preferred_element_type=jnp.float32)
+            out = jax.lax.psum(out, mesh_lib.MODEL)
+            out = (out + b).astype(dtype)
+        else:
+            out = nn.Dense(cfg.d_model, dtype=dtype, name="attn_out",
+                           kernel_init=nn.initializers.normal(0.02))(out)
         return nn.Dropout(cfg.dropout, deterministic=not train)(out)
 
 
@@ -242,6 +270,12 @@ class Block(nn.Module):
     cfg: TransformerConfig
     mesh: Any = None
     use_moe: bool = False
+    # Manual megatron TP inside a shard_map island (see SelfAttention.
+    # tp_shards): column-parallel q/k/v + mlp_in (local out slices),
+    # row-parallel attn_out + mlp_out (psum over `model`, bias once).
+    # LayerNorms see the full d_model (never sharded). The pipelined path
+    # sets this from the mesh; the GSPMD path must leave it at 1.
+    tp_shards: int = 1
 
     @nn.compact
     def __call__(self, x, mask, train: bool):
@@ -250,8 +284,13 @@ class Block(nn.Module):
         # but deliberately has no default: every call site must decide.
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
+        tp = self.tp_shards
+        if tp > 1 and self.use_moe:
+            raise ValueError("manual TP islands don't support MoE blocks")
+        if tp > 1 and cfg.d_ff % tp:
+            raise ValueError(f"d_ff={cfg.d_ff} not divisible by tp={tp}")
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
-        attn = SelfAttention(cfg, self.mesh, name="attn")
+        attn = SelfAttention(cfg, self.mesh, tp_shards=tp, name="attn")
 
         if self.use_moe:
             from ..ops.moe import MoEConfig, MoEMLP
@@ -276,17 +315,32 @@ class Block(nn.Module):
                 # everything after the mlp_in matmul — shared by the
                 # plain and fused-LN paths so they cannot drift
                 h = nn.gelu(h)
-                h = nn.Dense(cfg.d_model, dtype=dtype, name="mlp_out",
-                             kernel_init=nn.initializers.normal(0.02))(h)
+                if tp > 1:
+                    # row-parallel: local [d_ff/tp, d] slice -> psum over
+                    # `model`, bias added once after the reduce
+                    w, b = _DenseParams(cfg.d_model, cfg.d_ff // tp,
+                                        name="mlp_out")()
+                    h = jnp.dot(h, w.astype(dtype),
+                                preferred_element_type=jnp.float32)
+                    h = jax.lax.psum(h, mesh_lib.MODEL)
+                    h = (h + b).astype(dtype)
+                else:
+                    h = nn.Dense(cfg.d_model, dtype=dtype, name="mlp_out",
+                                 kernel_init=nn.initializers.normal(0.02))(h)
                 return nn.Dropout(cfg.dropout, deterministic=not train)(h)
 
             def mlp(h):
-                h = nn.Dense(cfg.d_ff, dtype=dtype, name="mlp_in",
+                # column-parallel under tp: local d_ff/tp out slice
+                h = nn.Dense(cfg.d_ff // tp, dtype=dtype, name="mlp_in",
                              kernel_init=nn.initializers.normal(0.02))(h)
                 return mlp_tail(h)
 
         use_fused_ln = cfg.fused_ln_matmul and not self.use_moe
         if use_fused_ln:
+            if tp > 1:
+                raise ValueError(
+                    "fused_ln_matmul is incompatible with manual TP islands"
+                )
             if not cfg.pre_ln:
                 raise ValueError(
                     "fused_ln_matmul requires pre_ln=True (a post-LN "
@@ -458,14 +512,40 @@ def from_pipeline_params(pparams: Any, cfg: TransformerConfig,
     return out
 
 
-def pipeline_param_specs(pparams: Any) -> Any:
-    """blocks → P('pipe', ...); ends pipe-replicated (compose TP/FSDP on the
-    ends separately if needed — out of scope for the PP demo)."""
+def pipeline_param_specs(pparams: Any, *, tp: bool = False) -> Any:
+    """blocks → P('pipe', ...); ends pipe-replicated (FSDP on the ends is
+    out of scope for the PP path).
+
+    ``tp=True`` additionally places the `model` axis on each stacked block
+    leaf — the megatron layout of TP_PATH_RULES shifted past the leading
+    [n_stages(, n_virtual), layers_per_stage] stacking dims: column-
+    parallel kernels/biases (query/key/value/mlp_in) shard their LAST dim,
+    row-parallel kernels (attn_out/mlp_out) their second-to-last, and
+    row-parallel biases + LayerNorms stay replicated. Must match
+    ``Block(tp_shards=...)``'s local-slice expectations exactly."""
     from ..parallel.pipeline import stage_param_specs
 
+    if not tp:
+        blocks = stage_param_specs(pparams["blocks"])
+    else:
+        col = re.compile(r"(query|key|value|mlp_in)/(kernel|bias)$")
+        row = re.compile(r"(attn_out|mlp_out)/kernel$")
+
+        def assign(path, leaf):
+            name = "/".join(
+                k.key for k in path if hasattr(k, "key")
+            )
+            spec = [mesh_lib.PIPE] + [None] * (jnp.ndim(leaf) - 1)
+            if col.search(name):
+                spec[-1] = mesh_lib.MODEL
+            elif row.search(name):
+                spec[-2] = mesh_lib.MODEL
+            return P(*spec)
+
+        blocks = jax.tree_util.tree_map_with_path(assign, pparams["blocks"])
     return {
         "ends": jax.tree.map(lambda _: P(), pparams["ends"]),
-        "blocks": stage_param_specs(pparams["blocks"]),
+        "blocks": blocks,
     }
 
 
@@ -495,7 +575,16 @@ def pipelined_apply(
         ).astype(dtype)
 
     stage_cfg = dataclasses.replace(cfg, dropout=0.0, seq_impl=None)
-    block = Block(stage_cfg, None, False)
+    # PP×TP: a model axis on the mesh turns on manual megatron TP inside
+    # the island — each device holds [pipe-slice × model-slice] of every
+    # block leaf and the Block psums its row-parallel projections.
+    tp = mesh.shape.get(mesh_lib.MODEL, 1) if mesh is not None else 1
+    if tp > 1 and mesh.shape[mesh_lib.PIPE] == 1:
+        raise ValueError(
+            "model axis without a pipe axis: use the dense Transformer "
+            "with tp_rules (GSPMD TP) instead of the pipelined path"
+        )
+    block = Block(stage_cfg, None, False, tp_shards=tp)
 
     x_mb = microbatch(x, n_microbatches)
 
@@ -510,8 +599,14 @@ def pipelined_apply(
         microbatch(attention_mask.astype(bool), n_microbatches)
         if attention_mask is not None else None
     )
-    y = pipeline_apply(stage_fn, pparams["blocks"], x_mb, mesh,
-                       aux_mb=mask_mb, n_virtual=n_virtual)
+    y = pipeline_apply(
+        stage_fn, pparams["blocks"], x_mb, mesh, aux_mb=mask_mb,
+        n_virtual=n_virtual,
+        param_specs=(
+            pipeline_param_specs(pparams, tp=True)["blocks"]
+            if tp > 1 else None
+        ),
+    )
     y = unmicrobatch(y)
 
     if cfg.pre_ln:

@@ -47,8 +47,11 @@ Constraints (documented, standard): stage_fn must be shape-preserving
 ([mb, ...] -> [mb, ...]); heterogeneous ends (embedding lookup, output
 head) run OUTSIDE the pipeline, pipe-replicated — see the pipelined
 path in models/transformer.py (to_pipeline_params/pipelined_apply). Composes with data/fsdp (batch dim sharded inside
-the same shard_map); tensor parallelism inside a stage would need manual
-collectives and is out of scope here.
+the same shard_map) AND with tensor parallelism inside a stage: pass
+``param_specs`` that shard kernel dims over `model` and a ``stage_fn``
+that does the matching manual collectives — the transformer family wires
+this via ``Block(tp_shards=...)`` (megatron column/row slices + psum),
+see models/transformer.pipelined_apply.
 """
 
 from __future__ import annotations
@@ -83,6 +86,7 @@ def pipeline_apply(
     mesh: Mesh,
     aux_mb: Any = None,
     n_virtual: int = 1,
+    param_specs: Any = None,
 ) -> jax.Array:
     """Run ``x_mb`` through the S-stage (optionally interleaved) pipeline.
 
@@ -104,6 +108,11 @@ def pipeline_apply(
         V-fold to (S-1)/(M·V+S-1) at the cost of retaining ~V× more
         per-tick activations for the backward (the scan is V× longer).
         Requires M % S == 0.
+    param_specs: override the default P('pipe', None, ...) per-leaf specs
+        — for PP×TP, pass specs that ALSO shard kernel dims over `model`
+        (models/transformer.pipeline_param_specs(tp=True)); stage_fn is
+        then responsible for the matching manual collectives (Block's
+        tp_shards psums). Specs must keep 'pipe' on the leading dim.
     """
     n_stages = mesh.shape[mesh_lib.PIPE]
     M = x_mb.shape[0]
@@ -117,6 +126,17 @@ def pipeline_apply(
     if V == 1:
         # canonical internal layout has the virtual-chunk dim: [S, 1, ...]
         stage_params = jax.tree.map(lambda p: p[:, None], stage_params)
+        if param_specs is not None:
+            # caller's specs describe the pre-insert layout; track the
+            # new virtual dim (replicated) at position 1
+            def _insert_vdim(s):
+                e = tuple(s)
+                return P(e[0], None, *e[1:])
+
+            param_specs = jax.tree.map(
+                _insert_vdim, param_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
     else:
         for leaf in jax.tree.leaves(stage_params):
             if jnp.ndim(leaf) < 2 or leaf.shape[1] != V:
@@ -127,6 +147,12 @@ def pipeline_apply(
                     "v*S+d at [d, v])"
                 )
     if n_stages == 1:
+        if param_specs is not None:
+            raise ValueError(
+                "param_specs on a pipe=1 mesh: the degenerate path runs "
+                "outside shard_map, so a TP stage_fn's collectives would "
+                "hit unbound axis names — use the GSPMD path instead"
+            )
         # degenerate: no pipe axis — scan this device's chunks in order
         sq = jax.tree.map(lambda p: p.reshape(-1, *p.shape[2:]), stage_params)
 
@@ -161,7 +187,8 @@ def pipeline_apply(
             "global batch"
         )
 
-    param_specs = stage_param_specs(stage_params)
+    if param_specs is None:
+        param_specs = stage_param_specs(stage_params)
     mb_spec = lambda leaf: P(
         None, mesh_lib.BATCH_AXES, *([None] * (jnp.ndim(leaf) - 2))
     )

@@ -283,3 +283,130 @@ def test_pipelined_transformer_trains(devices):
         losses.append(float(metrics["loss"]))
         assert float(metrics["grads_finite"]) == 1.0
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_pipelined_transformer_pp_tp_matches_dense(devices):
+    """PP×TP: pipe=2 × model=2 × data=2 — manual megatron TP inside the
+    pipeline island (column/row slices + psum, Block.tp_shards) must
+    reproduce the dense flax forward exactly, and the gradients must
+    match the dense model's gradients transposed into the pipe layout."""
+    cfg = _tiny_cfg()
+    mesh = build_mesh(MeshSpec(pipe=2, model=2, data=2), devices[:8])
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 16)(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)), jnp.int32)
+    want = model.apply({"params": params}, ids, None, train=False)
+    pparams = tfm.to_pipeline_params(params, cfg, n_stages=2)
+    got = jax.jit(
+        lambda p, i: tfm.pipelined_apply(p, i, None, cfg, mesh,
+                                         n_microbatches=4)
+    )(pparams, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+    # gradient parity: d mean(logits^2) — dense grads transposed to the
+    # pipe layout == grads through the PP×TP schedule
+    def dense_loss(p):
+        lg = model.apply({"params": p}, ids, None, train=False)
+        return (lg ** 2).mean()
+
+    def piped_loss(pp):
+        lg = tfm.pipelined_apply(pp, ids, None, cfg, mesh,
+                                 n_microbatches=4)
+        return (lg ** 2).mean()
+
+    g_dense = jax.jit(jax.grad(dense_loss))(params)
+    want_g = tfm.to_pipeline_params(g_dense, cfg, n_stages=2)
+    got_g = jax.jit(jax.grad(piped_loss))(pparams)
+    flat_w = jax.tree_util.tree_leaves_with_path(want_g)
+    flat_g = jax.tree_util.tree_leaves_with_path(got_g)
+    for (path, w), (_, g) in zip(flat_w, flat_g):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), atol=5e-4,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def test_pipelined_transformer_pp_tp_trains(devices):
+    """Train-engine integration on pipe=2 × model=2 × data=2: the stacked
+    leaves shard over BOTH pipe and model (pipeline_param_specs(tp=True))
+    and the loss decreases."""
+    cfg = _tiny_cfg()
+    mesh = build_mesh(MeshSpec(pipe=2, model=2, data=2), devices[:8])
+    tx = optax.adam(3e-3)
+    init_fn = tfm.make_pipelined_init_fn(cfg, n_stages=2, seq_len=16)
+    specs = tfm.pipeline_param_specs(
+        jax.eval_shape(init_fn, jax.random.PRNGKey(0))[0], tp=True
+    )
+    # kernels must actually carry the model axis (vacuity guard)
+    qk = specs["blocks"]["attn"]["query"]["kernel"]
+    ok_ = specs["blocks"]["attn"]["attn_out"]["kernel"]
+    assert qk[-1] == "model" and ok_[-2] == "model", (qk, ok_)
+    state, sspecs = init_train_state(
+        init_fn, tx, mesh, jax.random.PRNGKey(0), param_specs=specs,
+    )
+    step = jit_train_step(
+        make_train_step(
+            tfm.pipelined_lm_loss_fn(cfg, mesh, n_microbatches=4), tx,
+            StepOptions(check_grads_finite=True)), mesh, sspecs,
+    )
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    batch = {"input_ids": jax.device_put(
+        jnp.asarray(ids), NamedSharding(mesh, sh.batch_spec(2)))}
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        assert float(metrics["grads_finite"]) == 1.0
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_block_tp_guards():
+    """Manual-TP misuse fails loudly: indivisible heads/d_ff, MoE, and
+    fused-LN are all rejected."""
+    x = jnp.zeros((2, 8, 32), jnp.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        tfm.Block(_tiny_cfg(num_heads=3), tp_shards=2).init(
+            jax.random.PRNGKey(0), x, None, False)
+    with pytest.raises(ValueError, match="d_ff"):
+        tfm.Block(_tiny_cfg(d_ff=66), tp_shards=4).init(
+            jax.random.PRNGKey(0), x, None, False)
+    with pytest.raises(ValueError, match="MoE"):
+        tfm.Block(_tiny_cfg(num_experts=2), None, True, tp_shards=2).init(
+            jax.random.PRNGKey(0), x, None, False)
+    with pytest.raises(ValueError, match="fused_ln_matmul"):
+        tfm.Block(_tiny_cfg(fused_ln_matmul=True), tp_shards=2).init(
+            jax.random.PRNGKey(0), x, None, False)
+
+
+def test_pipelined_transformer_pp_tp_interleaved_matches_dense(devices):
+    """PP×TP × interleaved: the [S, V, lc, ...] stacking must place the
+    `model` axis on the same trailing kernel dims (a wrong-but-square
+    placement on the d_model×d_model qkv kernels would still be
+    shape-compatible — only numerical parity catches it)."""
+    cfg = _tiny_cfg()  # 4 layers
+    mesh = build_mesh(MeshSpec(pipe=2, model=2, data=2), devices[:8])
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 16)(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)), jnp.int32)
+    want = model.apply({"params": params}, ids, None, train=False)
+    pparams = tfm.to_pipeline_params(params, cfg, n_stages=2, n_virtual=2)
+    got = jax.jit(
+        lambda p, i: tfm.pipelined_apply(p, i, None, cfg, mesh,
+                                         n_microbatches=4, n_virtual=2)
+    )(pparams, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_pipeline_apply_rejects_param_specs_on_degenerate_mesh(devices):
+    """pipe=1 runs outside shard_map: TP param_specs must be rejected,
+    not silently dropped (a TP stage_fn's psum would hit unbound axes)."""
+    mesh = build_mesh(MeshSpec(data=2), devices[:2])
+    params = _toy_params(jax.random.PRNGKey(0), 1, 8)
+    x_mb = jnp.ones((2, 4, 8))
+    with pytest.raises(ValueError, match="degenerate"):
+        pipeline_apply(_toy_stage_fn, params, x_mb, mesh,
+                       param_specs=jax.tree.map(
+                           lambda _: P("pipe"), params,
+                       ))
