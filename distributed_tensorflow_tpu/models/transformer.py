@@ -159,6 +159,19 @@ class _DenseParams(nn.Module):
         )
 
 
+def _row_parallel_dense(h, out_features, in_features_local, name, dtype,
+                        parent):
+    """Megatron row-parallel projection inside a shard_map island: local
+    [in_local, out] slice computes a partial sum, psum over `model`
+    reduces it, the (replicated) bias is added ONCE after the reduce.
+    Shared by attn_out and mlp_out so the two cannot drift."""
+    w, b = _DenseParams(out_features, in_features_local, name=name,
+                        parent=parent)()
+    y = jnp.dot(h, w.astype(dtype), preferred_element_type=jnp.float32)
+    y = jax.lax.psum(y, mesh_lib.MODEL)
+    return (y + b).astype(dtype)
+
+
 class SelfAttention(nn.Module):
     cfg: TransformerConfig
     mesh: Any = None  # jax.sharding.Mesh or None; static module metadata
@@ -251,15 +264,10 @@ class SelfAttention(nn.Module):
 
         out = out.transpose(0, 2, 1, 3).reshape(B, S, H * D)
         if self.tp_shards > 1:
-            # row-parallel out-projection: local [H_local·D, d] slice
-            # contributes a partial sum; reduce over `model`, add the
-            # (replicated) bias ONCE after the reduce. _DenseParams keeps
-            # the exact nn.Dense param tree ('attn_out/{kernel,bias}').
-            w, b = _DenseParams(cfg.d_model, H * D, name="attn_out")()
-            out = jnp.dot(out, w.astype(dtype),
-                          preferred_element_type=jnp.float32)
-            out = jax.lax.psum(out, mesh_lib.MODEL)
-            out = (out + b).astype(dtype)
+            # _DenseParams keeps the exact nn.Dense param tree
+            # ('attn_out/{kernel,bias}')
+            out = _row_parallel_dense(out, cfg.d_model, H * D, "attn_out",
+                                      dtype, self)
         else:
             out = nn.Dense(cfg.d_model, dtype=dtype, name="attn_out",
                            kernel_init=nn.initializers.normal(0.02))(out)
@@ -316,14 +324,8 @@ class Block(nn.Module):
                 # plain and fused-LN paths so they cannot drift
                 h = nn.gelu(h)
                 if tp > 1:
-                    # row-parallel: local [d_ff/tp, d] slice -> psum over
-                    # `model`, bias added once after the reduce
-                    w, b = _DenseParams(cfg.d_model, cfg.d_ff // tp,
-                                        name="mlp_out")()
-                    h = jnp.dot(h, w.astype(dtype),
-                                preferred_element_type=jnp.float32)
-                    h = jax.lax.psum(h, mesh_lib.MODEL)
-                    h = (h + b).astype(dtype)
+                    h = _row_parallel_dense(h, cfg.d_model, cfg.d_ff // tp,
+                                            "mlp_out", dtype, self)
                 else:
                     h = nn.Dense(cfg.d_model, dtype=dtype, name="mlp_out",
                                  kernel_init=nn.initializers.normal(0.02))(h)
@@ -579,7 +581,7 @@ def pipelined_apply(
     # the island — each device holds [pipe-slice × model-slice] of every
     # block leaf and the Block psums its row-parallel projections.
     tp = mesh.shape.get(mesh_lib.MODEL, 1) if mesh is not None else 1
-    if tp > 1 and mesh.shape[mesh_lib.PIPE] == 1:
+    if tp > 1 and mesh.shape.get(mesh_lib.PIPE, 1) == 1:
         raise ValueError(
             "model axis without a pipe axis: use the dense Transformer "
             "with tp_rules (GSPMD TP) instead of the pipelined path"

@@ -49,14 +49,48 @@ def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
             f"data.vocab_size={cfg.data.vocab_size} != "
             f"model.vocab_size={mcfg.vocab_size}"
         )
-    model = tfm.Transformer(mcfg, mesh)
     fwd_flops = tfm.flops_per_example(mcfg, cfg.data.seq_len)
+    common = dict(
+        dataset_fn=lambda start: make_text_dataset(cfg.data, index_offset=start),
+        flops_per_step=fwd_flops * cfg.data.global_batch_size,
+        batch_size=cfg.data.global_batch_size,
+    )
+
+    from ..parallel import mesh as mesh_lib
+
+    pipe = mesh.shape.get(mesh_lib.PIPE, 1) if mesh is not None else 1
+    if pipe > 1:
+        # --mesh.pipe=S engages the pipelined family (parallel/pipeline.py
+        # schedule; deterministic — dropout off inside the island). A
+        # model axis on top runs manual megatron TP inside each stage
+        # (PP×TP, Block.tp_shards). Stacked [S(,V),lc,...] leaves shard
+        # via explicit specs instead of path rules; FSDP on the stacked
+        # layout is not composed here.
+        import jax
+
+        tp = mesh.shape.get(mesh_lib.MODEL, 1) > 1
+        n_virtual = cfg.train.pipeline_virtual
+        n_micro = cfg.train.pipeline_microbatches or 2 * pipe * n_virtual
+        init_fn = tfm.make_pipelined_init_fn(
+            mcfg, n_stages=pipe, seq_len=cfg.data.seq_len,
+            n_virtual=n_virtual,
+        )
+        return WorkloadParts(
+            init_fn=init_fn,
+            loss_fn=tfm.pipelined_mlm_loss_fn(
+                mcfg, mesh, n_microbatches=n_micro, n_virtual=n_virtual,
+            ),
+            param_specs=tfm.pipeline_param_specs(
+                jax.eval_shape(init_fn, jax.random.PRNGKey(0))[0], tp=tp,
+            ),
+            **common,
+        )
+
+    model = tfm.Transformer(mcfg, mesh)
     return WorkloadParts(
         init_fn=tfm.make_init_fn(model, cfg.data.seq_len),
         loss_fn=tfm.mlm_loss_fn(model),
-        dataset_fn=lambda start: make_text_dataset(cfg.data, index_offset=start),
-        flops_per_step=fwd_flops * cfg.data.global_batch_size,
         param_rules=tfm.tp_rules(),
         fsdp=True,
-        batch_size=cfg.data.global_batch_size,
+        **common,
     )

@@ -45,6 +45,11 @@ class TrainSection:
     grad_accum_steps: int = 1
     seed: int = 0
     eval_every: int = 0  # 0 = no mid-train eval
+    # Pipeline schedule (engaged when mesh.pipe > 1 on a workload that
+    # supports it): microbatches per step (0 = auto, 2x stages) and the
+    # interleaved-schedule virtual-chunk count (1 = plain GPipe).
+    pipeline_microbatches: int = 0
+    pipeline_virtual: int = 1
     eval_batches: int = 16
     profile: bool = False
     profile_dir: str = "/tmp/dtf_tpu_profile"
@@ -81,6 +86,9 @@ class WorkloadParts:
     eval_dataset_fn: Callable[[int], Iterable] | None = None
     flops_per_step: float | None = None  # analytic, for MFU
     param_rules: Any = None  # sharding path rules
+    # explicit spec tree (wins over rules — init_train_state contract);
+    # the pipelined paths use this for their stacked [S,...] layouts
+    param_specs: Any = None
     # workload-supplied optimizer (e.g. a make_multi_optimizer split);
     # None = runner builds one from cfg.optimizer
     tx: Any = None
@@ -117,13 +125,15 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
         ckpt = Checkpointer(cfg.checkpoint, mesh)
         state, specs, restored = init_or_restore(
             ckpt, parts.init_fn, tx, mesh, rng,
-            param_rules=parts.param_rules, fsdp=parts.fsdp,
+            param_rules=parts.param_rules, param_specs=parts.param_specs,
+            fsdp=parts.fsdp,
         )
         ckpt.save_config(cfg)
     else:
         state, specs = init_train_state(
             parts.init_fn, tx, mesh, rng,
-            param_rules=parts.param_rules, fsdp=parts.fsdp,
+            param_rules=parts.param_rules, param_specs=parts.param_specs,
+            fsdp=parts.fsdp,
         )
 
     metrics_logger = cb.MetricsLogger(
@@ -235,7 +245,8 @@ def evaluate_from_checkpoint(
     try:
         state, _, restored = init_or_restore(
             ckpt, parts.init_fn, tx, mesh, jax.random.PRNGKey(cfg.train.seed),
-            param_rules=parts.param_rules, fsdp=parts.fsdp,
+            param_rules=parts.param_rules, param_specs=parts.param_specs,
+            fsdp=parts.fsdp,
         )
         if not restored:
             raise FileNotFoundError(

@@ -287,3 +287,38 @@ def test_remat_preserves_forward_and_grads():
         np.testing.assert_allclose(
             a, b, rtol=2e-5, atol=1e-7,
             err_msg=jax.tree_util.keystr(path))
+
+
+def test_bert_workload_pipelined_pp_tp():
+    """--mesh.pipe=2 --mesh.model=2 engages the pipelined family (PP×TP)
+    straight from the workload config path; MLM loss must fall like the
+    dense run's."""
+    from distributed_tensorflow_tpu import workloads
+
+    result = workloads.run_workload(
+        "bert_pretrain",
+        [
+            "--train.num_steps=40",
+            "--train.log_every=10",
+            "--mesh.pipe=2",
+            "--mesh.model=2",
+            "--mesh.data=2",
+            "--data.global_batch_size=64",
+            "--data.seq_len=16",
+            "--data.vocab_size=48",
+            "--data.mask_token=0",
+            "--model.vocab_size=48",
+            "--model.max_len=16",
+            "--model.num_layers=2",
+            "--model.d_model=32",
+            "--model.num_heads=4",
+            "--model.d_ff=64",
+            "--model.dropout=0.0",
+            "--model.dtype=float32",
+            "--optimizer.learning_rate=3e-3",
+            "--optimizer.warmup_steps=5",
+            "--optimizer.total_steps=40",
+        ],
+    )
+    hist = result.history
+    assert hist[-1]["loss"] < hist[0]["loss"], hist
