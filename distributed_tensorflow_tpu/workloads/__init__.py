@@ -1,4 +1,5 @@
-"""Workload registry — the five BASELINE.json configs as presets.
+"""Workload registry — the five BASELINE.json configs as presets, plus
+gpt_lm (causal LM / long-context, beyond the reference set).
 
 Each workload module exposes ``default_config() -> RunConfig`` and
 ``build(cfg, mesh) -> WorkloadParts``; the shared runner (runner.py) does
@@ -27,6 +28,8 @@ _REGISTRY: dict[str, str] = {
     "resnet50_imagenet": ".resnet50_imagenet",
     "bert_pretrain": ".bert_pretrain",
     "wide_deep": ".wide_deep",
+    # beyond the reference's five: causal LM with a long-context preset
+    "gpt_lm": ".gpt_lm",
 }
 
 

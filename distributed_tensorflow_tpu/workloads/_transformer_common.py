@@ -1,0 +1,72 @@
+"""Shared build logic for the transformer workloads (bert_pretrain,
+gpt_lm): dataset/flops plumbing and the --mesh.pipe=S switch into the
+pipelined family (PP×TP with --mesh.model=T) — one implementation so the
+two workloads cannot drift."""
+
+from __future__ import annotations
+
+from ..data import make_text_dataset
+from ..models import transformer as tfm
+from ..parallel import mesh as mesh_lib
+from .runner import RunConfig, WorkloadParts
+
+
+def transformer_parts(cfg: RunConfig, mesh, *, mlm: bool) -> WorkloadParts:
+    """WorkloadParts for a Transformer workload. ``mlm`` selects the
+    masked-LM loss (encoder) vs next-token loss (causal decoder); the
+    pipelined variants engage when the mesh has a pipe axis > 1
+    (deterministic — dropout off inside the island; FSDP on the stacked
+    layout is not composed)."""
+    mcfg: tfm.TransformerConfig = cfg.model
+    if cfg.data.seq_len > mcfg.max_len:
+        raise ValueError(
+            f"data.seq_len={cfg.data.seq_len} exceeds "
+            f"model.max_len={mcfg.max_len}"
+        )
+    if cfg.data.vocab_size != mcfg.vocab_size:
+        # out-of-range ids would be silently clamped by jnp.take under jit
+        raise ValueError(
+            f"data.vocab_size={cfg.data.vocab_size} != "
+            f"model.vocab_size={mcfg.vocab_size}"
+        )
+    fwd_flops = tfm.flops_per_example(mcfg, cfg.data.seq_len)
+    common = dict(
+        dataset_fn=lambda start: make_text_dataset(
+            cfg.data, index_offset=start
+        ),
+        flops_per_step=fwd_flops * cfg.data.global_batch_size,
+        batch_size=cfg.data.global_batch_size,
+    )
+
+    pipe = mesh.shape.get(mesh_lib.PIPE, 1) if mesh is not None else 1
+    if pipe > 1:
+        import jax
+
+        tp = mesh.shape.get(mesh_lib.MODEL, 1) > 1
+        n_virtual = cfg.train.pipeline_virtual
+        n_micro = cfg.train.pipeline_microbatches or 2 * pipe * n_virtual
+        init_fn = tfm.make_pipelined_init_fn(
+            mcfg, n_stages=pipe, seq_len=cfg.data.seq_len,
+            n_virtual=n_virtual,
+        )
+        piped_loss = (tfm.pipelined_mlm_loss_fn if mlm
+                      else tfm.pipelined_lm_loss_fn)
+        return WorkloadParts(
+            init_fn=init_fn,
+            loss_fn=piped_loss(
+                mcfg, mesh, n_microbatches=n_micro, n_virtual=n_virtual,
+            ),
+            param_specs=tfm.pipeline_param_specs(
+                jax.eval_shape(init_fn, jax.random.PRNGKey(0))[0], tp=tp,
+            ),
+            **common,
+        )
+
+    model = tfm.Transformer(mcfg, mesh)
+    return WorkloadParts(
+        init_fn=tfm.make_init_fn(model, cfg.data.seq_len),
+        loss_fn=tfm.mlm_loss_fn(model) if mlm else tfm.lm_loss_fn(model),
+        param_rules=tfm.tp_rules(),
+        fsdp=True,
+        **common,
+    )

@@ -11,11 +11,11 @@ first-class here and gated by config)."""
 
 from __future__ import annotations
 
-from ..data import TextDataConfig, make_text_dataset
+from ..data import TextDataConfig
 from ..models import transformer as tfm
 from ..parallel import MeshSpec
 from ..train import OptimizerConfig
-from ..utils import flops as flops_lib
+from ._transformer_common import transformer_parts
 from .runner import RunConfig, TrainSection, WorkloadParts
 
 
@@ -38,59 +38,4 @@ def default_config() -> RunConfig:
 
 
 def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
-    mcfg: tfm.TransformerConfig = cfg.model
-    if cfg.data.seq_len > mcfg.max_len:
-        raise ValueError(
-            f"data.seq_len={cfg.data.seq_len} exceeds model.max_len={mcfg.max_len}"
-        )
-    if cfg.data.vocab_size != mcfg.vocab_size:
-        # out-of-range ids would be silently clamped by jnp.take under jit
-        raise ValueError(
-            f"data.vocab_size={cfg.data.vocab_size} != "
-            f"model.vocab_size={mcfg.vocab_size}"
-        )
-    fwd_flops = tfm.flops_per_example(mcfg, cfg.data.seq_len)
-    common = dict(
-        dataset_fn=lambda start: make_text_dataset(cfg.data, index_offset=start),
-        flops_per_step=fwd_flops * cfg.data.global_batch_size,
-        batch_size=cfg.data.global_batch_size,
-    )
-
-    from ..parallel import mesh as mesh_lib
-
-    pipe = mesh.shape.get(mesh_lib.PIPE, 1) if mesh is not None else 1
-    if pipe > 1:
-        # --mesh.pipe=S engages the pipelined family (parallel/pipeline.py
-        # schedule; deterministic — dropout off inside the island). A
-        # model axis on top runs manual megatron TP inside each stage
-        # (PP×TP, Block.tp_shards). Stacked [S(,V),lc,...] leaves shard
-        # via explicit specs instead of path rules; FSDP on the stacked
-        # layout is not composed here.
-        import jax
-
-        tp = mesh.shape.get(mesh_lib.MODEL, 1) > 1
-        n_virtual = cfg.train.pipeline_virtual
-        n_micro = cfg.train.pipeline_microbatches or 2 * pipe * n_virtual
-        init_fn = tfm.make_pipelined_init_fn(
-            mcfg, n_stages=pipe, seq_len=cfg.data.seq_len,
-            n_virtual=n_virtual,
-        )
-        return WorkloadParts(
-            init_fn=init_fn,
-            loss_fn=tfm.pipelined_mlm_loss_fn(
-                mcfg, mesh, n_microbatches=n_micro, n_virtual=n_virtual,
-            ),
-            param_specs=tfm.pipeline_param_specs(
-                jax.eval_shape(init_fn, jax.random.PRNGKey(0))[0], tp=tp,
-            ),
-            **common,
-        )
-
-    model = tfm.Transformer(mcfg, mesh)
-    return WorkloadParts(
-        init_fn=tfm.make_init_fn(model, cfg.data.seq_len),
-        loss_fn=tfm.mlm_loss_fn(model),
-        param_rules=tfm.tp_rules(),
-        fsdp=True,
-        **common,
-    )
+    return transformer_parts(cfg, mesh, mlm=True)

@@ -1,0 +1,64 @@
+"""Workload 6 — decoder-only causal LM (GPT-2-small shape), beyond the
+reference's five configs (BASELINE.json:7-11).
+
+Exists because the long-context surface (SURVEY.md §5.7 — absent from
+the reference, first-class here) deserves a CLI workload: the default
+preset is GPT-small at 1k tokens; ``long_context()`` scales to 8k+ with
+ring-attention sequence parallelism over the `seq` mesh axis plus
+per-block rematerialization. Like bert_pretrain, ``--mesh.pipe=S``
+switches to the pipelined family (PP×TP with ``--mesh.model=T``).
+
+Everything is shared plumbing: the Transformer family (models/
+transformer.py), the text pipeline (data/text.py), the shared builder
+(_transformer_common.py), the runner."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..data import TextDataConfig
+from ..models import transformer as tfm
+from ..parallel import MeshSpec
+from ..train import OptimizerConfig
+from ._transformer_common import transformer_parts
+from .runner import RunConfig, TrainSection, WorkloadParts
+
+
+def default_config() -> RunConfig:
+    model = tfm.gpt_small(causal_len=1024)
+    return RunConfig(
+        workload="gpt_lm",
+        model=model,
+        mesh=MeshSpec(data=-1),
+        data=TextDataConfig(
+            dataset="synthetic_lm", global_batch_size=64,
+            seq_len=model.max_len, vocab_size=model.vocab_size,
+        ),
+        optimizer=OptimizerConfig(
+            name="adamw", learning_rate=3e-4, weight_decay=0.1,
+            warmup_steps=2000, schedule="cosine", total_steps=100000,
+        ),
+        train=TrainSection(num_steps=100000, log_every=100),
+    )
+
+
+def long_context(seq_len: int = 8192) -> RunConfig:
+    """Ring-attention + remat preset: run with ``--mesh.seq=K`` (K divides
+    seq_len) so K/V blocks rotate around the seq axis over ICI
+    (parallel/ring_attention.py; SURVEY.md §5.7). Most devices belong on
+    the seq axis at this length; data stays at 1 unless overridden."""
+    cfg = default_config()
+    model = dataclasses.replace(
+        cfg.model, max_len=seq_len, seq_impl="ring", remat=True,
+    )
+    data = dataclasses.replace(cfg.data, seq_len=seq_len,
+                               global_batch_size=8)
+    return dataclasses.replace(
+        cfg, model=model, data=data, mesh=MeshSpec(data=1, seq=-1),
+    )
+
+
+def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
+    if not cfg.model.causal:
+        raise ValueError("gpt_lm is a causal workload; set model.causal=True")
+    return transformer_parts(cfg, mesh, mlm=False)

@@ -168,3 +168,42 @@ def test_eval_from_checkpoint_missing_dir_raises(tmp_path):
         workloads.eval_workload("mnist_mlp", [
             f"--checkpoint.directory={tmp_path / 'empty'}",
         ])
+
+
+def test_gpt_lm_workload_trains_and_long_context_preset():
+    """The sixth workload: causal LM through the full runner; the
+    long-context preset wires ring attention + remat + a seq-wildcard
+    mesh."""
+    from distributed_tensorflow_tpu import workloads
+    from distributed_tensorflow_tpu.workloads import gpt_lm
+
+    result = workloads.run_workload(
+        "gpt_lm",
+        [
+            "--train.num_steps=40",
+            "--train.log_every=10",
+            "--mesh.data=4",
+            "--mesh.model=2",
+            "--data.global_batch_size=32",
+            "--data.seq_len=16",
+            "--data.vocab_size=48",
+            "--model.vocab_size=48",
+            "--model.max_len=16",
+            "--model.num_layers=2",
+            "--model.d_model=32",
+            "--model.num_heads=4",
+            "--model.d_ff=64",
+            "--model.dropout=0.0",
+            "--model.dtype=float32",
+            "--optimizer.learning_rate=3e-3",
+            "--optimizer.warmup_steps=5",
+            "--optimizer.total_steps=40",
+        ],
+    )
+    hist = result.history
+    assert hist[-1]["loss"] < hist[0]["loss"], hist
+
+    lc = gpt_lm.long_context(seq_len=4096)
+    assert lc.model.seq_impl == "ring" and lc.model.remat
+    assert lc.model.max_len == 4096 and lc.data.seq_len == 4096
+    assert lc.mesh.seq == -1
