@@ -654,17 +654,7 @@ def pipelined_lm_loss_fn(cfg: TransformerConfig, mesh: Any,
             params, ids, batch.get("attention_mask"), cfg, mesh,
             n_microbatches, n_virtual,
         )
-        labels = jnp.concatenate(
-            [ids[:, 1:], jnp.full_like(ids[:, :1], IGNORE_INDEX)], axis=1
-        )
-        if "attention_mask" in batch:
-            # same label-validity rule as lm_loss_fn: label[t] = ids[t+1],
-            # valid iff the mask at t+1 is real
-            mask = batch["attention_mask"]
-            label_valid = jnp.concatenate(
-                [mask[:, 1:] > 0, jnp.zeros_like(mask[:, :1], bool)], axis=1
-            )
-            labels = jnp.where(label_valid, labels, IGNORE_INDEX)
+        labels = _shifted_lm_labels(ids, batch.get("attention_mask"))
         loss, acc = _masked_xent(logits, labels)
         return loss, (model_state, {"accuracy": acc})
 
@@ -693,19 +683,92 @@ def pipelined_mlm_loss_fn(cfg: TransformerConfig, mesh: Any,
 
 
 
-def _masked_xent(logits, labels):
-    """Mean cross-entropy over positions where labels != IGNORE_INDEX."""
+def _xent_eval_stats(logits, labels):
+    """SUMMED per-token eval statistics over valid (non-IGNORE) positions
+    — summed, not averaged, so sharded eval batches aggregate exactly
+    (models/common.classification_eval_fn contract; the runner derives
+    loss/accuracy ratios)."""
     valid = labels != IGNORE_INDEX
     safe = jnp.where(valid, labels, 0)
     xent = -jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     per_tok = jnp.take_along_axis(xent, safe[..., None], axis=-1)[..., 0]
-    per_tok = jnp.where(valid, per_tok, 0.0)
-    count = jnp.maximum(valid.sum(), 1)
-    loss = per_tok.sum() / count
-    acc = jnp.where(
-        valid, jnp.argmax(logits, -1) == safe, False
-    ).sum() / count
-    return loss, acc
+    return {
+        "loss_sum": jnp.where(valid, per_tok, 0.0).sum(),
+        "correct": jnp.where(
+            valid, jnp.argmax(logits, -1) == safe, False
+        ).sum().astype(jnp.float32),
+        "count": valid.sum().astype(jnp.float32),
+    }
+
+
+def _shifted_lm_labels(ids, attention_mask=None):
+    """Next-token labels: position t predicts ids[t+1]; the final
+    position (and positions whose TARGET is padding) are IGNOREd. Shared
+    by lm_loss_fn and lm_eval_fn."""
+    labels = jnp.concatenate(
+        [ids[:, 1:], jnp.full_like(ids[:, :1], IGNORE_INDEX)], axis=1
+    )
+    if attention_mask is not None:
+        label_valid = jnp.concatenate(
+            [attention_mask[:, 1:] > 0,
+             jnp.zeros_like(attention_mask[:, :1], bool)], axis=1
+        )
+        labels = jnp.where(label_valid, labels, IGNORE_INDEX)
+    return labels
+
+
+def transformer_eval_fn(model: Transformer, *, mlm: bool):
+    """Summed-stats eval, MLM or next-token (reference analog: the eval
+    loop over latest_checkpoint, SURVEY.md §3.5). Same ``mlm`` switch as
+    :func:`pipelined_eval_fn`."""
+
+    def eval_fn(params, model_state, batch):
+        ids = batch["input_ids"]
+        logits, _ = model.apply(
+            {"params": params}, ids, batch.get("attention_mask"),
+            train=False, mutable=["losses"],
+        )
+        labels = (batch["labels"] if mlm
+                  else _shifted_lm_labels(ids, batch.get("attention_mask")))
+        return _xent_eval_stats(logits, labels)
+
+    return eval_fn
+
+
+def mlm_eval_fn(model: Transformer):
+    return transformer_eval_fn(model, mlm=True)
+
+
+def lm_eval_fn(model: Transformer):
+    return transformer_eval_fn(model, mlm=False)
+
+
+def pipelined_eval_fn(cfg: TransformerConfig, mesh: Any,
+                      n_microbatches: int, n_virtual: int = 1,
+                      *, mlm: bool):
+    """Summed-stats eval through the pipelined forward (pipe-layout
+    params), MLM or next-token."""
+
+    def eval_fn(params, model_state, batch):
+        ids = batch["input_ids"]
+        logits = pipelined_apply(
+            params, ids, batch.get("attention_mask"), cfg, mesh,
+            n_microbatches, n_virtual,
+        )
+        labels = (batch["labels"] if mlm
+                  else _shifted_lm_labels(ids, batch.get("attention_mask")))
+        return _xent_eval_stats(logits, labels)
+
+    return eval_fn
+
+
+def _masked_xent(logits, labels):
+    """Mean cross-entropy over positions where labels != IGNORE_INDEX —
+    the ratio form of _xent_eval_stats (one implementation of the masked
+    gather/argmax math)."""
+    s = _xent_eval_stats(logits, labels)
+    count = jnp.maximum(s["count"], 1)
+    return s["loss_sum"] / count, s["correct"] / count
 
 
 def mlm_loss_fn(model: Transformer):
@@ -735,17 +798,7 @@ def lm_loss_fn(model: Transformer):
             {"params": params}, ids, batch.get("attention_mask"),
             train=True, rngs={"dropout": rng}, mutable=["losses"],
         )
-        labels = jnp.concatenate(
-            [ids[:, 1:], jnp.full_like(ids[:, :1], IGNORE_INDEX)], axis=1
-        )
-        if "attention_mask" in batch:
-            # label[t] = ids[t+1]: its validity is the mask at t+1, so the
-            # last real token isn't trained to predict padding
-            mask = batch["attention_mask"]
-            label_valid = jnp.concatenate(
-                [mask[:, 1:] > 0, jnp.zeros_like(mask[:, :1], bool)], axis=1
-            )
-            labels = jnp.where(label_valid, labels, IGNORE_INDEX)
+        labels = _shifted_lm_labels(ids, batch.get("attention_mask"))
         loss, acc = _masked_xent(logits, labels)
         loss = loss + collect_aux_loss(mut)  # MoE router load-balance
         return loss, (model_state, {"accuracy": acc})

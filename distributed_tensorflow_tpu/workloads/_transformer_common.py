@@ -34,6 +34,14 @@ def transformer_parts(cfg: RunConfig, mesh, *, mlm: bool) -> WorkloadParts:
         dataset_fn=lambda start: make_text_dataset(
             cfg.data, index_offset=start
         ),
+        # Eval stream at a disjoint index range (the mnist/wide_deep
+        # convention). Truly held-out for the synthetic families (index-
+        # keyed generation); for tokens:<path> corpora TokenFileLM samples
+        # random windows of the SAME corpus, so this is train-corpus
+        # perplexity — bring a separate eval corpus for generalization.
+        eval_dataset_fn=lambda n: make_text_dataset(
+            cfg.data, num_batches=n, index_offset=10**6
+        ),
         flops_per_step=fwd_flops * cfg.data.global_batch_size,
         batch_size=cfg.data.global_batch_size,
     )
@@ -56,6 +64,10 @@ def transformer_parts(cfg: RunConfig, mesh, *, mlm: bool) -> WorkloadParts:
             loss_fn=piped_loss(
                 mcfg, mesh, n_microbatches=n_micro, n_virtual=n_virtual,
             ),
+            eval_fn=tfm.pipelined_eval_fn(
+                mcfg, mesh, n_microbatches=n_micro, n_virtual=n_virtual,
+                mlm=mlm,
+            ),
             param_specs=tfm.pipeline_param_specs(
                 jax.eval_shape(init_fn, jax.random.PRNGKey(0))[0], tp=tp,
             ),
@@ -66,6 +78,7 @@ def transformer_parts(cfg: RunConfig, mesh, *, mlm: bool) -> WorkloadParts:
     return WorkloadParts(
         init_fn=tfm.make_init_fn(model, cfg.data.seq_len),
         loss_fn=tfm.mlm_loss_fn(model) if mlm else tfm.lm_loss_fn(model),
+        eval_fn=tfm.mlm_eval_fn(model) if mlm else tfm.lm_eval_fn(model),
         param_rules=tfm.tp_rules(),
         fsdp=True,
         **common,

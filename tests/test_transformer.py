@@ -322,3 +322,6 @@ def test_bert_workload_pipelined_pp_tp():
     )
     hist = result.history
     assert hist[-1]["loss"] < hist[0]["loss"], hist
+    # the pipelined eval fn runs the same schedule params
+    assert result.eval_metrics is not None
+    assert 0 < result.eval_metrics["accuracy"] <= 1.0
