@@ -1,0 +1,59 @@
+"""utils/benchmarking — the shared harness scaffolding both benches
+(bench.py, tools/bench_bert.py) depend on for honest numbers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.utils import benchmarking as bm
+
+
+def test_describe_devices_cpu_rig():
+    devices, n, platform, on_tpu = bm.describe_devices()
+    assert n == len(jax.devices()) >= 1
+    assert platform == "cpu" and not on_tpu
+
+
+def test_timed_steps_counts_and_syncs():
+    calls = []
+
+    def step(state, batch):
+        calls.append(batch)
+        return state + batch, {"loss": jnp.asarray(float(state + batch))}
+
+    state, sps, loss = bm.timed_steps(
+        step, 0.0, lambda: 1.0, warmup=2, measured=5,
+    )
+    # warmup + measured steps all ran; state chained through every one
+    assert len(calls) == 7
+    assert state == 7.0
+    assert loss == 7.0
+    assert sps > 0
+
+
+def test_timed_steps_rejects_nonfinite_loss():
+    def step(state, batch):
+        return state, {"loss": jnp.asarray(float("nan"))}
+
+    with pytest.raises(AssertionError, match="non-finite"):
+        bm.timed_steps(step, None, lambda: None, warmup=1, measured=1)
+
+
+def test_timed_steps_pulls_fresh_batches():
+    """next_batch is called once per step — the pipeline-fed window
+    contract (a prefetcher iterator advances per step)."""
+    it = iter(range(100))
+
+    def step(state, batch):
+        return state, {"loss": jnp.asarray(float(batch))}
+
+    _, _, loss = bm.timed_steps(
+        step, None, lambda: next(it), warmup=3, measured=4,
+    )
+    assert loss == 6.0  # 7th value pulled (0-indexed)
+
+
+def test_sync_by_value_forces_scalar():
+    assert bm.sync_by_value({"loss": jnp.asarray(2.5)}) == 2.5
+    assert isinstance(bm.sync_by_value({"loss": jnp.asarray(1)}), float)
