@@ -325,3 +325,44 @@ def test_bert_workload_pipelined_pp_tp():
     # the pipelined eval fn runs the same schedule params
     assert result.eval_metrics is not None
     assert 0 < result.eval_metrics["accuracy"] <= 1.0
+
+
+def test_bert_pipelined_checkpoint_eval_roundtrip(tmp_path):
+    """The stacked [S,lc,...] pipelined layout survives checkpoint →
+    standalone evaluate_from_checkpoint: restored eval stats equal the
+    live trainer's exactly."""
+    from distributed_tensorflow_tpu import workloads
+    from distributed_tensorflow_tpu.utils import config as config_lib
+    from distributed_tensorflow_tpu.workloads import bert_pretrain
+
+    overrides = [
+        "--train.num_steps=12",
+        "--train.log_every=6",
+        "--mesh.pipe=2",
+        "--mesh.data=4",
+        "--data.global_batch_size=32",
+        "--data.seq_len=16",
+        "--data.vocab_size=48",
+        "--data.mask_token=0",
+        "--model.vocab_size=48",
+        "--model.max_len=16",
+        "--model.num_layers=2",
+        "--model.d_model=32",
+        "--model.num_heads=4",
+        "--model.d_ff=64",
+        "--model.dropout=0.0",
+        "--model.dtype=float32",
+        f"--checkpoint.directory={tmp_path}/ck",
+        "--checkpoint.save_interval_steps=6",
+        "--checkpoint.async_save=false",
+        "--checkpoint.save_on_preemption=false",
+    ]
+    live = workloads.run_workload("bert_pretrain", overrides)
+    assert live.eval_metrics is not None
+    cfg = config_lib.apply_overrides(
+        bert_pretrain.default_config(), overrides
+    )
+    offline = workloads.evaluate_from_checkpoint(cfg, bert_pretrain.build)
+    for k in ("loss", "accuracy", "count"):
+        assert abs(offline[k] - live.eval_metrics[k]) < 1e-6, (
+            k, offline, live.eval_metrics)
