@@ -45,6 +45,11 @@ class ClusterConfig:
     # $TF tpu_cluster_resolver.py:95 — metadata autodetection); "always":
     # force argless init; "never": only explicit/env-configured init.
     auto_detect: str = "auto"
+    # Non-empty = persistent XLA compilation cache directory (first TPU
+    # compile is tens of seconds; restarts/resumes then load it in
+    # milliseconds — the checkpoint-restart elasticity story of SURVEY.md
+    # §5.3 leans on fast re-entry). Also honors JAX_COMPILATION_CACHE_DIR.
+    compilation_cache_dir: str = ""
 
 
 def initialize(config: ClusterConfig | None = None) -> None:
@@ -64,6 +69,19 @@ def initialize(config: ClusterConfig | None = None) -> None:
     if env_platforms and jax.config.jax_platforms != env_platforms:
         jax.config.update("jax_platforms", env_platforms)
     config = config or ClusterConfig()
+    cache_dir = config.compilation_cache_dir or os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", ""
+    )
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even quick-compiling programs: resume-after-preemption
+        # replays the whole startup, so every skipped compile counts.
+        # An explicit env threshold wins (same env-honoring contract as
+        # JAX_PLATFORMS above).
+        if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0
+            )
     explicit = config.coordinator_address is not None
     env = "COORDINATOR_ADDRESS" in os.environ
     if explicit or env:

@@ -86,3 +86,36 @@ def test_cluster_config_plumbed_from_cli(fresh_cluster):
     (a, kw), = fresh_cluster
     assert kw["coordinator_address"] == "10.1.1.1:9"
     assert kw["num_processes"] == 4
+
+
+def test_compilation_cache_config(tmp_path, monkeypatch):
+    """ClusterConfig.compilation_cache_dir populates a persistent XLA
+    cache: a second jit of the same program writes nothing new."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.parallel import cluster
+
+    # same env isolation as the fresh_cluster fixture: never let pod
+    # markers route this into a real jax.distributed.initialize()
+    for var in ("COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+                "MEGASCALE_COORDINATOR_ADDRESS",
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
+        monkeypatch.delenv(var, raising=False)
+    cache = tmp_path / "xla_cache"
+    monkeypatch.setattr(cluster, "_initialized", False)
+    cluster.initialize(cluster.ClusterConfig(
+        auto_detect="never", compilation_cache_dir=str(cache)))
+    try:
+        jax.jit(lambda x: x * 3 + 1)(jnp.arange(7.0)).block_until_ready()
+        entries = set(os.listdir(cache))
+        assert entries, "no cache entries written"
+        jax.clear_caches()
+        jax.jit(lambda x: x * 3 + 1)(jnp.arange(7.0)).block_until_ready()
+        assert set(os.listdir(cache)) == entries  # hit, not re-write
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        monkeypatch.setattr(cluster, "_initialized", False)
