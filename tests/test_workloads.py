@@ -207,3 +207,29 @@ def test_gpt_lm_workload_trains_and_long_context_preset():
     assert lc.model.seq_impl == "ring" and lc.model.remat
     assert lc.model.max_len == 4096 and lc.data.seq_len == 4096
     assert lc.mesh.seq == -1
+
+
+def test_profiler_callback_writes_trace(tmp_path):
+    """The Profiler callback (ProfilerHook analog) leaves an XPlane trace
+    on disk for TensorBoard after its start/stop window."""
+    import os
+
+    from distributed_tensorflow_tpu import workloads
+
+    logdir = tmp_path / "prof"
+    workloads.run_workload(
+        "mnist_mlp",
+        [
+            "--train.num_steps=20",
+            "--train.log_every=10",
+            "--train.profile=true",
+            f"--train.profile_dir={logdir}",
+            "--data.global_batch_size=16",
+        ],
+    )
+    traces = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(logdir) for f in fs
+        if f.endswith(".xplane.pb")
+    ]
+    assert traces, f"no xplane trace under {logdir}"
