@@ -80,11 +80,15 @@ def main() -> None:
         parts.init_fn, jax.random.PRNGKey(0)
     )
     P = jax.sharding.PartitionSpec
-    if parts.param_rules is not None:
+    if parts.param_specs is not None:
+        # explicit spec tree (pipelined stacked layouts) wins, same
+        # precedence as init_train_state
+        specs = parts.param_specs
+    elif parts.param_rules is not None:
         specs = sh.specs_from_path_rules(abstract_params, parts.param_rules)
     else:
         specs = jax.tree.map(lambda _: P(), abstract_params)
-    if parts.fsdp:
+    if parts.param_specs is None and parts.fsdp:
         # same merge as train/step.init_train_state: rules win, auto-FSDP
         # fills the replicated remainder
         auto = sh.auto_fsdp_specs(abstract_params, mesh)
