@@ -1,0 +1,116 @@
+"""Independent cross-framework oracle: a from-scratch torch (CPU)
+reimplementation of the transformer block math, fed the SAME weights as
+the flax model. The in-repo parity tests compare flax twins that share
+module code, so a systematic error in the shared code (masking sign,
+softmax axis, GELU flavor, residual order) would cancel out; torch's
+independent kernels cannot share such a bug.
+
+Matching contract: flax nn.gelu defaults to the tanh approximation;
+LayerNorm eps follows flax's 1e-6 default; attention uses 1/sqrt(D)
+scaling with pre-softmax additive masking. Post-LN (BERT) arrangement.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from distributed_tensorflow_tpu.models import transformer as tfm
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x, np.float32))
+
+
+def torch_block(p, x, cfg, mask=None):
+    """One post-LN encoder block in pure torch, weights from the flax
+    param subtree ``p`` (layer_i)."""
+    F = torch.nn.functional
+    B, S, d = x.shape
+    H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
+
+    a = p["attn"]
+    q = (x @ _t(a["query"]["kernel"]) + _t(a["query"]["bias"]))
+    k = (x @ _t(a["key"]["kernel"]) + _t(a["key"]["bias"]))
+    v = (x @ _t(a["value"]["kernel"]) + _t(a["value"]["bias"]))
+    split = lambda t: t.reshape(B, S, H, D).permute(0, 2, 1, 3)
+    q, k, v = split(q), split(k), split(v)
+    logits = (q @ k.transpose(-1, -2)) / (D ** 0.5)
+    if mask is not None:
+        logits = logits + torch.where(
+            _t(mask)[:, None, None, :] > 0, 0.0, -1e9
+        )
+    out = torch.softmax(logits, dim=-1) @ v
+    out = out.permute(0, 2, 1, 3).reshape(B, S, H * D)
+    out = out @ _t(a["attn_out"]["kernel"]) + _t(a["attn_out"]["bias"])
+    x = F.layer_norm(
+        x + out, (d,), _t(p["ln1"]["scale"]), _t(p["ln1"]["bias"]),
+        eps=1e-6,
+    )
+    h = x @ _t(p["mlp_in"]["kernel"]) + _t(p["mlp_in"]["bias"])
+    h = F.gelu(h, approximate="tanh")  # flax nn.gelu default flavor
+    h = h @ _t(p["mlp_out"]["kernel"]) + _t(p["mlp_out"]["bias"])
+    return F.layer_norm(
+        x + h, (d,), _t(p["ln2"]["scale"]), _t(p["ln2"]["bias"]),
+        eps=1e-6,
+    )
+
+
+def torch_bert_forward(params, ids, cfg, mask=None):
+    emb = _t(params["tok_embed"]["embedding"])
+    x = emb[torch.from_numpy(np.asarray(ids))]
+    x = x + _t(params["pos_embed"])[None, : ids.shape[1]]
+    x = torch.nn.functional.layer_norm(
+        x, (cfg.d_model,), _t(params["embed_ln"]["scale"]),
+        _t(params["embed_ln"]["bias"]), eps=1e-6,
+    )
+    for i in range(cfg.num_layers):
+        x = torch_block(params[f"layer_{i}"], x, cfg, mask)
+    x = x @ _t(params["mlm_transform"]["kernel"]) + _t(
+        params["mlm_transform"]["bias"])
+    x = torch.nn.functional.gelu(x, approximate="tanh")
+    x = torch.nn.functional.layer_norm(
+        x, (cfg.d_model,), _t(params["mlm_ln"]["scale"]),
+        _t(params["mlm_ln"]["bias"]), eps=1e-6,
+    )
+    return x @ emb.T + _t(params["mlm_bias"])
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_flax_bert_matches_independent_torch(masked):
+    cfg = tfm.TransformerConfig(
+        vocab_size=96, max_len=24, num_layers=2, d_model=32, num_heads=4,
+        d_ff=64, dropout=0.0, causal=False, pre_ln=False, dtype="float32",
+        attention_impl="dense",
+    )
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 24)(jax.random.PRNGKey(2))
+    # perturb away from init so LN scales etc. carry signal
+    leaves, tree = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.PRNGKey(5), len(leaves))
+    params = jax.tree.unflatten(tree, [
+        l + 0.05 * jax.random.normal(k, l.shape, l.dtype)
+        for l, k in zip(leaves, keys)
+    ])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (3, 24)).astype(np.int32)
+    mask = None
+    if masked:
+        mask = np.ones((3, 24), np.int32)
+        mask[:, -5:] = 0
+    want = torch_bert_forward(
+        jax.device_get(params), ids, cfg, mask
+    ).detach().numpy()
+    got = np.asarray(model.apply(
+        {"params": params}, jnp.asarray(ids),
+        jnp.asarray(mask) if mask is not None else None, train=False,
+    ))
+    if masked:
+        # masked-out positions' logits may differ (both arbitrary);
+        # compare real positions only
+        got, want = got[:, :-5], want[:, :-5]
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)

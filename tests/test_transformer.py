@@ -303,13 +303,14 @@ def test_bert_workload_pipelined_pp_tp():
             "--mesh.pipe=2",
             "--mesh.model=2",
             "--mesh.data=2",
+            "--train.pipeline_virtual=2",  # interleaved schedule knob
             "--data.global_batch_size=64",
             "--data.seq_len=16",
             "--data.vocab_size=48",
             "--data.mask_token=0",
             "--model.vocab_size=48",
             "--model.max_len=16",
-            "--model.num_layers=2",
+            "--model.num_layers=4",  # S*V=4 chunks of one layer
             "--model.d_model=32",
             "--model.num_heads=4",
             "--model.d_ff=64",
