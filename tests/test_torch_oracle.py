@@ -10,8 +10,6 @@ LayerNorm eps follows flax's 1e-6 default; attention uses 1/sqrt(D)
 scaling with pre-softmax additive masking. Post-LN (BERT) arrangement.
 """
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
