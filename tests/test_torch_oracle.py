@@ -112,3 +112,63 @@ def test_flax_bert_matches_independent_torch(masked):
         # compare real positions only
         got, want = got[:, :-5], want[:, :-5]
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def torch_gpt_forward(params, ids, cfg):
+    """Pre-LN causal decoder in pure torch (gpt_small arrangement):
+    x + attn(ln1(x)), x + mlp(ln2(x)), final_ln, tied head."""
+    F = torch.nn.functional
+    emb = _t(params["tok_embed"]["embedding"])
+    x = emb[torch.from_numpy(np.asarray(ids))]
+    x = x + _t(params["pos_embed"])[None, : ids.shape[1]]
+    B, S, d = x.shape
+    H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
+    causal = torch.tril(torch.ones(S, S, dtype=torch.bool))
+    for i in range(cfg.num_layers):
+        p = params[f"layer_{i}"]
+        a = p["attn"]
+        xn = F.layer_norm(x, (d,), _t(p["ln1"]["scale"]),
+                          _t(p["ln1"]["bias"]), eps=1e-6)
+        split = lambda t: t.reshape(B, S, H, D).permute(0, 2, 1, 3)
+        q = split(xn @ _t(a["query"]["kernel"]) + _t(a["query"]["bias"]))
+        k = split(xn @ _t(a["key"]["kernel"]) + _t(a["key"]["bias"]))
+        v = split(xn @ _t(a["value"]["kernel"]) + _t(a["value"]["bias"]))
+        logits = (q @ k.transpose(-1, -2)) / (D ** 0.5)
+        logits = logits.masked_fill(~causal, -1e9)
+        out = torch.softmax(logits, dim=-1) @ v
+        out = out.permute(0, 2, 1, 3).reshape(B, S, H * D)
+        x = x + (out @ _t(a["attn_out"]["kernel"])
+                 + _t(a["attn_out"]["bias"]))
+        hn = F.layer_norm(x, (d,), _t(p["ln2"]["scale"]),
+                          _t(p["ln2"]["bias"]), eps=1e-6)
+        h = hn @ _t(p["mlp_in"]["kernel"]) + _t(p["mlp_in"]["bias"])
+        h = F.gelu(h, approximate="tanh")
+        x = x + (h @ _t(p["mlp_out"]["kernel"]) + _t(p["mlp_out"]["bias"]))
+    x = F.layer_norm(x, (d,), _t(params["final_ln"]["scale"]),
+                     _t(params["final_ln"]["bias"]), eps=1e-6)
+    return x @ emb.T + _t(params["mlm_bias"])
+
+
+def test_flax_gpt_matches_independent_torch():
+    """Pre-LN CAUSAL decoder vs the independent torch oracle — catches
+    causal-mask offset/sign errors the flax twins share by construction."""
+    cfg = tfm.TransformerConfig(
+        vocab_size=96, max_len=24, num_layers=2, d_model=32, num_heads=4,
+        d_ff=64, dropout=0.0, causal=True, pre_ln=True, dtype="float32",
+        attention_impl="dense",
+    )
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 24)(jax.random.PRNGKey(3))
+    leaves, tree = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.PRNGKey(7), len(leaves))
+    params = jax.tree.unflatten(tree, [
+        l + 0.05 * jax.random.normal(k, l.shape, l.dtype)
+        for l, k in zip(leaves, keys)
+    ])
+    ids = np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (3, 24)).astype(np.int32)
+    want = torch_gpt_forward(jax.device_get(params), ids, cfg
+                             ).detach().numpy()
+    got = np.asarray(model.apply(
+        {"params": params}, jnp.asarray(ids), None, train=False))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
