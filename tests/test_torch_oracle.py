@@ -24,6 +24,34 @@ def _t(x):
     return torch.from_numpy(np.asarray(x, np.float32))
 
 
+def _torch_attention(a, xn, B, S, H, D, logit_mask):
+    """q/k/v projection + scaled softmax attention + merge + out-proj,
+    from the flax ``attn`` param subtree. ``logit_mask`` is an additive
+    [.., S, S]-broadcastable tensor (0 = keep, -1e9 = drop) — the single
+    spot where the encoder-padding and causal variants differ."""
+    split = lambda t: t.reshape(B, S, H, D).permute(0, 2, 1, 3)
+    q = split(xn @ _t(a["query"]["kernel"]) + _t(a["query"]["bias"]))
+    k = split(xn @ _t(a["key"]["kernel"]) + _t(a["key"]["bias"]))
+    v = split(xn @ _t(a["value"]["kernel"]) + _t(a["value"]["bias"]))
+    logits = (q @ k.transpose(-1, -2)) / (D ** 0.5)
+    if logit_mask is not None:
+        logits = logits + logit_mask
+    out = torch.softmax(logits, dim=-1) @ v
+    out = out.permute(0, 2, 1, 3).reshape(B, S, H * D)
+    return out @ _t(a["attn_out"]["kernel"]) + _t(a["attn_out"]["bias"])
+
+
+def _perturb(params, seed):
+    """Move params off their init values so LN scales/biases and the
+    zero-init heads carry signal in the comparison."""
+    leaves, tree = jax.tree.flatten(params)
+    keys = jax.random.split(jax.random.PRNGKey(seed), len(leaves))
+    return jax.tree.unflatten(tree, [
+        l + 0.05 * jax.random.normal(k, l.shape, l.dtype)
+        for l, k in zip(leaves, keys)
+    ])
+
+
 def torch_block(p, x, cfg, mask=None):
     """One post-LN encoder block in pure torch, weights from the flax
     param subtree ``p`` (layer_i)."""
@@ -31,20 +59,10 @@ def torch_block(p, x, cfg, mask=None):
     B, S, d = x.shape
     H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
 
-    a = p["attn"]
-    q = (x @ _t(a["query"]["kernel"]) + _t(a["query"]["bias"]))
-    k = (x @ _t(a["key"]["kernel"]) + _t(a["key"]["bias"]))
-    v = (x @ _t(a["value"]["kernel"]) + _t(a["value"]["bias"]))
-    split = lambda t: t.reshape(B, S, H, D).permute(0, 2, 1, 3)
-    q, k, v = split(q), split(k), split(v)
-    logits = (q @ k.transpose(-1, -2)) / (D ** 0.5)
+    logit_mask = None
     if mask is not None:
-        logits = logits + torch.where(
-            _t(mask)[:, None, None, :] > 0, 0.0, -1e9
-        )
-    out = torch.softmax(logits, dim=-1) @ v
-    out = out.permute(0, 2, 1, 3).reshape(B, S, H * D)
-    out = out @ _t(a["attn_out"]["kernel"]) + _t(a["attn_out"]["bias"])
+        logit_mask = torch.where(_t(mask)[:, None, None, :] > 0, 0.0, -1e9)
+    out = _torch_attention(p["attn"], x, B, S, H, D, logit_mask)
     x = F.layer_norm(
         x + out, (d,), _t(p["ln1"]["scale"]), _t(p["ln1"]["bias"]),
         eps=1e-6,
@@ -87,13 +105,7 @@ def test_flax_bert_matches_independent_torch(masked):
     )
     model = tfm.Transformer(cfg)
     params, _ = tfm.make_init_fn(model, 24)(jax.random.PRNGKey(2))
-    # perturb away from init so LN scales etc. carry signal
-    leaves, tree = jax.tree.flatten(params)
-    keys = jax.random.split(jax.random.PRNGKey(5), len(leaves))
-    params = jax.tree.unflatten(tree, [
-        l + 0.05 * jax.random.normal(k, l.shape, l.dtype)
-        for l, k in zip(leaves, keys)
-    ])
+    params = _perturb(params, 5)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (3, 24)).astype(np.int32)
     mask = None
@@ -124,21 +136,12 @@ def torch_gpt_forward(params, ids, cfg):
     B, S, d = x.shape
     H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
     causal = torch.tril(torch.ones(S, S, dtype=torch.bool))
+    causal_mask = torch.where(causal, 0.0, -1e9)
     for i in range(cfg.num_layers):
         p = params[f"layer_{i}"]
-        a = p["attn"]
         xn = F.layer_norm(x, (d,), _t(p["ln1"]["scale"]),
                           _t(p["ln1"]["bias"]), eps=1e-6)
-        split = lambda t: t.reshape(B, S, H, D).permute(0, 2, 1, 3)
-        q = split(xn @ _t(a["query"]["kernel"]) + _t(a["query"]["bias"]))
-        k = split(xn @ _t(a["key"]["kernel"]) + _t(a["key"]["bias"]))
-        v = split(xn @ _t(a["value"]["kernel"]) + _t(a["value"]["bias"]))
-        logits = (q @ k.transpose(-1, -2)) / (D ** 0.5)
-        logits = logits.masked_fill(~causal, -1e9)
-        out = torch.softmax(logits, dim=-1) @ v
-        out = out.permute(0, 2, 1, 3).reshape(B, S, H * D)
-        x = x + (out @ _t(a["attn_out"]["kernel"])
-                 + _t(a["attn_out"]["bias"]))
+        x = x + _torch_attention(p["attn"], xn, B, S, H, D, causal_mask)
         hn = F.layer_norm(x, (d,), _t(p["ln2"]["scale"]),
                           _t(p["ln2"]["bias"]), eps=1e-6)
         h = hn @ _t(p["mlp_in"]["kernel"]) + _t(p["mlp_in"]["bias"])
@@ -159,12 +162,7 @@ def test_flax_gpt_matches_independent_torch():
     )
     model = tfm.Transformer(cfg)
     params, _ = tfm.make_init_fn(model, 24)(jax.random.PRNGKey(3))
-    leaves, tree = jax.tree.flatten(params)
-    keys = jax.random.split(jax.random.PRNGKey(7), len(leaves))
-    params = jax.tree.unflatten(tree, [
-        l + 0.05 * jax.random.normal(k, l.shape, l.dtype)
-        for l, k in zip(leaves, keys)
-    ])
+    params = _perturb(params, 7)
     ids = np.random.RandomState(1).randint(
         0, cfg.vocab_size, (3, 24)).astype(np.int32)
     want = torch_gpt_forward(jax.device_get(params), ids, cfg

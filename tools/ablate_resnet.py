@@ -52,6 +52,7 @@ def main() -> None:
     for name in names:
         env = {k: v for k, v in os.environ.items() if k not in _KNOBS}
         env.update(VARIANTS[name])
+        env["BENCH_SKIP_PROBE"] = "1"  # one sweep, one relay; skip per-run probes
         proc = subprocess.run(
             [sys.executable, BENCH], env=env, capture_output=True, text=True
         )
