@@ -96,6 +96,36 @@ def test_schedules_smoke():
         assert all(np.isfinite(vals)), sched
 
 
+def test_schedule_shapes_analytic():
+    """Analytic checkpoints of the LR curves, not just finiteness:
+    warmup ramps linearly 0 -> peak, cosine lands on end_lr_factor at
+    total_steps, linear interpolates exactly halfway at midpoint."""
+    from distributed_tensorflow_tpu.train import make_schedule
+
+    lr, W, T = 0.1, 10, 110
+    cos = make_schedule(OptimizerConfig(
+        schedule="cosine", learning_rate=lr, warmup_steps=W, total_steps=T,
+        end_lr_factor=0.01))
+    # linear warmup: exact fractions of peak
+    for i in (0, 5, 10):
+        np.testing.assert_allclose(float(cos(i)), lr * i / W, rtol=1e-6)
+    # peak right after warmup (f32 schedule arithmetic), floor at the end
+    np.testing.assert_allclose(float(cos(W)), lr, rtol=1e-6)
+    np.testing.assert_allclose(float(cos(T)), lr * 0.01, rtol=1e-5)
+    # cosine midpoint: halfway between peak and floor
+    np.testing.assert_allclose(
+        float(cos(W + (T - W) // 2)), lr * (1 + 0.01) / 2, rtol=1e-5)
+    # monotone decay after warmup
+    pts = [float(cos(i)) for i in range(W, T, 10)]
+    assert all(a >= b for a, b in zip(pts, pts[1:])), pts
+
+    lin = make_schedule(OptimizerConfig(
+        schedule="linear", learning_rate=lr, warmup_steps=0, total_steps=100,
+        end_lr_factor=0.0))
+    np.testing.assert_allclose(float(lin(50)), lr / 2, rtol=1e-6)
+    np.testing.assert_allclose(float(lin(100)), 0.0, atol=1e-9)
+
+
 def test_checkpoint_save_restore_resume(mesh8, tmp_path):
     """The §5.4 oracle: train 6 steps straight == train 3, 'crash', resume 3."""
     tx = optax.adam(1e-2)
