@@ -149,3 +149,44 @@ def test_decode_throughput_host_only(tmp_path):
         serial = 64 / (time.perf_counter() - t0)
         print(f"single-thread: {serial:.0f} images/sec")
         assert rate > 2 * serial, (rate, serial)
+
+
+def test_imagefolder_converter_roundtrip(tmp_path):
+    """tools/make_jpeg_records.py: ImageFolder tree -> record pair by raw
+    byte copy (lossless — decoded pixels identical to the source files),
+    labels from sorted class dirs, readable by JpegClassificationDataset."""
+    import io
+    import json
+    import sys
+
+    from PIL import Image
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parents[1]))
+    from tools.make_jpeg_records import convert
+
+    src = tmp_path / "imagefolder"
+    imgs = _images(6, h=40, w=40)
+    for i, cls in enumerate(["cat", "dog", "ant"] * 2):
+        d = src / cls
+        d.mkdir(exist_ok=True, parents=True)
+        Image.fromarray(imgs[i]).save(d / f"img{i}.jpg", "JPEG", quality=92)
+
+    out = str(tmp_path / "rec")
+    n = convert(str(src), out, shuffle_seed=None)
+    assert n == 6
+    classes = json.load(open(out + ".classes.json"))
+    assert classes == ["ant", "cat", "dog"]
+
+    ds = JpegClassificationDataset(out, 32, 6, train=False, num_batches=1)
+    b = next(iter(ds))
+    assert b["image"].shape == (6, 32, 32, 3)
+    # labels follow sorted-class convention: ant=0, cat=1, dog=2
+    assert sorted(b["label"].tolist()) == [0, 0, 1, 1, 2, 2]
+    # raw-copy losslessness: the stored bytes ARE the source file's
+    entry = ds.entries[0]
+    raw = bytes(ds._data[entry["offset"]: entry["offset"] + entry["length"]])
+    first_file = sorted((src / "ant").iterdir())[0]
+    assert raw == first_file.read_bytes() or any(
+        raw == p.read_bytes()
+        for c in classes for p in sorted((src / c).iterdir())
+    )

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Convert an ImageFolder-layout directory (class_name/xxx.jpg) into the
+framework's JPEG record pair (<out>.dat + <out>.idx — see
+data/jpeg_records.py) by RAW BYTE CONCATENATION: original JPEG streams
+are copied verbatim, never decoded or re-encoded, so conversion is
+IO-bound and lossless. Labels are the sorted class-directory index
+(torchvision ImageFolder convention); a <out>.classes.json sidecar
+records the mapping.
+
+The reference's equivalent step was building per-worker TFRecords of
+JPEG bytes for tf.data (SURVEY.md §2a 'Input pipeline').
+
+Usage:
+  tools/make_jpeg_records.py /data/imagenet/train /data/records/train \
+      [--shuffle-seed 0] [--limit N]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from distributed_tensorflow_tpu.data.jpeg_records import _ENTRY
+
+_EXTS = (".jpg", ".jpeg", ".JPG", ".JPEG")
+
+
+def convert(src: str, out: str, shuffle_seed: int | None = 0,
+            limit: int | None = None) -> int:
+    classes = sorted(
+        d for d in os.listdir(src)
+        if os.path.isdir(os.path.join(src, d))
+    )
+    if not classes:
+        raise SystemExit(f"no class subdirectories under {src}")
+    files = [
+        (os.path.join(src, c, f), label)
+        for label, c in enumerate(classes)
+        for f in sorted(os.listdir(os.path.join(src, c)))
+        if f.endswith(_EXTS)
+    ]
+    if shuffle_seed is not None:
+        # pre-shuffle so sequential readers of the .dat stream well even
+        # before the per-epoch index shuffle kicks in
+        np.random.RandomState(shuffle_seed).shuffle(files)
+    if limit:
+        files = files[:limit]
+    entries = np.empty(len(files), _ENTRY)
+    off = 0
+    with open(out + ".dat", "wb") as dat:
+        for i, (path, label) in enumerate(files):
+            with open(path, "rb") as f:
+                raw = f.read()
+            dat.write(raw)
+            entries[i] = (off, len(raw), label)
+            off += len(raw)
+    entries.tofile(out + ".idx")
+    with open(out + ".classes.json", "w") as f:
+        json.dump(classes, f)
+    print(f"{len(files)} images, {len(classes)} classes, "
+          f"{off / 1e9:.2f} GB -> {out}.dat/.idx", file=sys.stderr)
+    return len(files)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("src")
+    ap.add_argument("out")
+    ap.add_argument("--shuffle-seed", type=int, default=0)
+    ap.add_argument("--no-shuffle", action="store_true")
+    ap.add_argument("--limit", type=int, default=None)
+    args = ap.parse_args()
+    convert(args.src, args.out,
+            shuffle_seed=None if args.no_shuffle else args.shuffle_seed,
+            limit=args.limit)
+
+
+if __name__ == "__main__":
+    main()
