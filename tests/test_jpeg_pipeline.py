@@ -182,11 +182,20 @@ def test_imagefolder_converter_roundtrip(tmp_path):
     assert b["image"].shape == (6, 32, 32, 3)
     # labels follow sorted-class convention: ant=0, cat=1, dog=2
     assert sorted(b["label"].tolist()) == [0, 0, 1, 1, 2, 2]
-    # raw-copy losslessness: the stored bytes ARE the source file's
-    entry = ds.entries[0]
-    raw = bytes(ds._data[entry["offset"]: entry["offset"] + entry["length"]])
-    first_file = sorted((src / "ant").iterdir())[0]
-    assert raw == first_file.read_bytes() or any(
-        raw == p.read_bytes()
-        for c in classes for p in sorted((src / c).iterdir())
-    )
+    # raw-copy losslessness AND offset/label correspondence: with no
+    # shuffle the stream is label-major, so entry i's bytes must equal
+    # the i-th file of the sorted walk of its OWN class
+    per_class_files = {
+        c: sorted(p for p in (src / c).rglob("*") if p.suffix == ".jpg")
+        for c in classes
+    }
+    cursor = {c: 0 for c in classes}
+    for i in range(6):
+        entry = ds.entries[i]
+        raw = bytes(
+            ds._data[entry["offset"]: entry["offset"] + entry["length"]]
+        )
+        cls = classes[int(entry["label"])]
+        expect = per_class_files[cls][cursor[cls]]
+        cursor[cls] += 1
+        assert raw == expect.read_bytes(), (i, cls, expect)

@@ -27,28 +27,46 @@ sys.path.insert(0, REPO)
 
 from distributed_tensorflow_tpu.data.jpeg_records import _ENTRY
 
-_EXTS = (".jpg", ".jpeg", ".JPG", ".JPEG")
+_EXTS = (".jpg", ".jpeg")
+
+
+def _class_files(class_dir: str) -> tuple[list[str], int]:
+    """All JPEGs under a class dir (recursive, case-insensitive extension
+    match — the torchvision ImageFolder contract) + skipped-file count."""
+    kept, skipped = [], 0
+    for root, _, names in sorted(os.walk(class_dir)):
+        for f in sorted(names):
+            if f.lower().endswith(_EXTS):
+                kept.append(os.path.join(root, f))
+            else:
+                skipped += 1
+    return kept, skipped
 
 
 def convert(src: str, out: str, shuffle_seed: int | None = 0,
             limit: int | None = None) -> int:
+    if limit is not None and limit <= 0:
+        raise ValueError(f"--limit must be positive, got {limit}")
     classes = sorted(
         d for d in os.listdir(src)
         if os.path.isdir(os.path.join(src, d))
     )
     if not classes:
-        raise SystemExit(f"no class subdirectories under {src}")
-    files = [
-        (os.path.join(src, c, f), label)
-        for label, c in enumerate(classes)
-        for f in sorted(os.listdir(os.path.join(src, c)))
-        if f.endswith(_EXTS)
-    ]
+        raise ValueError(f"no class subdirectories under {src}")
+    files, skipped = [], 0
+    for label, c in enumerate(classes):
+        kept, skip = _class_files(os.path.join(src, c))
+        files.extend((p, label) for p in kept)
+        skipped += skip
+    if skipped:
+        print(f"note: skipped {skipped} non-JPEG files", file=sys.stderr)
+    if not files:
+        raise ValueError(f"no .jpg/.jpeg files under {src}")
     if shuffle_seed is not None:
         # pre-shuffle so sequential readers of the .dat stream well even
         # before the per-epoch index shuffle kicks in
         np.random.RandomState(shuffle_seed).shuffle(files)
-    if limit:
+    if limit is not None:
         files = files[:limit]
     entries = np.empty(len(files), _ENTRY)
     off = 0
@@ -75,9 +93,12 @@ def main() -> None:
     ap.add_argument("--no-shuffle", action="store_true")
     ap.add_argument("--limit", type=int, default=None)
     args = ap.parse_args()
-    convert(args.src, args.out,
-            shuffle_seed=None if args.no_shuffle else args.shuffle_seed,
-            limit=args.limit)
+    try:
+        convert(args.src, args.out,
+                shuffle_seed=None if args.no_shuffle else args.shuffle_seed,
+                limit=args.limit)
+    except ValueError as e:
+        raise SystemExit(str(e))
 
 
 if __name__ == "__main__":
