@@ -304,6 +304,7 @@ def test_bert_workload_pipelined_pp_tp():
             "--mesh.model=2",
             "--mesh.data=2",
             "--train.pipeline_virtual=2",  # interleaved schedule knob
+            "--train.pipeline_microbatches=8",  # explicit (auto would pick 8 too)
             "--data.global_batch_size=64",
             "--data.seq_len=16",
             "--data.vocab_size=48",
