@@ -192,48 +192,15 @@ def main() -> None:
     }))
 
 
-def _maybe_fall_back_to_cpu(timeout_s: int = 150) -> None:
-    """When the tunneled accelerator is unreachable (the axon relay has
-    died mid-session twice — PERF_NOTES.md), pin this run to CPU so the
-    driver records an honest CPU row instead of timing out with no row.
-
-    Backend init BLOCKS forever when the relay is down (no error), so
-    the probe runs device init in a subprocess under an external timeout;
-    the killed child holds no device lease (it never got past init).
-
-    Fallback applies only to the ambient platform config ("axon" baked
-    into this environment's env, or unset): an operator's explicit
-    JAX_PLATFORMS pin — cpu or anything else — is honored untouched
-    (the same explicit-env-wins contract as utils/benchmarking.
-    honor_env_platform). BENCH_SKIP_PROBE=1 skips the probe's extra
-    backend init (sweeps like tools/ablate_resnet.py, and the execv
-    retry below, already know the relay state)."""
-    import subprocess
-
-    env_pin = os.environ.get("JAX_PLATFORMS", "").strip()
-    if env_pin not in ("", "axon"):
-        return  # explicit operator pin: never second-guess it
-    if os.environ.get("BENCH_SKIP_PROBE") == "1":
-        return
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True, text=True,
-        )
-        if proc.returncode == 0:
-            return
-        log("accelerator probe failed; falling back to CPU. stderr tail:")
-        for line in proc.stderr.splitlines()[-5:]:
-            log("  " + line)
-    except subprocess.TimeoutExpired:
-        log(f"accelerator probe hung >{timeout_s}s (relay down?); "
-            "falling back to CPU")
-    os.environ["JAX_PLATFORMS"] = "cpu"
-
-
 if __name__ == "__main__":
     _pinned = "BENCH_BLOCK_IMPL" in os.environ
-    _maybe_fall_back_to_cpu()
+    # Honest CPU row instead of hanging the driver when the relay is down
+    # (probe + explicit-pin contract: utils/benchmarking.py).
+    from distributed_tensorflow_tpu.utils.benchmarking import (
+        fall_back_to_cpu_if_unreachable,
+    )
+
+    fall_back_to_cpu_if_unreachable(log=log)
     try:
         main()
     except Exception:
@@ -247,5 +214,7 @@ if __name__ == "__main__":
         traceback.print_exc(file=sys.stderr)
         log("bench failed with default blocks; retrying with standard")
         os.environ["BENCH_BLOCK_IMPL"] = "standard"
-        os.environ["BENCH_SKIP_PROBE"] = "1"  # relay state already known
+        # deliberately NOT skipping the probe: the failure may BE the
+        # relay dying mid-run, and the retry must re-detect that.
+        os.environ.pop("BENCH_SKIP_PROBE", None)
         os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])

@@ -39,6 +39,8 @@ def main() -> None:
 
     from distributed_tensorflow_tpu.utils import benchmarking as bm
 
+    # honest CPU row instead of hanging forever on a dead relay
+    bm.fall_back_to_cpu_if_unreachable(log=log)
     bm.honor_env_platform()
     import dataclasses
 
