@@ -125,7 +125,8 @@ def timed_steps(
     metrics = None
     for _ in range(warmup):
         state, metrics = step(state, next_batch())
-    sync_by_value(metrics)
+    if metrics is not None:  # warmup=0: nothing dispatched yet to sync
+        sync_by_value(metrics)
     log("measuring...")
     t0 = time.perf_counter()
     for _ in range(measured):
@@ -133,5 +134,8 @@ def timed_steps(
     loss = sync_by_value(metrics)
     dt = time.perf_counter() - t0
     log(f"final loss {loss:.4f} (finite => really trained)")
-    assert np.isfinite(loss), f"non-finite loss {loss}"
+    # explicit raise, not assert: must survive `python -O` so a diverged
+    # run can never post a throughput number
+    if not np.isfinite(loss):
+        raise RuntimeError(f"non-finite loss {loss}; refusing to report a rate")
     return state, measured / dt, loss

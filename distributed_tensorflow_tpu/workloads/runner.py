@@ -210,9 +210,13 @@ def _run_eval(state: Any, put_batch: Callable, parts: WorkloadParts,
         if summed in result and result.get("count"):
             result[ratio] = result[summed] / result["count"]
     if "auc_pos_hist" in totals and "auc_neg_hist" in totals:
-        result["auc"] = metrics_lib.auc_from_histograms(
+        auc = metrics_lib.auc_from_histograms(
             totals["auc_pos_hist"], totals["auc_neg_hist"]
         )
+        # a one-class stream makes AUC undefined (NaN); omit the key
+        # rather than emit the non-JSON `NaN` literal downstream
+        if np.isfinite(auc):
+            result["auc"] = auc
     return result
 
 

@@ -36,8 +36,21 @@ def test_timed_steps_rejects_nonfinite_loss():
     def step(state, batch):
         return state, {"loss": jnp.asarray(float("nan"))}
 
-    with pytest.raises(AssertionError, match="non-finite"):
+    # RuntimeError, not assert: must fire even under `python -O`
+    with pytest.raises(RuntimeError, match="non-finite"):
         bm.timed_steps(step, None, lambda: None, warmup=1, measured=1)
+
+
+def test_timed_steps_warmup_zero():
+    """warmup=0 is public API: no boundary sync to a metrics dict that
+    doesn't exist yet (timing then includes compile — caller's choice)."""
+    def step(state, batch):
+        return state + 1, {"loss": jnp.asarray(1.0)}
+
+    state, sps, loss = bm.timed_steps(
+        step, 0, lambda: None, warmup=0, measured=3,
+    )
+    assert state == 3 and loss == 1.0 and sps > 0
 
 
 def test_timed_steps_pulls_fresh_batches():

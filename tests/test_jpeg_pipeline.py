@@ -199,3 +199,32 @@ def test_imagefolder_converter_roundtrip(tmp_path):
         expect = per_class_files[cls][cursor[cls]]
         cursor[cls] += 1
         assert raw == expect.read_bytes(), (i, cls, expect)
+
+
+def test_converter_limit_without_shuffle_keeps_all_classes(tmp_path):
+    """--limit + --no-shuffle must not truncate the label-major list to
+    the first class(es): the subset is interleaved round-robin so every
+    class stays represented (ADVICE r2)."""
+    import json
+    import sys
+
+    import numpy as np
+    from PIL import Image
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parents[1]))
+    from tools.make_jpeg_records import convert
+    from distributed_tensorflow_tpu.data.jpeg_records import _ENTRY
+
+    src = tmp_path / "imagefolder"
+    imgs = _images(9, h=24, w=24)
+    for i in range(9):
+        d = src / f"class{i // 3}"  # 3 classes x 3 images
+        d.mkdir(exist_ok=True, parents=True)
+        Image.fromarray(imgs[i]).save(d / f"img{i}.jpg", "JPEG")
+
+    out = str(tmp_path / "rec")
+    n = convert(str(src), out, shuffle_seed=None, limit=3)
+    assert n == 3
+    entries = np.fromfile(out + ".idx", _ENTRY)
+    assert sorted(entries["label"].tolist()) == [0, 1, 2]
+    assert len(json.load(open(out + ".classes.json"))) == 3

@@ -42,3 +42,16 @@ def test_pipelined_plan_uses_explicit_specs():
     # stacked [S, V, ...] leaves: pipe leads, model on kernel dims
     assert "PartitionSpec('pipe', None, None, 'model')" in out  # qkv kernel
     assert "PartitionSpec('pipe', None, 'model', None)" in out  # attn_out
+
+
+def test_wildcard_mesh_with_nondividing_fixed_axis():
+    """A -1 wildcard with a fixed axis that doesn't divide 8 (e.g.
+    pipe=3) must still size a representable fake mesh (ADVICE r2:
+    previously max(8, 3)=8, which 3 doesn't divide -> build_mesh fail)."""
+    out = _run(
+        "bert_pretrain", "--mesh.pipe=3", "--mesh.data=-1",
+        "--model.num_layers=3", "--model.d_model=32", "--model.num_heads=4",
+        "--model.d_ff=64", "--model.vocab_size=128", "--data.vocab_size=128",
+        "--data.seq_len=16", "--model.max_len=16",
+    )
+    assert "pipe=3" in out

@@ -19,6 +19,7 @@ import argparse
 import json
 import os
 import sys
+from itertools import zip_longest
 
 import numpy as np
 
@@ -66,6 +67,18 @@ def convert(src: str, out: str, shuffle_seed: int | None = 0,
         # pre-shuffle so sequential readers of the .dat stream well even
         # before the per-epoch index shuffle kicks in
         np.random.RandomState(shuffle_seed).shuffle(files)
+    elif limit is not None and limit < len(files):
+        # --no-shuffle + --limit on the label-major list would truncate
+        # to the first class(es) only; interleave round-robin per class
+        # so the subset keeps every class represented
+        by_label: dict[int, list] = {}
+        for p, label in files:
+            by_label.setdefault(label, []).append((p, label))
+        files = [
+            pair
+            for tier in zip_longest(*by_label.values())
+            for pair in tier if pair is not None
+        ]
     if limit is not None:
         files = files[:limit]
     entries = np.empty(len(files), _ENTRY)

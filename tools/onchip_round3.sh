@@ -1,0 +1,58 @@
+#!/bin/bash
+# Round-3 on-chip measurement session (VERDICT r2 items 1, 2, 5 + Weak #2).
+# Same discipline as onchip_round2.sh: SEQUENTIAL (single device lease),
+# failure-tolerant, one log per step. New vs round 2:
+#   - HBM/MXU roofline microbench runs FIRST (the 445 GB/s re-measure)
+#   - JPEG-decode-fed bench window (BENCH_DATA=jpeg)
+# Usage: bash tools/onchip_round3.sh [outdir]   (default /tmp/onchip_r3)
+set -u
+OUT=${1:-/tmp/onchip_r3}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+run() { # name timeout_s cmd...
+  local name=$1 t=$2; shift 2
+  echo "=== $name ($(date -u +%H:%M:%S)) ==="
+  timeout --signal=TERM --kill-after=60 "$t" "$@" \
+    >"$OUT/$name.log" 2>&1
+  local rc=$?
+  echo "    rc=$rc  tail:"
+  tail -3 "$OUT/$name.log" | sed 's/^/    /'
+  return $rc
+}
+
+# 0. cheap probe — bail early if the relay is down
+run probe 180 python -u -c "
+import jax, jax.numpy as jnp
+print(jax.devices(), float(jax.jit(lambda a:(a@a).sum())(jnp.ones((256,256),jnp.bfloat16))))
+" || { echo 'relay down; aborting session'; exit 1; }
+
+# 1. roofline inputs: re-measure HBM bandwidth + MXU peak (Weak #2)
+run hbm 600 python -u tools/bench_hbm.py
+
+# 2. parity gate for every fused kernel (26 checks, compiled Mosaic)
+run validate 900 python -u tools/validate_fused_tpu.py
+
+# 3. flagship bench: fused default (auto-falls-back) then standard
+run bench_fused 1200 python -u bench.py
+run bench_standard 1200 env BENCH_BLOCK_IMPL=standard python -u bench.py
+
+# 4. JPEG-decode-fed window (VERDICT item 2: decode inside a measured
+#    TPU window). No-op failure until BENCH_DATA lands in bench.py.
+run bench_jpeg 1500 env BENCH_DATA=jpeg python -u bench.py
+
+# 5. kernel microbench at bench shapes (fwd then grad)
+run microbench_fwd 900 python -u tools/bench_fused_kernels.py fwd 10
+run microbench_grad 900 python -u tools/bench_fused_kernels.py grad 10
+
+# 6. BERT-base MLM + GPT fused-LN ablation (first transformer numbers)
+run bert 1200 python -u tools/bench_bert.py
+run bert_dense_attn 1200 env BENCH_ATTN=dense python -u tools/bench_bert.py
+run gpt_plain 1200 env BENCH_MODEL=gpt python -u tools/bench_bert.py
+run gpt_fused_ln 1200 env BENCH_MODEL=gpt BENCH_FUSED_LN=1 \
+  python -u tools/bench_bert.py
+
+echo "=== session done; JSON lines: ==="
+grep -h '"metric"' "$OUT"/hbm.log "$OUT"/bench_*.log "$OUT"/bert*.log \
+  "$OUT"/gpt*.log 2>/dev/null
+echo "logs in $OUT"

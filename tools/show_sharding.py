@@ -39,7 +39,11 @@ def _fake_device_count() -> int:
             elif n > 0:
                 product *= n
     if wildcard:
-        return max(8, product)
+        # the wildcard axis absorbs the remainder, but the device count
+        # must stay divisible by the fixed-axis product (e.g. pipe=3
+        # data=-1 needs 9 devices, not max(8,3)=8 which 3 won't divide)
+        count = max(8, product)
+        return count if count % product == 0 else ((count // product) + 1) * product
     return product if product > 1 else 8
 
 
