@@ -129,30 +129,74 @@ def main() -> None:
 
     # ---- pipeline-fed window (VERDICT round-1 item 3) -------------------
     # Same jit step, but every batch flows host->device through the
-    # Prefetcher: the host pre-stages K distinct bf16 numpy batches (disk
-    # decode stands outside this loop; transfer + dispatch overlap is what's
-    # being proven). pipeline_efficiency = fed / resident throughput.
+    # Prefetcher. Two modes:
+    #   default   — K pre-staged bf16 numpy batches (transfer + dispatch
+    #               overlap is what's being proven; decode outside)
+    #   BENCH_DATA=jpeg — every batch decodes from a JPEG record file
+    #               built at setup (VERDICT r2 item 2: decode INSIDE the
+    #               measured window, through the production
+    #               JpegClassificationDataset thread-pool path)
     from distributed_tensorflow_tpu.data import Prefetcher
 
     img_dtype = jnp.bfloat16 if on_tpu else np.float32
-    host_batches = []
-    for k in range(4):
-        host_batches.append({
-            "image": rng.randn(global_batch, image, image, 3)
-            .astype(np.float32).astype(img_dtype),
-            "label": rng.randint(0, cfg.num_classes, global_batch)
-            .astype(np.int32),
-        })
+    fed_data = os.environ.get("BENCH_DATA", "synthetic")
+    if fed_data == "jpeg":
+        import tempfile
 
-    def host_stream():
-        i = 0
-        while True:
-            yield host_batches[i % len(host_batches)]
-            i += 1
+        from distributed_tensorflow_tpu.data.jpeg_records import (
+            JpegClassificationDataset, make_jpeg_record_file,
+        )
+
+        n_src = max(512, 2 * global_batch)
+        src_size = image + 32  # decode-then-crop, the ImageNet shape flow
+        # JPEG-compressible synthetic content (8x block upsample): pure
+        # noise would decode slower than any real photo; blocks land
+        # between noise and natural-image decode cost
+        small = rng.randint(0, 255, (n_src, src_size // 8, src_size // 8, 3))
+        src_imgs = np.kron(
+            small, np.ones((1, 8, 8, 1), np.uint8)
+        ).astype(np.uint8)[:, :src_size, :src_size]
+        rec = os.path.join(tempfile.mkdtemp(prefix="bench_jpeg_"), "rec")
+        make_jpeg_record_file(rec, src_imgs, rng.randint(
+            0, cfg.num_classes, n_src))
+        log(f"jpeg-fed: {n_src} records at {src_size}px -> decode+augment "
+            f"to {image}px inside the measured window")
+        ds = JpegClassificationDataset(rec, image, global_batch, train=True)
+
+        def host_stream():
+            i = 0
+            while True:
+                b = ds.batch(i)
+                b["image"] = b["image"].astype(img_dtype)
+                yield b
+                i += 1
+
+        # shardings only need shapes/dtypes — don't pay a decode here
+        probe = {
+            "image": np.zeros((global_batch, image, image, 3), img_dtype),
+            "label": np.zeros((global_batch,), np.int32),
+        }
+    else:
+        host_batches = []
+        for k in range(4):
+            host_batches.append({
+                "image": rng.randn(global_batch, image, image, 3)
+                .astype(np.float32).astype(img_dtype),
+                "label": rng.randint(0, cfg.num_classes, global_batch)
+                .astype(np.int32),
+            })
+
+        def host_stream():
+            i = 0
+            while True:
+                yield host_batches[i % len(host_batches)]
+                i += 1
+
+        probe = host_batches[0]
 
     shardings = jax.tree.map(
         lambda x: NamedSharding(mesh, sh.batch_spec(np.ndim(x))),
-        host_batches[0],
+        probe,
     )
     put = lambda b: jax.tree.map(jax.device_put, b, shardings)
     fed = iter(Prefetcher(host_stream(), depth=2, transform=put))
@@ -189,6 +233,7 @@ def main() -> None:
         "pipeline_fed_images_per_sec_per_chip":
             round(fed_images_per_sec_per_chip, 2),
         "pipeline_efficiency": round(pipeline_efficiency, 4),
+        "fed_data": fed_data,
     }))
 
 

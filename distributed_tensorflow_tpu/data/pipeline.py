@@ -138,7 +138,10 @@ class NpzDataset:
 
 
 def make_dataset(cfg: DataConfig, num_batches: int | None = None,
-                 index_offset: int = 0) -> Iterable:
+                 index_offset: int = 0, train: bool = True) -> Iterable:
+    """``train=False`` turns off stochastic augmentation (records:) and
+    switches the jpeg: path to the deterministic resize+center-crop eval
+    decode — the workloads' eval_dataset_fn contract."""
     if cfg.dataset == "synthetic":
         return SyntheticClassification(cfg, num_batches, index_offset)
     if cfg.dataset.startswith("npz:"):
@@ -152,17 +155,17 @@ def make_dataset(cfg: DataConfig, num_batches: int | None = None,
             (cfg.image_size, cfg.image_size, cfg.channels),
             cfg.global_batch_size, seed=cfg.seed,
             num_batches=num_batches, index_offset=index_offset,
-            flat=cfg.flat, augment=cfg.augment,
+            flat=cfg.flat, augment=cfg.augment if train else "none",
         )
     if cfg.dataset.startswith("jpeg:"):
         from .jpeg_records import JpegClassificationDataset
 
-        # Train-mode stream (shuffled, random-resized-crop). Eval callers
-        # construct JpegClassificationDataset(train=False) directly on a
-        # held-out record pair.
+        # train: shuffled epoch order + random-resized-crop/hflip;
+        # eval: in-order, resize + center crop. Point eval at a held-out
+        # record pair via the config override (--data.dataset=jpeg:...).
         return JpegClassificationDataset(
             cfg.dataset[len("jpeg:"):], cfg.image_size,
-            cfg.global_batch_size, seed=cfg.seed,
+            cfg.global_batch_size, seed=cfg.seed, train=train,
             num_batches=num_batches, index_offset=index_offset,
         )
     raise ValueError(f"Unknown dataset '{cfg.dataset}'")

@@ -87,6 +87,7 @@ def pipeline_apply(
     aux_mb: Any = None,
     n_virtual: int = 1,
     param_specs: Any = None,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
     """Run ``x_mb`` through the S-stage (optionally interleaved) pipeline.
 
@@ -113,6 +114,19 @@ def pipeline_apply(
         (models/transformer.pipeline_param_specs(tp=True)); stage_fn is
         then responsible for the matching manual collectives (Block's
         tp_shards psums). Specs must keep 'pipe' on the leading dim.
+    rng: optional PRNG key enabling STOCHASTIC stage fns (dropout in
+        pipelined training — VERDICT r2 item 7). When given, stage_fn is
+        called with two extra trailing args ``(mb_key, chunk_idx)``:
+        ``mb_key = fold_in(rng, m)`` is unique per microbatch and
+        ``chunk_idx = v·S + stage`` identifies the chunk, so the stage fn
+        can derive a key per (microbatch, layer) that is INDEPENDENT of
+        the schedule — fold the global layer index ``chunk_idx ·
+        layers_per_chunk + local_idx`` into ``mb_key`` and the same key
+        tree falls out for any (S, V) decomposition (asserted by
+        tests/test_pipeline.py dropout-parity). Keys are replayed
+        identically in the backward (jax.checkpoint re-runs the forward
+        with the same folded values), so dropout masks are consistent
+        across fwd/bwd by construction.
     """
     n_stages = mesh.shape[mesh_lib.PIPE]
     M = x_mb.shape[0]
@@ -154,19 +168,34 @@ def pipeline_apply(
                 "hit unbound axis names — use the GSPMD path instead"
             )
         # degenerate: no pipe axis — scan this device's chunks in order
+        # (S=1, so chunk index c = v, matching the pipelined c = v·S+d)
         sq = jax.tree.map(lambda p: p.reshape(-1, *p.shape[2:]), stage_params)
+        n_chunks = jax.tree.leaves(sq)[0].shape[0]
 
-        def through_chunks(x, aux=None):
-            def chunk(x, p):
-                return (stage_fn(p, x) if aux is None
-                        else stage_fn(p, x, aux)), None
+        def through_chunks(x, aux=None, mb_key=None):
+            def chunk(x, pc):
+                p, c = pc
+                args = [p, x] + ([] if aux is None else [aux])
+                if mb_key is not None:
+                    args += [mb_key, c]
+                return stage_fn(*args), None
 
-            y, _ = jax.lax.scan(chunk, x, sq)
+            y, _ = jax.lax.scan(chunk, x, (sq, jnp.arange(n_chunks)))
             return y
 
+        mb_keys = (
+            None if rng is None
+            else jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+                jnp.arange(M))
+        )
+        if aux_mb is None and mb_keys is None:
+            return jax.vmap(lambda x: through_chunks(x))(x_mb)
+        if mb_keys is None:
+            return jax.vmap(lambda x, a: through_chunks(x, a))(x_mb, aux_mb)
         if aux_mb is None:
-            return jax.vmap(through_chunks)(x_mb)
-        return jax.vmap(through_chunks)(x_mb, aux_mb)
+            return jax.vmap(lambda x, k: through_chunks(x, None, k))(
+                x_mb, mb_keys)
+        return jax.vmap(through_chunks)(x_mb, aux_mb, mb_keys)
     if M < n_stages:
         raise ValueError(
             f"need at least as many microbatches ({M}) as stages "
@@ -202,13 +231,13 @@ def pipeline_apply(
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(param_specs, x_spec, aux_specs),
+        in_specs=(param_specs, x_spec, aux_specs, P()),
         out_specs=x_spec,
         check_vma=False,
-    )(stage_params, x_mb, aux_mb)
+    )(stage_params, x_mb, aux_mb, rng)
 
 
-def _pipeline_body(stage_fn, stage_params, x_mb, aux_mb, *, n_stages,
+def _pipeline_body(stage_fn, stage_params, x_mb, aux_mb, rng, *, n_stages,
                    n_microbatches, n_virtual):
     """Per-device schedule; runs inside shard_map. stage_params leaves are
     [1, V, ...] local slices; x_mb is [M, mb_local, ...].
@@ -242,16 +271,19 @@ def _pipeline_body(stage_fn, stage_params, x_mb, aux_mb, *, n_stages,
         # device 0 injects a fresh microbatch whenever it starts chunk 0
         x_t = jax.lax.dynamic_index_in_dim(x_mb, m, 0, keepdims=False)
         inp = jnp.where((stage == 0) & (v == 0) & active, x_t, buf)
-        if aux_mb is None:
-            y = fn(params_v, inp)
-        else:
-            aux_t = jax.tree.map(
+        args = [params_v, inp]
+        if aux_mb is not None:
+            args.append(jax.tree.map(
                 lambda a: jax.lax.dynamic_index_in_dim(
                     a, m, 0, keepdims=False
                 ),
                 aux_mb,
-            )
-            y = fn(params_v, inp, aux_t)
+            ))
+        if rng is not None:
+            # (mb_key, chunk): schedule-independent RNG identity — see
+            # the pipeline_apply docstring
+            args += [jax.random.fold_in(rng, m), v * S + stage]
+        y = fn(*args)
         # the last device finishing the last chunk holds microbatch m's
         # final output; collect it (only stage S-1's buffer survives the
         # masked psum below, so garbage writes on other ranks are inert)

@@ -50,7 +50,8 @@ def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
         loss_fn=common.classification_loss_fn(model, label_smoothing=0.1),
         eval_fn=common.classification_eval_fn(model),
         dataset_fn=lambda start: make_dataset(cfg.data, index_offset=start),
-        eval_dataset_fn=lambda n: make_dataset(cfg.data, n, index_offset=10**6),
+        eval_dataset_fn=lambda n: make_dataset(
+            cfg.data, n, index_offset=10**6, train=False),
         flops_per_step=flops_per_example(cfg.model, cfg.data.image_size)
         * cfg.data.global_batch_size,
         batch_size=cfg.data.global_batch_size,

@@ -410,3 +410,101 @@ def test_pipeline_apply_rejects_param_specs_on_degenerate_mesh(devices):
                        param_specs=jax.tree.map(
                            lambda _: P("pipe"), params,
                        ))
+
+
+def test_pipelined_dropout_schedule_independent(devices):
+    """Dropout through the pipeline (VERDICT r2 item 7): the per-
+    (microbatch, global-layer, batch-shard) key derivation must be
+    independent of the S>1 (S, V) schedule decomposition — pipe=2/V=1,
+    pipe=2/V=2 and pipe=4/V=1 draw the SAME masks at a fixed batch
+    sharding — and must actually drop (differs from train=False)."""
+    cfg = _tiny_cfg(dropout=0.5)
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 16)(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 16)), jnp.int32)
+    key = jax.random.PRNGKey(7)
+
+    outs = []
+    for spec, n_devs, n_stages, n_virtual in (
+        (MeshSpec(pipe=2, data=2), 4, 2, 1),
+        (MeshSpec(pipe=2, data=2), 4, 2, 2),
+        (MeshSpec(pipe=4, data=2), 8, 4, 1),
+    ):
+        mesh = build_mesh(spec, devices[:n_devs])
+        pp = tfm.to_pipeline_params(params, cfg, n_stages=n_stages,
+                                    n_virtual=n_virtual)
+        outs.append(jax.jit(
+            lambda p, i, k, mesh=mesh, nv=n_virtual: tfm.pipelined_apply(
+                p, i, None, cfg, mesh, n_microbatches=4, n_virtual=nv,
+                train=True, rng=k,
+            )
+        )(pp, ids, key))
+
+    np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(outs[1]),
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(outs[2]),
+                               atol=2e-4)
+
+    mesh = build_mesh(MeshSpec(pipe=2, data=2), devices[:4])
+    pp = tfm.to_pipeline_params(params, cfg, n_stages=2)
+    eval_out = jax.jit(
+        lambda p, i: tfm.pipelined_apply(p, i, None, cfg, mesh,
+                                         n_microbatches=4)
+    )(pp, ids)
+    assert not np.allclose(np.asarray(outs[0]), np.asarray(eval_out)), (
+        "dropout had no effect")
+    # a different key draws different masks (keys really reach the blocks)
+    other = jax.jit(
+        lambda p, i, k: tfm.pipelined_apply(
+            p, i, None, cfg, mesh, n_microbatches=4, train=True, rng=k)
+    )(pp, ids, jax.random.PRNGKey(8))
+    assert not np.allclose(np.asarray(outs[0]), np.asarray(other))
+    # pipe=1 degenerate: a different (global-shape) stream, but dropout
+    # is active, deterministic, and decorrelated across layers/keys
+    mesh1 = build_mesh(MeshSpec(data=2), devices[:2])
+    pp1 = tfm.to_pipeline_params(params, cfg, n_stages=1)
+    f1 = jax.jit(lambda p, i, k: tfm.pipelined_apply(
+        p, i, None, cfg, mesh1, n_microbatches=4, train=True, rng=k))
+    a, b = f1(pp1, ids, key), f1(pp1, ids, key)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.allclose(np.asarray(a), np.asarray(
+        f1(pp1, ids, jax.random.PRNGKey(8))))
+
+
+def test_pipelined_dropout_trains_and_grads_flow(devices):
+    """Grad through the stochastic schedule: masks replay identically in
+    the backward (jax.checkpoint) and the train engine runs."""
+    import optax
+
+    from distributed_tensorflow_tpu.train import (
+        StepOptions, init_train_state, jit_train_step, make_train_step,
+    )
+
+    cfg = _tiny_cfg(dropout=0.1)
+    mesh = build_mesh(MeshSpec(pipe=2, data=2), devices[:4])
+    init_fn = tfm.make_pipelined_init_fn(cfg, n_stages=2, seq_len=16)
+    state, specs = init_train_state(
+        init_fn, optax.adam(3e-3), mesh, jax.random.PRNGKey(0),
+        param_specs=tfm.pipeline_param_specs(
+            jax.eval_shape(init_fn, jax.random.PRNGKey(0))[0]
+        ),
+    )
+    step = jit_train_step(
+        make_train_step(tfm.pipelined_lm_loss_fn(cfg, mesh, 4),
+                        optax.adam(3e-3),
+                        StepOptions(check_grads_finite=True)),
+        mesh, specs,
+    )
+    rng = np.random.RandomState(0)
+    losses = []
+    for i in range(20):
+        start = rng.randint(0, cfg.vocab_size, (16, 1))
+        ids = (start + np.arange(16)[None]) % cfg.vocab_size
+        batch = {"input_ids": jax.device_put(
+            jnp.asarray(ids, jnp.int32),
+            NamedSharding(mesh, sh.batch_spec(2)))}
+        state, metrics = step(state, batch)
+        assert float(metrics["grads_finite"]) == 1.0
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.9, losses

@@ -38,7 +38,7 @@ run bench_fused 1200 python -u bench.py
 run bench_standard 1200 env BENCH_BLOCK_IMPL=standard python -u bench.py
 
 # 4. JPEG-decode-fed window (VERDICT item 2: decode inside a measured
-#    TPU window). No-op failure until BENCH_DATA lands in bench.py.
+#    TPU window, through the production JpegClassificationDataset path)
 run bench_jpeg 1500 env BENCH_DATA=jpeg python -u bench.py
 
 # 5. kernel microbench at bench shapes (fwd then grad)
