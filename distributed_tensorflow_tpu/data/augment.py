@@ -40,18 +40,17 @@ def random_crop_flip(
     return out
 
 
-def random_resized_crop(
-    image: np.ndarray, rng: np.random.RandomState, out_size: int,
+def sample_crop_rect(
+    h: int, w: int, rng: np.random.RandomState,
     *, scale: tuple[float, float] = (0.08, 1.0),
     ratio: tuple[float, float] = (3 / 4, 4 / 3), attempts: int = 10,
-) -> np.ndarray:
-    """ImageNet-style train augmentation for ONE [H, W, C] uint8 image:
-    sample an area/aspect crop (Inception recipe), resize to
-    out_size×out_size (PIL bilinear). Falls back to a center crop when no
-    sample fits."""
-    from PIL import Image
-
-    h, w = image.shape[:2]
+) -> tuple[int, int, int, int]:
+    """Sample the Inception-recipe area/aspect crop rect (y, x, ch, cw)
+    for an H×W image; center-square fallback when no sample fits. The
+    ONE definition of the crop policy — shared by the PIL path
+    (:func:`random_resized_crop`) and the native libjpeg decoder
+    (data/native_jpeg.py), so the two decoders draw identical rects from
+    identical rng states."""
     area = h * w
     for _ in range(attempts):
         target_area = area * rng.uniform(*scale)
@@ -62,10 +61,25 @@ def random_resized_crop(
         if 0 < cw <= w and 0 < ch <= h:
             y = rng.randint(0, h - ch + 1)
             x = rng.randint(0, w - cw + 1)
-            crop = image[y:y + ch, x:x + cw]
-            break
-    else:
-        crop = center_crop(image, min(h, w))
+            return y, x, ch, cw
+    side = min(h, w)
+    return max(0, (h - side) // 2), max(0, (w - side) // 2), side, side
+
+
+def random_resized_crop(
+    image: np.ndarray, rng: np.random.RandomState, out_size: int,
+    *, scale: tuple[float, float] = (0.08, 1.0),
+    ratio: tuple[float, float] = (3 / 4, 4 / 3), attempts: int = 10,
+) -> np.ndarray:
+    """ImageNet-style train augmentation for ONE [H, W, C] uint8 image:
+    sample an area/aspect crop (Inception recipe), resize to
+    out_size×out_size (PIL bilinear)."""
+    from PIL import Image
+
+    h, w = image.shape[:2]
+    y, x, ch, cw = sample_crop_rect(
+        h, w, rng, scale=scale, ratio=ratio, attempts=attempts)
+    crop = image[y:y + ch, x:x + cw]
     pil = Image.fromarray(crop)
     pil = pil.resize((out_size, out_size), Image.BILINEAR)
     return np.asarray(pil)

@@ -9,9 +9,13 @@ container is two flat files the host can mmap:
 
 Fixed 24-byte index entries make sharding/shuffling O(1) per record with
 no per-record framing in the data file (same design driver as the dense
-record loader, data/records.py). Decode (PIL) + random-resized-crop/flip
-augmentation run in a host thread pool and overlap device compute through
-the Prefetcher.
+record loader, data/records.py). Decode + random-resized-crop/flip
+augmentation run on the host and overlap device compute through the
+Prefetcher. Two decode tiers share one augmentation policy
+(augment.sample_crop_rect): the native C++ libjpeg stage
+(native/dtf_jpeg.cpp via data/native_jpeg.py — DCT-domain downscaled
+decode, threaded; the default when it builds) and a PIL thread pool
+fallback.
 """
 
 from __future__ import annotations
@@ -64,7 +68,7 @@ class JpegClassificationDataset:
     def __init__(self, path: str, image_size: int, global_batch_size: int,
                  *, seed: int = 0, train: bool = True,
                  num_batches: int | None = None, index_offset: int = 0,
-                 n_threads: int | None = None):
+                 n_threads: int | None = None, decoder: str = "auto"):
         import jax
 
         from .pipeline import local_batch_size
@@ -82,8 +86,32 @@ class JpegClassificationDataset:
         self._data = np.memmap(path + ".dat", np.uint8, "r")
         self._shard = jax.process_index()
         self._n_shards = jax.process_count()
-        self._pool = cf.ThreadPoolExecutor(
-            max_workers=n_threads or min(16, os.cpu_count() or 4)
+        self._n_threads = n_threads or min(16, os.cpu_count() or 4)
+        # decoder: "native" = C++ libjpeg stage (native/dtf_jpeg.cpp —
+        # DCT-downscaled decode + crop + bilinear, threaded); "pil" =
+        # Python/PIL in a thread pool; "auto" = native when the library
+        # builds (DTF_JPEG_DECODER env overrides). The two decoders draw
+        # IDENTICAL crop/flip decisions (augment.sample_crop_rect is the
+        # one policy definition) but resample with different filters, so
+        # pixels differ slightly; each is deterministic for resume.
+        decoder = os.environ.get("DTF_JPEG_DECODER", decoder)
+        if decoder not in ("auto", "pil", "native"):
+            raise ValueError(f"unknown decoder {decoder!r}")
+        if decoder == "auto":
+            from . import native_jpeg
+
+            decoder = "native" if native_jpeg.available() else "pil"
+        elif decoder == "native":
+            from . import native_jpeg
+
+            if not native_jpeg.available():
+                raise RuntimeError(
+                    "decoder='native' requested but native/dtf_jpeg.cpp "
+                    "did not build (g++ or libjpeg missing)")
+        self.decoder = decoder
+        self._pool = (
+            cf.ThreadPoolExecutor(max_workers=self._n_threads)
+            if decoder == "pil" else None
         )
 
     def _batches_per_epoch(self) -> int:
@@ -105,6 +133,39 @@ class JpegClassificationDataset:
             img = augment.resize_center_crop(img, self.image_size)
         return img
 
+    def _decode_batch_native(self, entries, seeds) -> np.ndarray:
+        """C++ decode stage: Python samples the SAME crop/flip decisions
+        as the PIL path (augment.sample_crop_rect / hflip draw order),
+        the native library executes decode+crop+resize."""
+        from . import native_jpeg
+
+        n = len(entries)
+        dims = native_jpeg.jpeg_dims(
+            self._data, entries["offset"], entries["length"])
+        rects = np.empty((n, 4), np.int64)
+        flips = np.zeros(n, bool)
+        for i in range(n):
+            h, w = int(dims[i, 0]), int(dims[i, 1])
+            if h == 0 or w == 0:  # unparsable; decode will zero-fill
+                rects[i] = (0, 0, 1, 1)
+                continue
+            if self.train:
+                rng = np.random.RandomState(seeds[i] & 0x7FFFFFFF)
+                rects[i] = augment.sample_crop_rect(h, w, rng)
+                flips[i] = rng.rand() < 0.5
+            else:
+                # resize_center_crop equivalence: centered square of
+                # side short*0.875, resized to image_size
+                side = max(1, int(round(min(h, w) * 0.875)))
+                rects[i] = ((h - side) // 2, (w - side) // 2, side, side)
+        out = native_jpeg.decode_crop_resize(
+            self._data, entries["offset"], entries["length"], rects,
+            self.image_size, self._n_threads,
+        )
+        if flips.any():
+            out[flips] = out[flips, :, ::-1]
+        return out
+
     def batch(self, index: int) -> dict[str, np.ndarray]:
         index += self.index_offset
         bpe = self._batches_per_epoch()
@@ -119,8 +180,11 @@ class JpegClassificationDataset:
         seeds = [
             (self.seed * 1_000_003 + index) * 131 + int(i) for i in idx
         ]
-        images = list(self._pool.map(self._decode_one, entries, seeds))
-        img = np.stack(images).astype(np.float32)
+        if self.decoder == "native":
+            img = self._decode_batch_native(entries, seeds).astype(np.float32)
+        else:
+            images = list(self._pool.map(self._decode_one, entries, seeds))
+            img = np.stack(images).astype(np.float32)
         img *= 1.0 / 255.0
         return {
             "image": img,

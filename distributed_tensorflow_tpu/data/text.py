@@ -30,6 +30,27 @@ class TextDataConfig:
     mask_prob: float = 0.15
     seed: int = 0
     mask_token: int = 103  # [MASK] in BERT vocab
+    # > 0: emit the gathered-head MLM format — exactly this many
+    # prediction positions per example as "masked_positions" [B,K] +
+    # "masked_labels" [B,K] (the reference's masked_lm_positions /
+    # max_predictions_per_seq shape) instead of dense [B,S] labels.
+    # The model then runs its MLM head + vocab projection on [B,K,d]
+    # (models/transformer.Transformer positions docstring). 0 keeps the
+    # dense-labels format; -1 = auto: round(mask_prob * seq_len).
+    max_predictions: int = 0
+
+
+def resolved_max_predictions(cfg: TextDataConfig) -> int:
+    """0 = dense labels; -1 = auto (round(mask_prob * seq_len)); else the
+    explicit count. Single definition shared by the dataset and the
+    workloads' FLOPs accounting."""
+    if cfg.max_predictions == 0:
+        return 0
+    K = (max(1, int(round(cfg.mask_prob * cfg.seq_len)))
+         if cfg.max_predictions < 0 else cfg.max_predictions)
+    if K > cfg.seq_len:
+        raise ValueError(f"max_predictions={K} > seq_len={cfg.seq_len}")
+    return K
 
 
 class SyntheticMLM:
@@ -65,8 +86,18 @@ class SyntheticMLM:
         rng = batch_rng(cfg.seed, index)
         tokens = self._tokens(rng)
 
-        masked = rng.rand(*tokens.shape) < cfg.mask_prob
-        labels = np.where(masked, tokens, IGNORE_INDEX)
+        K = resolved_max_predictions(cfg)
+        if K > 0:
+            # gathered-head format: exactly K positions per example,
+            # sampled without replacement (argsort of uniform noise)
+            positions = np.argsort(
+                rng.rand(*tokens.shape), axis=1
+            )[:, :K].astype(np.int32)
+            positions.sort(axis=1)
+            masked = np.zeros(tokens.shape, bool)
+            np.put_along_axis(masked, positions, True, axis=1)
+        else:
+            masked = rng.rand(*tokens.shape) < cfg.mask_prob
         u = rng.rand(*tokens.shape)
         inputs = tokens.copy()
         # 80% -> [MASK], 10% -> random token, 10% -> keep
@@ -75,6 +106,14 @@ class SyntheticMLM:
         inputs[masked & (u >= 0.8) & (u < 0.9)] = rand_tok[
             masked & (u >= 0.8) & (u < 0.9)
         ]
+        if K > 0:
+            return {
+                "input_ids": inputs.astype(np.int32),
+                "masked_positions": positions,
+                "masked_labels": np.take_along_axis(
+                    tokens, positions, axis=1).astype(np.int32),
+            }
+        labels = np.where(masked, tokens, IGNORE_INDEX)
         return {
             "input_ids": inputs.astype(np.int32),
             "labels": labels.astype(np.int32),

@@ -379,13 +379,24 @@ class Block(nn.Module):
 class Transformer(nn.Module):
     """Token-in, logits-out transformer. ``input_ids`` [B,S] int32;
     ``attention_mask`` [B,S] (1 = real token) or None. Returns [B,S,vocab]
-    logits (f32) from the tied embedding head."""
+    logits (f32) from the tied embedding head.
+
+    ``positions`` [B,K] (MLM only): gather the K prediction positions
+    AFTER the block stack and run the MLM head + vocab projection on
+    [B,K,d] instead of [B,S,d] — the standard BERT masked-position
+    optimization (the reference fed `masked_lm_positions` the same way).
+    At seq 512 / K=76 this cuts the head+logits term ~6.7x; the [B,S,V]
+    logits tensor (16 GiB f32 at batch 256, vocab 30K) was the dominant
+    memory term in the pipelined BERT step (tools/pipeline_memory_
+    analysis.py), not the schedule. Returns [B,K,vocab] logits.
+    """
 
     cfg: TransformerConfig
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, input_ids, attention_mask=None, *, train: bool = False):
+    def __call__(self, input_ids, attention_mask=None, *,
+                 train: bool = False, positions=None):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         B, S = input_ids.shape
@@ -418,6 +429,15 @@ class Transformer(nn.Module):
         if cfg.pre_ln:
             x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x).astype(dtype)
 
+        if positions is not None:
+            if cfg.causal:
+                raise ValueError(
+                    "positions gather is the MLM head path; causal LMs "
+                    "predict every position"
+                )
+            x = jnp.take_along_axis(
+                x, positions[..., None].astype(jnp.int32), axis=1
+            )  # [B, K, d]
         if not cfg.causal:
             # BERT MLM transform head before the tied projection
             x = nn.Dense(cfg.d_model, dtype=dtype, name="mlm_transform",
@@ -561,10 +581,14 @@ def pipelined_apply(
     n_virtual: int = 1,
     train: bool = False,
     rng: jax.Array | None = None,
+    positions: jax.Array | None = None,
 ) -> jax.Array:
     """input_ids [B,S] -> logits [B,S,vocab] (f32, pipe-replicated), same
     math as ``Transformer.apply(...)`` with blocks run through the
-    parallel/pipeline.py microbatch schedule.
+    parallel/pipeline.py microbatch schedule. With ``positions`` [B,K]
+    (MLM gathered head, see ``Transformer.__call__``), the head runs on
+    the gathered positions OUTSIDE the pipeline island and the return is
+    [B,K,vocab].
 
     ``train=True`` with ``rng`` enables dropout (training-semantics parity
     with the dense path, VERDICT r2 item 7): each layer's mask key is
@@ -683,6 +707,18 @@ def pipelined_apply(
         y = nn.LayerNorm(dtype=jnp.float32).apply(
             {"params": ends["final_ln"]}, y
         ).astype(dtype)
+    if positions is not None:
+        if cfg.causal:
+            raise ValueError(
+                "positions gather is the MLM head path; causal LMs "
+                "predict every position"
+            )
+        # MLM gathered head (see Transformer.__call__): head + vocab
+        # projection on [B,K,d]; runs outside the pipeline island, so the
+        # pipelined path gets the same memory/FLOPs win
+        y = jnp.take_along_axis(
+            y, positions[..., None].astype(jnp.int32), axis=1
+        )
     if not cfg.causal:
         y = nn.Dense(cfg.d_model, dtype=dtype).apply(
             {"params": ends["mlm_transform"]}, y
@@ -736,11 +772,13 @@ def pipelined_mlm_loss_fn(cfg: TransformerConfig, mesh: Any,
     Dropout active per cfg.dropout (see pipelined_lm_loss_fn)."""
 
     def loss_fn(params, model_state, batch, rng):
+        positions, labels = _mlm_targets(batch)
         logits = pipelined_apply(
             params, batch["input_ids"], batch.get("attention_mask"), cfg,
             mesh, n_microbatches, n_virtual, train=True, rng=rng,
+            positions=positions,
         )
-        loss, acc = _masked_xent(logits, batch["labels"])
+        loss, acc = _masked_xent(logits, labels)
         return loss, (model_state, {"accuracy": acc})
 
     return loss_fn
@@ -786,6 +824,17 @@ def _shifted_lm_labels(ids, attention_mask=None):
     return labels
 
 
+def _mlm_targets(batch):
+    """(positions, labels) for the MLM head: the gathered-head batch
+    format {"masked_positions" [B,K], "masked_labels" [B,K]} when the
+    pipeline provides it (TextDataConfig.max_predictions > 0 — the
+    reference's masked_lm_positions format), else the dense [B,S]
+    labels with IGNORE_INDEX on unmasked positions."""
+    if "masked_positions" in batch:
+        return batch["masked_positions"], batch["masked_labels"]
+    return None, batch["labels"]
+
+
 def transformer_eval_fn(model: Transformer, *, mlm: bool):
     """Summed-stats eval, MLM or next-token (reference analog: the eval
     loop over latest_checkpoint, SURVEY.md §3.5). Same ``mlm`` switch as
@@ -793,12 +842,14 @@ def transformer_eval_fn(model: Transformer, *, mlm: bool):
 
     def eval_fn(params, model_state, batch):
         ids = batch["input_ids"]
+        positions, labels = (
+            _mlm_targets(batch) if mlm
+            else (None, _shifted_lm_labels(ids, batch.get("attention_mask")))
+        )
         logits, _ = model.apply(
             {"params": params}, ids, batch.get("attention_mask"),
-            train=False, mutable=["losses"],
+            train=False, mutable=["losses"], positions=positions,
         )
-        labels = (batch["labels"] if mlm
-                  else _shifted_lm_labels(ids, batch.get("attention_mask")))
         return _xent_eval_stats(logits, labels)
 
     return eval_fn
@@ -820,12 +871,14 @@ def pipelined_eval_fn(cfg: TransformerConfig, mesh: Any,
 
     def eval_fn(params, model_state, batch):
         ids = batch["input_ids"]
+        positions, labels = (
+            _mlm_targets(batch) if mlm
+            else (None, _shifted_lm_labels(ids, batch.get("attention_mask")))
+        )
         logits = pipelined_apply(
             params, ids, batch.get("attention_mask"), cfg, mesh,
-            n_microbatches, n_virtual,
+            n_microbatches, n_virtual, positions=positions,
         )
-        labels = (batch["labels"] if mlm
-                  else _shifted_lm_labels(ids, batch.get("attention_mask")))
         return _xent_eval_stats(logits, labels)
 
     return eval_fn
@@ -845,12 +898,13 @@ def mlm_loss_fn(model: Transformer):
     IGNORE_INDEX on unmasked positions, optional "attention_mask" [B,S]}."""
 
     def loss_fn(params, model_state, batch, rng):
+        positions, labels = _mlm_targets(batch)
         logits, mut = model.apply(
             {"params": params}, batch["input_ids"],
             batch.get("attention_mask"), train=True, rngs={"dropout": rng},
-            mutable=["losses"],
+            mutable=["losses"], positions=positions,
         )
-        loss, acc = _masked_xent(logits, batch["labels"])
+        loss, acc = _masked_xent(logits, labels)
         loss = loss + collect_aux_loss(mut)  # MoE router load-balance
         return loss, (model_state, {"accuracy": acc})
 
@@ -945,11 +999,23 @@ def active_param_count(cfg: TransformerConfig) -> int:
     return param_count(cfg) - n_moe * idle_experts * (2 * d * f + f + d)
 
 
-def flops_per_example(cfg: TransformerConfig, seq_len: int) -> float:
+def flops_per_example(cfg: TransformerConfig, seq_len: int,
+                      n_predictions: int | None = None) -> float:
     """Forward FLOPs per example at ``seq_len`` (×3 for training in the
     engine's MFU accounting, utils/flops.py train_flops_multiplier).
     Uses *active* params so MoE MFU accounting stays honest (SURVEY.md §7
-    'MFU accounting honesty')."""
-    return seq_len * flops_lib.transformer_flops_per_token(
+    'MFU accounting honesty').
+
+    ``n_predictions``: gathered MLM head (Transformer positions arg) —
+    the head (mlm_transform d×d + tied d×vocab projection) runs on K
+    positions instead of all seq_len; subtract the skipped positions'
+    share so declared FLOPs stay honest (tests/test_flops_contract.py).
+    """
+    base = seq_len * flops_lib.transformer_flops_per_token(
         active_param_count(cfg), seq_len, cfg.num_layers, cfg.d_model
     )
+    if n_predictions is not None and not cfg.causal:
+        per_pos_head = 2.0 * (cfg.vocab_size * cfg.d_model
+                              + cfg.d_model * cfg.d_model)
+        base -= (seq_len - n_predictions) * per_pos_head
+    return base

@@ -29,7 +29,11 @@ def transformer_parts(cfg: RunConfig, mesh, *, mlm: bool) -> WorkloadParts:
             f"data.vocab_size={cfg.data.vocab_size} != "
             f"model.vocab_size={mcfg.vocab_size}"
         )
-    fwd_flops = tfm.flops_per_example(mcfg, cfg.data.seq_len)
+    from ..data.text import resolved_max_predictions
+
+    n_pred = resolved_max_predictions(cfg.data) if mlm else 0
+    fwd_flops = tfm.flops_per_example(
+        mcfg, cfg.data.seq_len, n_predictions=n_pred or None)
     common = dict(
         dataset_fn=lambda start: make_text_dataset(
             cfg.data, index_offset=start

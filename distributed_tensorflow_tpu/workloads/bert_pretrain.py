@@ -28,6 +28,11 @@ def default_config() -> RunConfig:
         data=TextDataConfig(
             dataset="synthetic_mlm", global_batch_size=256,
             seq_len=model.max_len, vocab_size=model.vocab_size,
+            # gathered MLM head (masked_lm_positions format): head +
+            # vocab projection on ~77 predicted positions, not all 512 —
+            # the [B,S,vocab] logits tensor was the dominant memory term
+            # (tools/pipeline_memory_analysis.py)
+            max_predictions=-1,
         ),
         optimizer=OptimizerConfig(
             name="adamw", learning_rate=1e-4, weight_decay=0.01,

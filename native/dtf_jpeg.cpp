@@ -1,0 +1,221 @@
+// Native JPEG decode stage for the input pipeline (SURVEY.md §2a 'Input
+// pipeline'; the reference ran per-worker tf.data decode_jpeg C++ kernels
+// — this is the framework's equivalent hot loop).
+//
+// Built separately from dtf_runtime.cpp because it links -ljpeg (the
+// system libjpeg); runtime/native.py's core library keeps its
+// no-external-deps invariant and data/native_jpeg.py degrades to the PIL
+// path when this library can't build.
+//
+// C ABI (ctypes, see data/native_jpeg.py):
+//   dtf_jpeg_dims   — parse headers only: [h, w] per stream (cheap).
+//   dtf_jpeg_decode_crop_resize — per stream: decode (libjpeg, with the
+//       scale_denom fast path when the crop is much larger than the
+//       target), crop rect (y, x, ch, cw in FULL-RES coords), bilinear
+//       resize to out_size x out_size RGB u8.
+//
+// Crop POLICY (what rect, which flips) stays in Python
+// (data/augment.py sample_crop_rect) — this file only executes pixels,
+// so the augmentation recipe has exactly one definition.
+
+#include <cstddef>
+#include <cstdio>  // jpeglib.h needs size_t/FILE declared first
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+void on_error(j_common_ptr cinfo) {
+  ErrMgr* err = reinterpret_cast<ErrMgr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+// Decode one JPEG stream to RGB. Returns false on corrupt input. When
+// min(crop_h, crop_w) / out_size >= 2, asks libjpeg for a 1/2, 1/4 or
+// 1/8-scale decode (DCT-domain downscale — the big win over a
+// full-res decode + resize) and maps the crop rect into scaled coords.
+bool decode_rgb(const uint8_t* data, int64_t len, int denom,
+                std::vector<uint8_t>& pixels, int& h, int& w) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr err;
+  cinfo.err = jpeg_std_error(&err.pub);
+  err.pub.error_exit = on_error;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  // a corrupt/hostile header can declare up to 65500x65500 (~12.8 GB
+  // RGB) — cap the decoded frame so a bad stream is a zero-fill
+  // failure, not a bad_alloc that escapes the worker thread
+  constexpr uint64_t kMaxPixels = 128ull * 1024 * 1024;  // 128 MPix
+  if (static_cast<uint64_t>(cinfo.image_height) * cinfo.image_width >
+      kMaxPixels) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  cinfo.scale_num = 1;
+  cinfo.scale_denom = denom;
+  jpeg_start_decompress(&cinfo);
+  h = static_cast<int>(cinfo.output_height);
+  w = static_cast<int>(cinfo.output_width);
+  pixels.resize(static_cast<size_t>(h) * w * 3);
+  JSAMPROW row;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    row = pixels.data() + static_cast<size_t>(cinfo.output_scanline) * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Bilinear resize of an RGB crop (src coords) into out (S x S x 3).
+// align_corners=false convention (pixel centers), matching the usual
+// image-resampling grid; numerics differ from PIL's filtered resample
+// by design — each decoder is its own deterministic stream.
+void resize_bilinear(const uint8_t* src, int sh, int sw, int y0, int x0,
+                     int ch, int cw, int out_size, uint8_t* out) {
+  const float sy = static_cast<float>(ch) / out_size;
+  const float sx = static_cast<float>(cw) / out_size;
+  for (int oy = 0; oy < out_size; ++oy) {
+    float fy = (oy + 0.5f) * sy - 0.5f + y0;
+    fy = std::max(static_cast<float>(y0),
+                  std::min(fy, static_cast<float>(y0 + ch - 1)));
+    int iy = static_cast<int>(fy);
+    iy = std::min(iy, sh - 1);
+    int iy1 = std::min(iy + 1, std::min(y0 + ch - 1, sh - 1));
+    float wy = fy - iy;
+    for (int ox = 0; ox < out_size; ++ox) {
+      float fx = (ox + 0.5f) * sx - 0.5f + x0;
+      fx = std::max(static_cast<float>(x0),
+                    std::min(fx, static_cast<float>(x0 + cw - 1)));
+      int ix = static_cast<int>(fx);
+      ix = std::min(ix, sw - 1);
+      int ix1 = std::min(ix + 1, std::min(x0 + cw - 1, sw - 1));
+      float wx = fx - ix;
+      const uint8_t* p00 = src + (static_cast<size_t>(iy) * sw + ix) * 3;
+      const uint8_t* p01 = src + (static_cast<size_t>(iy) * sw + ix1) * 3;
+      const uint8_t* p10 = src + (static_cast<size_t>(iy1) * sw + ix) * 3;
+      const uint8_t* p11 = src + (static_cast<size_t>(iy1) * sw + ix1) * 3;
+      uint8_t* o = out + (static_cast<size_t>(oy) * out_size + ox) * 3;
+      for (int c = 0; c < 3; ++c) {
+        float v = (1 - wy) * ((1 - wx) * p00[c] + wx * p01[c]) +
+                  wy * ((1 - wx) * p10[c] + wx * p11[c]);
+        o[c] = static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Header-only pass: dims[2*i] = height, dims[2*i+1] = width. Returns the
+// number of unparsable streams (their dims are set to 0).
+int dtf_jpeg_dims(const uint8_t* data, const int64_t* offsets,
+                  const int64_t* lengths, int64_t n, int64_t* dims) {
+  int failures = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    jpeg_decompress_struct cinfo;
+    ErrMgr err;
+    cinfo.err = jpeg_std_error(&err.pub);
+    err.pub.error_exit = on_error;
+    if (setjmp(err.jump)) {
+      jpeg_destroy_decompress(&cinfo);
+      dims[2 * i] = dims[2 * i + 1] = 0;
+      ++failures;
+      continue;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data + offsets[i]),
+                 static_cast<unsigned long>(lengths[i]));
+    jpeg_read_header(&cinfo, TRUE);
+    dims[2 * i] = cinfo.image_height;
+    dims[2 * i + 1] = cinfo.image_width;
+    jpeg_destroy_decompress(&cinfo);
+  }
+  return failures;
+}
+
+// rects: int64 [n, 4] = (y, x, ch, cw) per image in FULL-RESOLUTION
+// coordinates. out: u8 [n, out_size, out_size, 3]. Returns the number of
+// failed streams (their output slots are zeroed).
+int dtf_jpeg_decode_crop_resize(const uint8_t* data, const int64_t* offsets,
+                                const int64_t* lengths, const int64_t* rects,
+                                int64_t n, int out_size, uint8_t* out,
+                                int n_threads) {
+  std::atomic<int> failures{0};
+  std::atomic<int64_t> next{0};
+  const size_t out_stride = static_cast<size_t>(out_size) * out_size * 3;
+
+  auto worker = [&]() {
+    std::vector<uint8_t> pixels;
+    for (;;) {
+      const int64_t i = next.fetch_add(1);
+      if (i >= n) return;
+      int64_t y = rects[4 * i], x = rects[4 * i + 1];
+      int64_t ch = rects[4 * i + 2], cw = rects[4 * i + 3];
+      // DCT-domain downscale: largest denom in {1,2,4,8} keeping the
+      // scaled crop at least out_size on its short side
+      int denom = 1;
+      while (denom < 8 &&
+             std::min(ch, cw) / (denom * 2) >= static_cast<int64_t>(out_size))
+        denom *= 2;
+      int h = 0, w = 0;
+      bool ok;
+      try {
+        ok = decode_rgb(data + offsets[i], lengths[i], denom, pixels, h, w);
+      } catch (...) {  // bad_alloc etc. must not escape the thread
+        ok = false;
+      }
+      if (!ok) {
+        std::memset(out + i * out_stride, 0, out_stride);
+        ++failures;
+        continue;
+      }
+      // map the rect into scaled coords (libjpeg rounds output dims UP:
+      // out = ceil(full / denom)), clamping to the decoded frame
+      int64_t sy = y / denom, sx = x / denom;
+      int64_t sch = std::max<int64_t>(1, ch / denom);
+      int64_t scw = std::max<int64_t>(1, cw / denom);
+      sy = std::min<int64_t>(sy, h - 1);
+      sx = std::min<int64_t>(sx, w - 1);
+      sch = std::min<int64_t>(sch, h - sy);
+      scw = std::min<int64_t>(scw, w - sx);
+      resize_bilinear(pixels.data(), h, w, static_cast<int>(sy),
+                      static_cast<int>(sx), static_cast<int>(sch),
+                      static_cast<int>(scw), out_size,
+                      out + i * out_stride);
+    }
+  };
+
+  const int nt = std::max(1, std::min<int>(n_threads, n));
+  std::vector<std::thread> threads;
+  threads.reserve(nt - 1);
+  for (int t = 1; t < nt; ++t) threads.emplace_back(worker);
+  worker();
+  for (auto& t : threads) t.join();
+  return failures.load();
+}
+
+}  // extern "C"

@@ -130,19 +130,33 @@ def test_decode_throughput_host_only(tmp_path):
     n = 128
     imgs = _images(n, h=256, w=256, seed=1)
     make_jpeg_record_file(path, imgs, np.zeros(n, np.int64))
-    ds = JpegClassificationDataset(path, 224, 64, train=True)
-    ds.batch(0)  # warm the pool + caches
-    t0 = time.perf_counter()
-    for i in range(1, 5):
-        ds.batch(i)
-    dt = time.perf_counter() - t0
-    rate = 4 * 64 / dt
-    print(f"decode+augment throughput: {rate:.0f} images/sec "
-          f"({ds._pool._max_workers} threads, {os.cpu_count()} cores)")
-    assert rate > 100, rate  # an order under the single-core measurement
+
+    def rate_of(decoder):
+        try:
+            ds = JpegClassificationDataset(path, 224, 64, train=True,
+                                           decoder=decoder)
+        except RuntimeError:  # native lib unavailable on this host
+            return None
+        ds.batch(0)  # warm the pool + caches
+        t0 = time.perf_counter()
+        for i in range(1, 5):
+            ds.batch(i)
+        return 4 * 64 / (time.perf_counter() - t0)
+
+    rate = rate_of("pil")
+    native_rate = rate_of("native")
+    print(f"decode+augment: pil {rate:.0f} img/s, native "
+          f"{native_rate and round(native_rate)} img/s "
+          f"({os.cpu_count()} cores)")
+    # well under the idle single-core measurement (~500 img/s) — the CI
+    # box may be sharing its core with concurrent jobs
+    assert rate > 60, rate
+    if native_rate is not None:
+        # the C++ stage must not be slower than PIL (measured ~1.9x)
+        assert native_rate > rate * 0.9, (native_rate, rate)
     if (os.cpu_count() or 1) >= 4:
         ds1 = JpegClassificationDataset(path, 224, 64, train=True,
-                                        n_threads=1)
+                                        n_threads=1, decoder="pil")
         ds1.batch(0)
         t0 = time.perf_counter()
         ds1.batch(1)
@@ -228,3 +242,54 @@ def test_converter_limit_without_shuffle_keeps_all_classes(tmp_path):
     entries = np.fromfile(out + ".idx", _ENTRY)
     assert sorted(entries["label"].tolist()) == [0, 1, 2]
     assert len(json.load(open(out + ".classes.json"))) == 3
+
+
+def test_native_decoder_matches_pil_policy(tmp_path):
+    """Native (C++/libjpeg) and PIL decoders draw IDENTICAL crop/flip
+    decisions (augment.sample_crop_rect is the single policy definition)
+    and resample within a small tolerance; both are deterministic."""
+    from distributed_tensorflow_tpu.data import native_jpeg
+
+    if not native_jpeg.available():
+        pytest.skip("native jpeg library unavailable (no g++/libjpeg)")
+
+    path = str(tmp_path / "rec")
+    imgs = _images(16, h=64, w=56)
+    make_jpeg_record_file(path, imgs, np.arange(16) % 4)
+
+    for train in (False, True):
+        dn = JpegClassificationDataset(path, 32, 8, train=train,
+                                       decoder="native")
+        dp = JpegClassificationDataset(path, 32, 8, train=train,
+                                       decoder="pil")
+        bn, bp = dn.batch(0), dp.batch(0)
+        np.testing.assert_array_equal(bn["label"], bp["label"])
+        # same crops/flips, different resampling filter: close, not equal
+        assert np.abs(bn["image"] - bp["image"]).max() < 0.08, train
+        np.testing.assert_array_equal(
+            dn.batch(1)["image"], dn.batch(1)["image"])
+
+    with pytest.raises(ValueError, match="decoder"):
+        JpegClassificationDataset(path, 32, 8, decoder="webp")
+
+
+def test_native_decoder_zero_fills_corrupt_stream(tmp_path):
+    from distributed_tensorflow_tpu.data import native_jpeg
+
+    if not native_jpeg.available():
+        pytest.skip("native jpeg library unavailable")
+
+    path = str(tmp_path / "rec")
+    imgs = _images(8, h=40, w=40)
+    make_jpeg_record_file(path, imgs, np.arange(8))
+    # truncate record 3's stream in the index (simulates corruption)
+    from distributed_tensorflow_tpu.data.jpeg_records import _ENTRY
+
+    entries = np.fromfile(path + ".idx", _ENTRY)
+    entries[3]["length"] = 10
+    entries.tofile(path + ".idx")
+    ds = JpegClassificationDataset(path, 32, 8, train=False,
+                                   decoder="native")
+    b = ds.batch(0)
+    assert b["image"][3].max() == 0.0  # zero-filled, not crashed
+    assert b["image"][0].max() > 0.0

@@ -368,3 +368,61 @@ def test_bert_pipelined_checkpoint_eval_roundtrip(tmp_path):
     for k in ("loss", "accuracy", "count"):
         assert abs(offline[k] - live.eval_metrics[k]) < 1e-6, (
             k, offline, live.eval_metrics)
+
+
+def test_mlm_gathered_head_matches_dense_slice():
+    """Transformer(positions=...) must equal the full-seq logits gathered
+    at those positions — the head math is identical, only the gather
+    moves before the head (the reference's masked_lm_positions path)."""
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, max_len=16, num_layers=2, d_model=32, num_heads=4,
+        d_ff=64, causal=False, pre_ln=False, dtype="float32", dropout=0.0,
+    )
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 16)(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 64, (4, 16)), jnp.int32)
+    pos = jnp.asarray(
+        np.sort(np.argsort(rng.rand(4, 16), axis=1)[:, :5], axis=1),
+        jnp.int32,
+    )
+    full = model.apply({"params": params}, ids, train=False)
+    gathered = model.apply({"params": params}, ids, train=False,
+                           positions=pos)
+    want = jnp.take_along_axis(full, pos[..., None], axis=1)
+    np.testing.assert_allclose(np.asarray(gathered), np.asarray(want),
+                               atol=1e-5)
+    # causal LMs reject the MLM-head path
+    ccfg = dataclasses.replace(cfg, causal=True, pre_ln=True)
+    cmodel = tfm.Transformer(ccfg)
+    cparams, _ = tfm.make_init_fn(cmodel, 16)(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="causal"):
+        cmodel.apply({"params": cparams}, ids, train=False, positions=pos)
+
+
+def test_synthetic_mlm_gathered_format():
+    """max_predictions emits exactly-K positions/labels consistent with
+    the corrupted input_ids (labels = original tokens at positions)."""
+    from distributed_tensorflow_tpu.data.text import (
+        TextDataConfig, resolved_max_predictions, make_text_dataset,
+    )
+
+    cfg = TextDataConfig(dataset="synthetic_mlm", global_batch_size=8,
+                         seq_len=32, vocab_size=128, max_predictions=-1)
+    K = resolved_max_predictions(cfg)
+    assert K == round(0.15 * 32)
+    b = next(iter(make_text_dataset(cfg, num_batches=1)))
+    assert b["masked_positions"].shape == (8, K)
+    assert b["masked_labels"].shape == (8, K)
+    assert "labels" not in b
+    # positions strictly increasing per row (sorted, no duplicates)
+    assert (np.diff(b["masked_positions"], axis=1) > 0).all()
+    # at keep-corruption positions the label equals the input token;
+    # everywhere the label is a valid vocab id
+    assert ((0 <= b["masked_labels"]) & (b["masked_labels"] < 128)).all()
+    # explicit K wins; K > seq_len rejected
+    cfg2 = dataclasses.replace(cfg, max_predictions=7)
+    assert resolved_max_predictions(cfg2) == 7
+    with pytest.raises(ValueError, match="max_predictions"):
+        resolved_max_predictions(
+            dataclasses.replace(cfg, max_predictions=64))

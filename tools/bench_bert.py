@@ -112,9 +112,27 @@ def main() -> None:
     ids = rng.randint(0, cfg.vocab_size, (global_batch, seq)).astype(np.int32)
     batch = {"input_ids": ids}
     if which == "bert":
-        batch["labels"] = np.where(
-            rng.rand(global_batch, seq) < 0.15, ids, IGNORE_INDEX
-        ).astype(np.int32)
+        if os.environ.get("BENCH_MLM_DENSE") == "1":
+            # legacy dense-labels head: vocab projection on all seq
+            # positions (the pre-gather behavior, kept for ablation)
+            batch["labels"] = np.where(
+                rng.rand(global_batch, seq) < 0.15, ids, IGNORE_INDEX
+            ).astype(np.int32)
+        else:
+            # gathered MLM head — the bert_pretrain workload default;
+            # K from the ONE definition of the auto rule
+            from distributed_tensorflow_tpu.data.text import (
+                TextDataConfig, resolved_max_predictions,
+            )
+
+            K = resolved_max_predictions(
+                TextDataConfig(seq_len=seq, max_predictions=-1))
+            pos = np.sort(
+                np.argsort(rng.rand(global_batch, seq), axis=1)[:, :K],
+                axis=1,
+            ).astype(np.int32)
+            batch["masked_positions"] = pos
+            batch["masked_labels"] = np.take_along_axis(ids, pos, axis=1)
     batch = jax.tree.map(
         lambda x: jax.device_put(
             x, NamedSharding(mesh, sh.batch_spec(np.ndim(x)))
@@ -127,7 +145,10 @@ def main() -> None:
         step, state, lambda: batch, warmup=3, measured=measured, log=log,
     )
     examples_per_sec_per_chip = steps_per_sec * global_batch / n_chips
-    model_flops = (tfm.flops_per_example(cfg, seq) * global_batch
+    n_pred = (batch["masked_positions"].shape[1]
+              if "masked_positions" in batch else None)
+    model_flops = (tfm.flops_per_example(cfg, seq, n_predictions=n_pred)
+                   * global_batch
                    * flops_lib.train_flops_multiplier())
     peak = flops_lib.peak_flops_per_chip(devices[0])
     mfu = flops_lib.mfu(model_flops, steps_per_sec, n_chips, peak)

@@ -67,9 +67,19 @@ def analyze(S: int, V: int, M: int, *, batch: int, seq: int, cfg, data_ax=1):
         tx, StepOptions(),
     )
     jitted = jit_train_step(step, mesh, sspecs)
+    # gathered-head MLM format — the bert_pretrain default; K from the
+    # ONE definition of the auto rule (data/text.py)
+    from distributed_tensorflow_tpu.data.text import (
+        TextDataConfig, resolved_max_predictions,
+    )
+
+    K = resolved_max_predictions(
+        TextDataConfig(seq_len=seq, max_predictions=-1))
     batch_tree = {
         "input_ids": jnp.zeros((batch, seq), jnp.int32),
-        "labels": jnp.zeros((batch, seq), jnp.int32),
+        "masked_positions": jnp.tile(jnp.arange(K, dtype=jnp.int32),
+                                     (batch, 1)),
+        "masked_labels": jnp.zeros((batch, K), jnp.int32),
     }
     batch_tree = jax.tree.map(
         lambda x: jax.device_put(
@@ -118,8 +128,11 @@ def main() -> None:
         batch, seq = 32, 64
     else:
         cfg = tfm.bert_base()
+        # S*V must divide num_layers=12: V=2 pairs with S=2 only; V=3
+        # covers the deep-interleave point at both stage counts
         grid = [(S, V, M)
-                for S in (2, 4) for V in (1, 2) for M in (8, 16, 32)]
+                for S in (2, 4) for V in (1, 3) for M in (8, 16, 32)]
+        grid += [(2, 2, M) for M in (8, 16, 32)]
         batch, seq = 256, 512
 
     rows = []
