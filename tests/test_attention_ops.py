@@ -45,6 +45,7 @@ def test_blockwise_ragged_block_padding():
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_blockwise_grads_match_reference():
     q, k, v = make_qkv(jax.random.PRNGKey(2), B=1, H=2, S=128)
 

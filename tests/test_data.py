@@ -88,6 +88,7 @@ def test_prefetcher_early_stop_does_not_hang():
     it.close()  # generator close must not deadlock the worker
 
 
+@pytest.mark.slow
 def test_tfdata_adapter_host_stream():
     """tf.data -> host-batch contract: numpy dicts at the local batch
     size, resume via start_index (batch skip), deterministic shuffle, and

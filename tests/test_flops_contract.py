@@ -27,7 +27,13 @@ SHRINK = {
 }
 
 
-@pytest.mark.parametrize("name", sorted(SHRINK))
+SLOW_PARAMS = {"resnet50_imagenet", "bert_pretrain"}  # 70s+/27s shapes
+
+
+@pytest.mark.parametrize("name", [
+    pytest.param(n, marks=[pytest.mark.slow] if n in SLOW_PARAMS else [])
+    for n in sorted(SHRINK)
+])
 def test_declared_flops_are_forward_only(name):
     mod = workloads.get(name)
     cfg = config_lib.apply_overrides(

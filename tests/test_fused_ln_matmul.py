@@ -78,6 +78,7 @@ def test_bf16_io_and_flax_ln_parity():
     )
 
 
+@pytest.mark.slow
 def test_transformer_fused_ln_matches_unfused():
     """TransformerConfig(fused_ln_matmul=True) produces the same logits
     and gradients as the unfused pre-LN path on the SAME params (the

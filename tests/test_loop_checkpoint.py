@@ -76,6 +76,7 @@ def test_nan_guard_raises(mesh8):
         trainer.fit(batches(10), num_steps=5)
 
 
+@pytest.mark.slow
 def test_optimizer_zoo_smoke(mesh8):
     for name in ["sgd", "momentum", "adam", "adamw", "adagrad", "rmsprop",
                  "lamb", "ftrl", "adafactor"]:

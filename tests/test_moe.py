@@ -84,6 +84,7 @@ def test_aux_loss_positive_and_bounded():
     assert 0 < aux < CFG.router_aux_weight * CFG.num_experts
 
 
+@pytest.mark.slow
 def test_top1_router_gets_main_loss_gradient():
     """Switch-style top_k=1 must scale outputs by the raw gate probability —
     renormalizing would pin the gate at 1.0 and starve the router of
@@ -144,6 +145,7 @@ def test_sharded_matches_unsharded(devices):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_transformer_moe_trains(devices):
     import optax
 
@@ -186,6 +188,7 @@ def test_transformer_moe_trains(devices):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_scatter_dispatch_matches_einsum():
     """The linear-memory scatter dispatch makes identical routing
     decisions and produces the same outputs/aux as the einsum dispatch —
@@ -214,6 +217,7 @@ def test_scatter_dispatch_matches_einsum():
         )
 
 
+@pytest.mark.slow
 def test_scatter_dispatch_gradients_match_einsum():
     import dataclasses
 

@@ -190,7 +190,8 @@ def test_pipelined_transformer_rejects_moe():
         tfm.make_pipelined_init_fn(cfg, n_stages=2, seq_len=16)
 
 
-@pytest.mark.parametrize("family", ["gpt", "bert"])
+@pytest.mark.parametrize("family", [
+    pytest.param("gpt", marks=pytest.mark.slow), "bert"])
 def test_pipelined_transformer_matches_dense(devices, family):
     """Same weights through the pipeline schedule == the dense flax
     forward (the family shares the Block module, so this is an exact
@@ -245,6 +246,7 @@ def test_pipelined_transformer_interleaved_matches_dense(devices):
     )
 
 
+@pytest.mark.slow
 def test_pipelined_transformer_trains(devices):
     """Full train-engine integration on a pipe=2 × data=2 × fsdp=2 mesh:
     loss decreases on the deterministic-walk corpus."""
@@ -285,6 +287,7 @@ def test_pipelined_transformer_trains(devices):
     assert losses[-1] < losses[0] * 0.8, losses
 
 
+@pytest.mark.slow
 def test_pipelined_transformer_pp_tp_matches_dense(devices):
     """PP×TP: pipe=2 × model=2 × data=2 — manual megatron TP inside the
     pipeline island (column/row slices + psum, Block.tp_shards) must
@@ -326,6 +329,7 @@ def test_pipelined_transformer_pp_tp_matches_dense(devices):
             err_msg=jax.tree_util.keystr(path))
 
 
+@pytest.mark.slow
 def test_pipelined_transformer_pp_tp_trains(devices):
     """Train-engine integration on pipe=2 × model=2 × data=2: the stacked
     leaves shard over BOTH pipe and model (pipeline_param_specs(tp=True))
@@ -412,6 +416,7 @@ def test_pipeline_apply_rejects_param_specs_on_degenerate_mesh(devices):
                        ))
 
 
+@pytest.mark.slow
 def test_pipelined_dropout_schedule_independent(devices):
     """Dropout through the pipeline (VERDICT r2 item 7): the per-
     (microbatch, global-layer, batch-shard) key derivation must be
@@ -472,6 +477,7 @@ def test_pipelined_dropout_schedule_independent(devices):
         f1(pp1, ids, jax.random.PRNGKey(8))))
 
 
+@pytest.mark.slow
 def test_pipelined_dropout_trains_and_grads_flow(devices):
     """Grad through the stochastic schedule: masks replay identically in
     the backward (jax.checkpoint) and the train engine runs."""

@@ -2,6 +2,7 @@ import jax
 import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_tensorflow_tpu.models import common
 from distributed_tensorflow_tpu.models.resnet import (
@@ -18,6 +19,7 @@ def tiny_cfg(**kw):
     return ResNetConfig(**defaults)
 
 
+@pytest.mark.slow
 def test_resnet_forward_shape_and_params():
     model = ResNet50(tiny_cfg())
     init_fn = common.make_init_fn(model, (32, 32, 3))
@@ -55,6 +57,7 @@ def test_space_to_depth_stem():
     assert flops_per_example(cfg, 32) != flops_per_example(tiny_cfg(), 32)
 
 
+@pytest.mark.slow
 def test_resnet_train_step_updates_bn_stats(mesh8):
     import optax
 
@@ -97,6 +100,7 @@ def test_resnet50_flops_sane():
     assert 6.5e9 < f < 9.5e9, f
 
 
+@pytest.mark.slow
 def test_resnet_bf16_params_stay_f32():
     model = ResNet50(tiny_cfg(dtype="bfloat16"))
     params, _ = common.make_init_fn(model, (16, 16, 3))(jax.random.PRNGKey(0))
@@ -104,6 +108,7 @@ def test_resnet_bf16_params_stay_f32():
     assert kinds == {jnp.dtype("float32")}, kinds
 
 
+@pytest.mark.slow
 def test_fused_block_impl_matches_standard():
     """Same params through the fused-kernel blocks == the standard flax
     blocks, forward (train + eval) and gradients, and the batch_stats
@@ -165,6 +170,7 @@ def test_fused_block_impl_matches_standard():
                                rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_fused_block_impl_through_dp_mesh(devices):
     """Fused blocks under a data=8 mesh (shard_map psum stats) match the
     standard model under plain GSPMD on the same global batch."""

@@ -68,6 +68,7 @@ def test_ring_gradients_match_oracle(mesh_seq4):
         np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.slow
 def test_seq_axis_1_degenerates(devices):
     mesh = build_mesh(MeshSpec(data=8), devices[:8])
     q, k, v = make_qkv(jax.random.PRNGKey(3), B=8, S=32)

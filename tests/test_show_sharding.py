@@ -4,6 +4,7 @@ setup), assert the plan it prints."""
 
 import os
 import subprocess
+import pytest
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -20,6 +21,7 @@ def _run(*args):
     return proc.stdout
 
 
+@pytest.mark.slow
 def test_bert_tp_fsdp_plan():
     out = _run("bert_pretrain", "--mesh.data=2", "--mesh.fsdp=2",
                "--mesh.model=2")
@@ -32,6 +34,7 @@ def test_bert_tp_fsdp_plan():
     assert factor > 1.5, line
 
 
+@pytest.mark.slow
 def test_pipelined_plan_uses_explicit_specs():
     out = _run(
         "bert_pretrain", "--mesh.pipe=2", "--mesh.model=2", "--mesh.data=2",

@@ -96,6 +96,7 @@ def torch_bert_forward(params, ids, cfg, mask=None):
     return x @ emb.T + _t(params["mlm_bias"])
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("masked", [False, True])
 def test_flax_bert_matches_independent_torch(masked):
     cfg = tfm.TransformerConfig(

@@ -86,6 +86,7 @@ def _run_steps(mesh, param_rules, n_steps=3, seq_impl=None, mesh_for_model=None,
     return losses, state
 
 
+@pytest.mark.slow
 def test_tp_matches_replicated(devices):
     """dp8 (params replicated) and dp4×tp2 (megatron rules) produce the
     same losses on the same batches."""
@@ -99,6 +100,7 @@ def test_tp_matches_replicated(devices):
     assert qk.sharding.spec == P(None, "model")
 
 
+@pytest.mark.slow
 def test_seq_parallel_training_step(devices):
     """Training with ring-attention seq parallelism (seq=4) matches the
     dense dp run."""
@@ -111,6 +113,7 @@ def test_seq_parallel_training_step(devices):
     np.testing.assert_allclose(losses_dense, losses_sp, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_seq_parallel_composes_with_remat(devices):
     """cfg.remat (nn.remat around each Block) nests the ring-attention
     shard_map island inside jax.checkpoint; the composed program must
@@ -124,6 +127,7 @@ def test_seq_parallel_composes_with_remat(devices):
     np.testing.assert_allclose(losses_dense, losses_sp_remat, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_lm_loss_decreases():
     cfg = tiny_cfg(causal=True, pre_ln=True)
     mesh = build_mesh(MeshSpec(data=1), jax.devices()[:1])
@@ -184,6 +188,7 @@ def test_synthetic_mlm_dataset():
     np.testing.assert_array_equal(b["input_ids"], b2["input_ids"])
 
 
+@pytest.mark.slow
 def test_bert_workload_converges():
     """Tiny BERT through the full runner on 8 fake devices with dp4×tp2 —
     MLM on the permutation corpus must beat chance clearly."""
@@ -218,6 +223,7 @@ def test_bert_workload_converges():
     assert int(result.state.step) == 40
 
 
+@pytest.mark.slow
 def test_flash_padding_path_matches_dense():
     """attention_impl=flash with a non-block-multiple seq len (200) pads
     internally and matches the dense reference (Pallas interpret on CPU)."""
@@ -254,6 +260,7 @@ def test_param_count_matches_analytic_moe():
     assert tfm.active_param_count(tiny_cfg()) == tfm.param_count(tiny_cfg())
 
 
+@pytest.mark.slow
 def test_remat_preserves_forward_and_grads():
     """cfg.remat wraps blocks in nn.remat (jax.checkpoint): identical
     param tree, bit-equal-at-f32-tolerance forward, and matching grads —
@@ -289,6 +296,7 @@ def test_remat_preserves_forward_and_grads():
             err_msg=jax.tree_util.keystr(path))
 
 
+@pytest.mark.slow
 def test_bert_workload_pipelined_pp_tp():
     """--mesh.pipe=2 --mesh.model=2 engages the pipelined family (PP×TP)
     straight from the workload config path; MLM loss must fall like the
@@ -329,6 +337,7 @@ def test_bert_workload_pipelined_pp_tp():
     assert 0 < result.eval_metrics["accuracy"] <= 1.0
 
 
+@pytest.mark.slow
 def test_bert_pipelined_checkpoint_eval_roundtrip(tmp_path):
     """The stacked [S,lc,...] pipelined layout survives checkpoint →
     standalone evaluate_from_checkpoint: restored eval stats equal the

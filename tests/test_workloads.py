@@ -42,6 +42,7 @@ def test_mnist_mlp_converges(tmp_path):
     assert (tmp_path / "ck" / "config.json").exists()
 
 
+@pytest.mark.slow
 def test_cifar10_cnn_sync_dp8_smoke():
     result = workloads.run_workload(
         "cifar10_cnn",
