@@ -159,9 +159,11 @@ def main() -> None:
         rec = os.path.join(tempfile.mkdtemp(prefix="bench_jpeg_"), "rec")
         make_jpeg_record_file(rec, src_imgs, rng.randint(
             0, cfg.num_classes, n_src))
-        log(f"jpeg-fed: {n_src} records at {src_size}px -> decode+augment "
-            f"to {image}px inside the measured window")
         ds = JpegClassificationDataset(rec, image, global_batch, train=True)
+        log(f"jpeg-fed: {n_src} records at {src_size}px -> decode+augment "
+            f"to {image}px inside the measured window "
+            f"(decoder={ds.decoder})")
+        fed_data = f"jpeg/{ds.decoder}"
 
         def host_stream():
             i = 0

@@ -52,7 +52,23 @@ run gpt_plain 1200 env BENCH_MODEL=gpt python -u tools/bench_bert.py
 run gpt_fused_ln 1200 env BENCH_MODEL=gpt BENCH_FUSED_LN=1 \
   python -u tools/bench_bert.py
 
+# 7. long-context: 4k flash-attention GPT (first long-context number;
+#    SURVEY §5.7 — ring/SP path is multi-chip, this reads the single-chip
+#    flash-attention memory/throughput point)
+run gpt_long4k 1500 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=8 \
+  BENCH_REMAT=1 python -u tools/bench_bert.py
+
 echo "=== session done; JSON lines: ==="
 grep -h '"metric"' "$OUT"/hbm.log "$OUT"/bench_*.log "$OUT"/bert*.log \
   "$OUT"/gpt*.log 2>/dev/null
 echo "logs in $OUT"
+
+# Preserve the evidence in-tree immediately (VERDICT r2 item 1: mid-round
+# artifacts, not end-of-round luck) — the session or relay may not
+# survive to a second chance. Committing is done by the operator/driver.
+ART="$(dirname "$0")/../artifacts/onchip_r3"
+mkdir -p "$ART"
+cp "$OUT"/*.log "$ART"/ 2>/dev/null
+grep -h '"metric"' "$OUT"/bench_fused.log 2>/dev/null | tail -1 \
+  > "$ART"/BENCH_LATEST.json || true
+echo "artifacts copied to $ART"
