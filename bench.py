@@ -200,7 +200,19 @@ def main() -> None:
         lambda x: NamedSharding(mesh, sh.batch_spec(np.ndim(x))),
         probe,
     )
-    put = lambda b: jax.tree.map(jax.device_put, b, shardings)
+    # BENCH_PUT_SYNC=1: force each transfer to COMPLETE inside the
+    # prefetch thread (block_until_ready on the put) instead of lazily at
+    # step dispatch — the A/B knob for the round-2 tunneled-TPU fed
+    # anomaly (0.044 efficiency attributed to dependent-dispatch
+    # transfer; PERF_NOTES.md round-2)
+    put_sync = os.environ.get("BENCH_PUT_SYNC") == "1"
+
+    def put(b):
+        dev = jax.tree.map(jax.device_put, b, shardings)
+        if put_sync:
+            jax.block_until_ready(dev)
+        return dev
+
     fed = iter(Prefetcher(host_stream(), depth=2, transform=put))
     state, fed_steps_per_sec, _ = bm.timed_steps(
         step, state, lambda: next(fed), warmup=2, measured=measured, log=log,

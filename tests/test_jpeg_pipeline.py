@@ -318,3 +318,41 @@ def test_jpeg_per_host_sharding_disjoint(tmp_path, monkeypatch):
     assert len(set(a.tolist()) & set(b.tolist())) == 0  # disjoint
     assert sorted(set(a.tolist()) | set(b.tolist())) == sorted(
         np.arange(24).tolist())  # epoch covered
+
+
+def test_native_decoder_grayscale_source(tmp_path):
+    """Grayscale JPEGs (1-channel sources exist in real ImageNet) must
+    decode to RGB in both tiers — libjpeg's out_color_space=JCS_RGB
+    upsamples gray, PIL's convert('RGB') likewise."""
+    import io
+
+    from PIL import Image
+
+    from distributed_tensorflow_tpu.data import native_jpeg
+    from distributed_tensorflow_tpu.data.jpeg_records import _ENTRY
+
+    if not native_jpeg.available():
+        pytest.skip("native jpeg library unavailable")
+
+    path = str(tmp_path / "rec")
+    gray = _images(4, h=40, w=40)[..., 0]  # [N, H, W] single channel
+    entries = np.empty(4, _ENTRY)
+    with open(path + ".dat", "wb") as f:
+        off = 0
+        for i in range(4):
+            buf = io.BytesIO()
+            Image.fromarray(gray[i], "L").save(buf, "JPEG", quality=92)
+            raw = buf.getvalue()
+            f.write(raw)
+            entries[i] = (off, len(raw), i)
+            off += len(raw)
+    entries.tofile(path + ".idx")
+
+    bn = JpegClassificationDataset(path, 32, 4, train=False,
+                                   decoder="native").batch(0)
+    bp = JpegClassificationDataset(path, 32, 4, train=False,
+                                   decoder="pil").batch(0)
+    assert bn["image"].shape == (4, 32, 32, 3)
+    # gray upsampled: all three channels equal
+    np.testing.assert_array_equal(bn["image"][..., 0], bn["image"][..., 1])
+    assert np.abs(bn["image"] - bp["image"]).max() < 0.08

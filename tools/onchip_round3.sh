@@ -38,8 +38,11 @@ run bench_fused 1200 python -u bench.py
 run bench_standard 1200 env BENCH_BLOCK_IMPL=standard python -u bench.py
 
 # 4. JPEG-decode-fed window (VERDICT item 2: decode inside a measured
-#    TPU window, through the production JpegClassificationDataset path)
+#    TPU window, through the production JpegClassificationDataset path);
+#    then the transfer-sync A/B for the round-2 0.044 fed anomaly
 run bench_jpeg 1500 env BENCH_DATA=jpeg python -u bench.py
+run bench_jpeg_putsync 1500 env BENCH_DATA=jpeg BENCH_PUT_SYNC=1 \
+  python -u bench.py
 
 # 5. kernel microbench at bench shapes (fwd then grad)
 run microbench_fwd 900 python -u tools/bench_fused_kernels.py fwd 10
