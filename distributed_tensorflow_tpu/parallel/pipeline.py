@@ -188,14 +188,12 @@ def pipeline_apply(
             else jax.vmap(lambda i: jax.random.fold_in(rng, i))(
                 jnp.arange(M))
         )
-        if aux_mb is None and mb_keys is None:
-            return jax.vmap(lambda x: through_chunks(x))(x_mb)
-        if mb_keys is None:
-            return jax.vmap(lambda x, a: through_chunks(x, a))(x_mb, aux_mb)
-        if aux_mb is None:
-            return jax.vmap(lambda x, k: through_chunks(x, None, k))(
-                x_mb, mb_keys)
-        return jax.vmap(through_chunks)(x_mb, aux_mb, mb_keys)
+        return jax.vmap(
+            through_chunks,
+            in_axes=(0,
+                     0 if aux_mb is not None else None,
+                     0 if mb_keys is not None else None),
+        )(x_mb, aux_mb, mb_keys)
     if M < n_stages:
         raise ValueError(
             f"need at least as many microbatches ({M}) as stages "
