@@ -514,3 +514,48 @@ def test_pipelined_dropout_trains_and_grads_flow(devices):
         assert float(metrics["grads_finite"]) == 1.0
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_pipelined_composes_with_grad_accum(devices):
+    """PP × ConditionalAccumulator-descendant: grad_accum_steps=2 through
+    the pipelined loss must equal the accum=1 step on the same batch
+    (causal LM: every chunk has identical valid-token counts, so
+    mean-of-means == full-batch mean exactly; dropout off)."""
+    import optax
+
+    from distributed_tensorflow_tpu.train import (
+        StepOptions, init_train_state, jit_train_step, make_train_step,
+    )
+
+    cfg = _tiny_cfg()  # causal, dropout=0.0
+    mesh = build_mesh(MeshSpec(pipe=2, data=2), devices[:4])
+    init_fn = tfm.make_pipelined_init_fn(cfg, n_stages=2, seq_len=16)
+    specs = tfm.pipeline_param_specs(
+        jax.eval_shape(init_fn, jax.random.PRNGKey(0))[0])
+    tx = optax.sgd(0.1)
+    loss_fn = tfm.pipelined_lm_loss_fn(cfg, mesh, n_microbatches=4)
+
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (16, 16))
+    batch = {"input_ids": jax.device_put(
+        jnp.asarray(ids, jnp.int32),
+        NamedSharding(mesh, sh.batch_spec(2)))}
+
+    results = []
+    for accum in (1, 2):
+        state, sspecs = init_train_state(
+            init_fn, tx, mesh, jax.random.PRNGKey(0), param_specs=specs)
+        step = jit_train_step(
+            make_train_step(loss_fn, tx,
+                            StepOptions(grad_accum_steps=accum)),
+            mesh, sspecs,
+        )
+        state, metrics = step(state, batch)
+        results.append((state.params, float(metrics["loss"])))
+
+    (p1, l1), (p2, l2) = results
+    assert abs(l1 - l2) < 1e-5, (l1, l2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5),
+        p1, p2,
+    )
