@@ -168,6 +168,7 @@ def main() -> None:
         "model": which,
         "fused_ln_matmul": fused_ln,
         "attention_impl": attn,
+        "mlm_predictions": n_pred,  # None = dense head / causal LM
         "full_size_model": bool(on_tpu),
     }))
 
