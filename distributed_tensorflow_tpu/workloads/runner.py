@@ -59,6 +59,11 @@ class TrainSection:
     # Adds grad_norm + grads_finite to the step metrics — an extra pass over
     # every gradient leaf per step; off in production (PERF_NOTES.md).
     debug_metrics: bool = False
+    # > 0: clip gradients to this global norm (the transformer-pretrain
+    # standard). Side benefit: the norm's finiteness doubles as a FREE
+    # same-step grads_finite signal for NaNGuard (train/step.py), closing
+    # the one-step-delayed-loss window without debug_metrics' extra pass.
+    clip_grad_norm: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,6 +166,7 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
             grad_accum_steps=cfg.train.grad_accum_steps,
             compute_grad_norm=cfg.train.debug_metrics,
             check_grads_finite=cfg.train.debug_metrics,
+            clip_grad_norm=cfg.train.clip_grad_norm or None,
         ),
     )
     trainer = Trainer(step_fn, state, mesh, specs, callbacks=callbacks)

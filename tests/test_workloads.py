@@ -259,3 +259,16 @@ def test_convergence_demo_machinery(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["eval_top1"] > 0.3, result
+
+
+def test_clip_grad_norm_knob_gives_same_step_nan_signal():
+    """--train.clip_grad_norm clips AND yields the free grads_finite
+    metric (derived from the global norm) without debug_metrics."""
+    result = workloads.run_workload("mnist_mlp", [
+        "--train.num_steps=3", "--train.log_every=1",
+        "--train.clip_grad_norm=1.0", "--data.global_batch_size=16",
+        "--mesh.data=-1",
+    ])
+    last = result.history[-1]
+    assert "grad_norm" in last and "grads_finite" in last
+    assert last["grads_finite"] == 1.0
