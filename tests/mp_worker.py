@@ -40,6 +40,8 @@ def main() -> None:
         scenario_hybrid()
     elif scenario == "divergence":
         scenario_divergence(pid)
+    elif scenario == "pipeline":
+        scenario_pipeline()
     elif scenario == "checkpoint":
         scenario_checkpoint(workdir, resume="--resume" in sys.argv)
     elif scenario == "preempt":
@@ -135,6 +137,54 @@ def scenario_divergence(pid: int) -> None:
         print(f"DIVERGE-MISSED {pid}", flush=True)
     except AssertionError:
         print(f"DIVERGE-CAUGHT {pid}", flush=True)
+
+
+def scenario_pipeline() -> None:
+    """Pipeline stages on DIFFERENT hosts: dcn_pipe=2 forces the pipe
+    axis across the process boundary, so every ppermute hop (activations
+    stage->stage, fwd AND transposed bwd) crosses DCN. One stochastic
+    (dropout) pipelined train step; loss finite and host-agreeing."""
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_tpu.models import transformer as tfm
+    from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh
+    from distributed_tensorflow_tpu.parallel import sharding as sh
+    from distributed_tensorflow_tpu.train import (
+        StepOptions, init_train_state, jit_train_step, make_train_step,
+    )
+
+    mesh = build_mesh(MeshSpec(pipe=2, data=2, dcn_pipe=2))
+    cfg = tfm.TransformerConfig(
+        vocab_size=32, max_len=8, num_layers=2, d_model=16, num_heads=2,
+        d_ff=32, causal=True, pre_ln=True, dropout=0.1, dtype="float32",
+    )
+    init_fn = tfm.make_pipelined_init_fn(cfg, n_stages=2, seq_len=8)
+    specs = tfm.pipeline_param_specs(
+        jax.eval_shape(init_fn, jax.random.PRNGKey(0))[0])
+    tx = optax.sgd(0.05)
+    state, sspecs = init_train_state(
+        init_fn, tx, mesh, jax.random.PRNGKey(0), param_specs=specs)
+    step = jit_train_step(
+        make_train_step(tfm.pipelined_lm_loss_fn(cfg, mesh, 2), tx,
+                        StepOptions()),
+        mesh, sspecs,
+    )
+    rng = np.random.RandomState(0)  # same seed: agreed global batch
+    ids = rng.randint(0, 32, (8, 8)).astype(np.int32)
+    # the data axis is INTRA-process here (pipe spans the hosts), so each
+    # host's addressable shards cover every batch row: pass the full
+    # pipe-replicated batch, not a per-host slice
+    batch = sh.put_host_batch(mesh, {"input_ids": ids})
+    state, metrics = step(state, batch)
+    loss = float(jax.device_get(metrics["loss"]))
+    assert np.isfinite(loss), loss
+    from distributed_tensorflow_tpu.utils import multihost
+
+    multihost.assert_same_across_hosts(
+        {"loss": np.asarray(loss, np.float32)}, "pipeline-loss"
+    )
+    print(f"PIPELINE-OK {jax.process_index()} {loss:.6f}", flush=True)
 
 
 def scenario_checkpoint(workdir: str, resume: bool) -> None:

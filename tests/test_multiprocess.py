@@ -100,6 +100,16 @@ def test_hybrid_mesh_two_process_step():
 
 
 @pytest.mark.slow
+def test_pipeline_stages_across_hosts():
+    """dcn_pipe=2: pipeline stages live on DIFFERENT processes — every
+    schedule hop (fwd ppermute and its backward transpose) crosses the
+    host boundary, with dropout active through the tick."""
+    outs = run_cluster("pipeline", timeout=300)
+    for pid, out in enumerate(outs):
+        assert f"PIPELINE-OK {pid}" in out, out
+
+
+@pytest.mark.slow
 def test_cross_host_divergence_detection():
     outs = run_cluster("divergence")
     for pid, out in enumerate(outs):
