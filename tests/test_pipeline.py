@@ -559,3 +559,30 @@ def test_pipelined_composes_with_grad_accum(devices):
             np.asarray(a), np.asarray(b), atol=2e-5),
         p1, p2,
     )
+
+
+@pytest.mark.slow
+def test_pipelined_dropout_consistent_under_tp(devices):
+    """PP×TP × dropout: model-axis devices must draw IDENTICAL masks
+    (the shard fold uses only the data/fsdp index), so the TP=2 forward
+    equals the TP=1 forward exactly — a wrong per-device key would break
+    the row-parallel psum math, which only numerical parity catches."""
+    cfg = _tiny_cfg(dropout=0.5)
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 16)(jax.random.PRNGKey(0))
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16)),
+        jnp.int32)
+    key = jax.random.PRNGKey(3)
+    pp = tfm.to_pipeline_params(params, cfg, n_stages=2)
+
+    outs = []
+    for spec, nd in ((MeshSpec(pipe=2, data=2), 4),
+                     (MeshSpec(pipe=2, model=2, data=2), 8)):
+        mesh = build_mesh(spec, devices[:nd])
+        outs.append(np.asarray(jax.jit(
+            lambda p, i, k, mesh=mesh: tfm.pipelined_apply(
+                p, i, None, cfg, mesh, n_microbatches=4,
+                train=True, rng=k)
+        )(pp, ids, key)))
+    np.testing.assert_allclose(outs[0], outs[1], atol=2e-4)
