@@ -44,3 +44,58 @@ def pick_block_n(k: int, n: int, *, name: str) -> int:
         f"{name}: n={n} has no lane-aligned tile whose [k={k}, bn] f32 "
         "accumulator fits VMEM; pad n to a multiple of 128"
     )
+
+
+def _aligned_divisors(M: int, cap: int = 1024) -> list[int]:
+    """8-aligned divisors of M up to ``cap`` (descending), with M itself
+    as the fallback when no aligned divisor exists (Mosaic then pads the
+    array edge)."""
+    out = [bm for bm in range(min(M, cap) // 8 * 8, 7, -8) if M % bm == 0]
+    return out or [M]
+
+
+def pick_dw_tiles(M: int, cin: int, cout: int, *, in_bytes: int,
+                  emit_stats: bool, name: str) -> tuple[int, int]:
+    """Joint (bm, bn) for the dw kernels, with FULL per-tile VMEM
+    accounting — the round-2 pickers modelled only the streamed operands
+    and sized the accumulator separately, which let the bench-shape
+    [12544, 512] x [12544, 2048] dw kernel allocate a 17.9 MB scoped
+    stack (> the 16 MB core limit) even though each term individually
+    "fit" (caught on-chip, round 3; the validator now compiles the real
+    bench shapes so this class of miss cannot pass again).
+
+    Model per (bm, bn) tile:
+      - streamed, double-buffered: x [bm, cin]; y and dy [bm, bn] (y is
+        streamed regardless of emit_stats — the BlockSpec always maps it)
+      - resident accumulator, double-buffered across the outer-j switch:
+        dw [cin, bn] f32, plus the dot-product f32 temp of the same shape
+      - f32 stack scratch Mosaic materializes: g (and y when emit_stats)
+        [bm, bn]; the prologue x [bm, cin] + its in-dtype cast
+
+    Preference order: largest bn first (each bn-tile re-streams the whole
+    x, so fewer column tiles = less HBM traffic), then largest bm; bm is
+    kept >= 128 where possible so the row-contraction feeds the MXU full
+    tiles."""
+    budget = 13 * 1024 * 1024  # ~3 MB slack under the 16 MB scoped limit
+
+    def tile_bytes(bm: int, bn: int) -> int:
+        stream = 2 * (bm * cin * in_bytes + 2 * bm * bn * in_bytes)
+        acc = 3 * cin * bn * 4
+        scratch = ((2 if emit_stats else 1) * bm * bn * 4
+                   + bm * cin * 4 + bm * cin * in_bytes)
+        return stream + acc + scratch
+
+    bms = _aligned_divisors(M)
+    bns = [bn for bn in (cout, *range(2048, 127, -128))
+           if bn <= cout and cout % bn == 0]
+    for prefer_wide_bm in (True, False):
+        for bn in bns:
+            for bm in bms:
+                if prefer_wide_bm and bm < min(128, M):
+                    continue
+                if tile_bytes(bm, bn) <= budget:
+                    return bm, bn
+    raise ValueError(
+        f"{name}: no (bm, bn) tile for M={M}, cin={cin}, cout={cout} "
+        "fits the VMEM budget; pad cout to a multiple of 128"
+    )

@@ -49,9 +49,6 @@ def _pick_block_m(M: int, cin: int, cout: int) -> int:
     return _tiling.pick_block_m(M, cin, cout, name="fused conv1x1 kernel")
 
 
-def _pick_block_n(cin: int, cout: int) -> int:
-    return _tiling.pick_block_n(cin, cout, name="fused conv1x1 kernel")
-
 
 _on_tpu = _tiling.on_tpu
 
@@ -232,8 +229,10 @@ def _bwd_dw_call(x, y, dy, scale, shift, dsum, dssq, *, prologue, relu,
                  emit_stats, interpret):
     M, cin = x.shape
     cout = dy.shape[1]
-    bm = _pick_block_m(M, cin, cout)
-    bn = _pick_block_n(cin, cout)
+    bm, bn = _tiling.pick_dw_tiles(
+        M, cin, cout, in_bytes=x.dtype.itemsize, emit_stats=emit_stats,
+        name="fused conv1x1 dw kernel",
+    )
     kernel = functools.partial(
         _bwd_dw_kernel, prologue=prologue, relu=relu, emit_stats=emit_stats,
     )
@@ -258,12 +257,64 @@ def _bwd_dw_call(x, y, dy, scale, shift, dsum, dssq, *, prologue, relu,
 
 
 # ---------------------------------------------------------------------------
+# Backward C: the XLA-math backward (round-3 default)
+# ---------------------------------------------------------------------------
+
+
+def _xla_bwd(x, y, dy, w, scale, shift, dsum, dssq, *, prologue, relu,
+             emit_stats):
+    """Same math as the two Pallas backward kernels, in plain jnp.
+
+    Round-3 on-chip microbenches (artifacts/onchip_r3/microbench_*.log):
+    the Pallas FORWARD beats the unfused XLA sequence 1.0-2.5x at every
+    batch-256 ResNet shape, but the two-kernel Pallas backward re-streams
+    x/y/dy once per kernel (2 full passes) and loses to XLA's fused
+    backward at every shape (0.40-0.87x). So the composite keeps the
+    Pallas forward and defaults the VJP to this XLA path, which the
+    compiler fuses into dgrad/wgrad epilogues; the Pallas backward
+    kernels stay selectable (DTF_FUSED_BWD=pallas) for future tiles."""
+    g = dy.astype(jnp.float32)
+    if emit_stats:
+        g = g + dsum + 2.0 * y.astype(jnp.float32) * dssq
+    gq = g.astype(y.dtype)
+    dh = jax.lax.dot_general(
+        gq, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if prologue:
+        x32 = x.astype(jnp.float32)
+        xn = x32 * scale + shift
+        if relu:
+            dh = dh * (xn > 0.0).astype(jnp.float32)
+        dx = (dh * scale).astype(x.dtype)
+        dscale = (dh * x32).sum(0, keepdims=True)
+        dshift = dh.sum(0, keepdims=True)
+        h = jnp.maximum(xn, 0.0) if relu else xn
+        hq = h.astype(x.dtype)
+    else:
+        dx = dh.astype(x.dtype)
+        dscale = dshift = None
+        hq = x
+    dw = jax.lax.dot_general(
+        hq, gq, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return dx, dw, dscale, dshift
+
+
+def _default_bwd_impl() -> str:
+    import os
+
+    return os.environ.get("DTF_FUSED_BWD", "xla")
+
+
+# ---------------------------------------------------------------------------
 # custom_vjp composite
 # ---------------------------------------------------------------------------
 
 
 @functools.lru_cache(maxsize=None)
-def _make_op(prologue, relu, emit_stats, out_dtype, interpret):
+def _make_op(prologue, relu, emit_stats, out_dtype, interpret, bwd_impl):
     @jax.custom_vjp
     def op(x, w, scale, shift):
         y, s, ssq = _fwd_call(
@@ -292,14 +343,21 @@ def _make_op(prologue, relu, emit_stats, out_dtype, interpret):
             dsum = jnp.zeros((1, cout), jnp.float32)
             dssq = jnp.zeros((1, cout), jnp.float32)
         dy = dy.astype(y.dtype)
-        dx, dscale, dshift = _bwd_dx_call(
-            x, y, dy, w, scale, shift, dsum, dssq, prologue=prologue,
-            relu=relu, emit_stats=emit_stats, interpret=interpret,
-        )
-        dw = _bwd_dw_call(
-            x, y, dy, scale, shift, dsum, dssq, prologue=prologue,
-            relu=relu, emit_stats=emit_stats, interpret=interpret,
-        ).astype(w.dtype)
+        if bwd_impl == "xla":
+            dx, dw, dscale, dshift = _xla_bwd(
+                x, y, dy, w, scale, shift, dsum, dssq, prologue=prologue,
+                relu=relu, emit_stats=emit_stats,
+            )
+            dw = dw.astype(w.dtype)
+        else:
+            dx, dscale, dshift = _bwd_dx_call(
+                x, y, dy, w, scale, shift, dsum, dssq, prologue=prologue,
+                relu=relu, emit_stats=emit_stats, interpret=interpret,
+            )
+            dw = _bwd_dw_call(
+                x, y, dy, scale, shift, dsum, dssq, prologue=prologue,
+                relu=relu, emit_stats=emit_stats, interpret=interpret,
+            ).astype(w.dtype)
         if prologue:
             return dx, dw, dscale.reshape(scale.shape), dshift.reshape(shift.shape)
         return dx, dw, jnp.zeros_like(scale), jnp.zeros_like(shift)
@@ -318,6 +376,7 @@ def conv1x1_bn_act(
     emit_stats: bool = True,
     out_dtype=None,
     interpret: bool | None = None,
+    bwd_impl: str | None = None,
 ):
     """Fused ``[M, Cin] @ [Cin, Cout]`` with optional BN-apply prologue and
     stats epilogue.
@@ -342,7 +401,11 @@ def conv1x1_bn_act(
         scale = scale.reshape(1, -1).astype(jnp.float32)
         shift = shift.reshape(1, -1).astype(jnp.float32)
     out_dtype = jnp.dtype(out_dtype or x.dtype)
-    op = _make_op(prologue, relu, emit_stats, out_dtype.name, bool(interpret))
+    bwd_impl = bwd_impl or _default_bwd_impl()
+    if bwd_impl not in ("xla", "pallas"):
+        raise ValueError(f"bwd_impl must be 'xla' or 'pallas', got {bwd_impl!r}")
+    op = _make_op(prologue, relu, emit_stats, out_dtype.name, bool(interpret),
+                  bwd_impl)
     return op(x, w, scale, shift)
 
 

@@ -153,9 +153,6 @@ def _bwd_dx_call(x, gamma, w, dy, *, eps, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _pick_block_n(d: int, n: int) -> int:
-    return _tiling.pick_block_n(d, n, name="fused ln_matmul")
-
 
 def _bwd_dw_kernel(x_ref, g_ref, b_ref, dy_ref, dw_ref, *, eps):
     x32 = x_ref[:].astype(jnp.float32)
@@ -175,8 +172,13 @@ def _bwd_dw_kernel(x_ref, g_ref, b_ref, dy_ref, dw_ref, *, eps):
 def _bwd_dw_call(x, gamma, beta, dy, *, eps, interpret):
     M, d = x.shape
     n = dy.shape[1]
-    bm = _pick_block_m(M, d, n)
-    bn = _pick_block_n(d, n)
+    # emit_stats=True deliberately over-counts scratch by ~bm*bn*4 to
+    # cover this kernel's f32 LN recompute (x32 + h), which the conv
+    # model attributes to the stats path
+    bm, bn = _tiling.pick_dw_tiles(
+        M, d, n, in_bytes=x.dtype.itemsize, emit_stats=True,
+        name="fused ln_matmul dw kernel",
+    )
     return pl.pallas_call(
         functools.partial(_bwd_dw_kernel, eps=eps),
         grid=(n // bn, M // bm),  # M innermost: dw tile revisited
@@ -194,12 +196,51 @@ def _bwd_dw_call(x, gamma, beta, dy, *, eps, interpret):
 
 
 # ---------------------------------------------------------------------------
+# The XLA-math backward (round-3 default — see fused_conv_bn._xla_bwd:
+# same on-chip finding, the two-pass Pallas backward loses to XLA's
+# fused dgrad/wgrad at bench shapes while the Pallas forward wins)
+# ---------------------------------------------------------------------------
+
+
+def _xla_bwd(x, gamma, beta, w, dy, *, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = (x32 - mu) * inv
+    dy32 = dy.astype(jnp.float32)
+    dh = jax.lax.dot_general(
+        dy, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dxhat = dh * gamma
+    m1 = dxhat.mean(-1, keepdims=True)
+    m2 = (dxhat * xhat).mean(-1, keepdims=True)
+    dx = ((dxhat - m1 - xhat * m2) * inv).astype(x.dtype)
+    dg = (dh * xhat).sum(0, keepdims=True)
+    db = dh.sum(0, keepdims=True)
+    dbias = dy32.sum(0, keepdims=True)
+    h = (xhat * gamma + beta).astype(x.dtype)
+    dw = jax.lax.dot_general(
+        h, dy, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return dx, dg, db, dw, dbias
+
+
+def _default_bwd_impl() -> str:
+    import os
+
+    return os.environ.get("DTF_FUSED_BWD", "xla")
+
+
+# ---------------------------------------------------------------------------
 # custom_vjp composite + reference
 # ---------------------------------------------------------------------------
 
 
 @functools.lru_cache(maxsize=None)
-def _make_op(eps, out_dtype, interpret):
+def _make_op(eps, out_dtype, interpret, bwd_impl):
     @jax.custom_vjp
     def op(x, gamma, beta, w, bias):
         return _fwd_call(x, gamma, beta, w, bias, eps=eps,
@@ -213,6 +254,12 @@ def _make_op(eps, out_dtype, interpret):
     def bwd(res, dy):
         x, gamma, beta, w = res
         dy = dy.astype(jnp.dtype(out_dtype))
+        if bwd_impl == "xla":
+            dx, dg, db, dw, dbias = _xla_bwd(
+                x, gamma.reshape(1, -1), beta.reshape(1, -1), w, dy, eps=eps
+            )
+            return (dx, dg.reshape(1, -1), db.reshape(1, -1),
+                    dw.astype(w.dtype), dbias.reshape(1, -1))
         dx, dg, db, dbias = _bwd_dx_call(
             x, gamma, w, dy, eps=eps, interpret=interpret
         )
@@ -238,6 +285,7 @@ def ln_matmul(
     eps: float = 1e-6,
     out_dtype=None,
     interpret: bool | None = None,
+    bwd_impl: str | None = None,
 ) -> jax.Array:
     """``LayerNorm(x; gamma, beta) @ w + bias`` in one kernel.
 
@@ -251,7 +299,10 @@ def ln_matmul(
     if bias is None:
         bias = jnp.zeros((n,), jnp.float32)
     out_dtype = jnp.dtype(out_dtype or x.dtype)
-    op = _make_op(float(eps), out_dtype.name, bool(interpret))
+    bwd_impl = bwd_impl or _default_bwd_impl()
+    if bwd_impl not in ("xla", "pallas"):
+        raise ValueError(f"bwd_impl must be 'xla' or 'pallas', got {bwd_impl!r}")
+    op = _make_op(float(eps), out_dtype.name, bool(interpret), bwd_impl)
     return op(
         x,
         gamma.reshape(1, d).astype(jnp.float32),

@@ -48,7 +48,8 @@ def test_forward_matches_reference(prologue, relu, emit_stats):
 
 
 @pytest.mark.parametrize("prologue", [False, True])
-def test_gradients_match_reference(prologue):
+@pytest.mark.parametrize("bwd_impl", ["xla", "pallas"])
+def test_gradients_match_reference(prologue, bwd_impl):
     """Full-pathway gradient check: the loss consumes y AND the emitted
     stats (through moments, like the next BN does), so the stats-output
     cotangent path into dy is exercised."""
@@ -57,7 +58,8 @@ def test_gradients_match_reference(prologue):
     def loss(fn):
         def go(x, w, scale, shift):
             args = (x, w, scale, shift) if prologue else (x, w)
-            y, s, ssq = fn(*args, relu=True, emit_stats=True)
+            kw = {"bwd_impl": bwd_impl} if fn is conv1x1_bn_act else {}
+            y, s, ssq = fn(*args, relu=True, emit_stats=True, **kw)
             mean, var = moments_from_sums(s, ssq, y.shape[0])
             return (
                 (y * y).mean()

@@ -32,12 +32,14 @@ def test_forward_matches_reference(with_bias):
                                rtol=1e-5, atol=1e-4)
 
 
-def test_gradients_match_reference():
+@pytest.mark.parametrize("bwd_impl", ["xla", "pallas"])
+def test_gradients_match_reference(bwd_impl):
     x, gamma, beta, w, bias = _mk(M=48, d=24, n=40)
 
     def loss(fn):
         def go(x, gamma, beta, w, bias):
-            y = fn(x, gamma, beta, w, bias)
+            kw = {"bwd_impl": bwd_impl} if fn is ln_matmul else {}
+            y = fn(x, gamma, beta, w, bias, **kw)
             return (y * jnp.cos(y)).mean()
 
         return go

@@ -68,17 +68,36 @@ def _timed(fn, arg, iters: int) -> float:
 def main() -> None:
     dev = jax.devices()[0]
     print(f"device: {dev} platform={dev.platform}", file=sys.stderr)
-    iters = int(os.environ.get("HBM_ITERS", "16"))
+    iters = int(os.environ.get("HBM_ITERS", "64"))
+
+    # Fixed dispatch+fetch overhead of one timed call — through the axon
+    # tunnel this is a network round trip (~10-100 ms), which deflates
+    # every short chain: round-3's first run measured 43.5 "TFLOP/s" on
+    # a 4-iter matmul chain purely because ~80 ms of RTT was folded into
+    # ~23 ms of compute. Measured with the same _timed discipline on a
+    # scalar body, then subtracted below; both raw and corrected values
+    # are reported so the correction is auditable.
+    rtt = _timed(lambda s: s + 1.0, jnp.zeros((), jnp.float32), 1)
+    print(json.dumps({
+        "metric": "dispatch_fetch_overhead_ms",
+        "value": round(rtt * 1e3, 2), "unit": "ms",
+        "platform": dev.platform,
+    }))
+
+    def corrected(per_iter: float, n_iters: int) -> float:
+        # remove the one-off RTT amortized across the chain, floor at 10%
+        # of the raw time so a misestimated RTT can't produce nonsense
+        return max(per_iter - rtt / n_iters, per_iter * 0.1)
 
     n = GIB // 2  # 1 GiB of bf16
     x = jnp.zeros((n,), jnp.bfloat16)
 
     dt = _timed(lambda a: a + jnp.bfloat16(1), x, iters)
-    stream = 2 * GIB / dt  # read + write
+    stream = 2 * GIB / corrected(dt, iters)  # read + write
     print(json.dumps({
         "metric": "hbm_stream_gbps", "value": round(stream / 1e9, 1),
         "unit": "GB/s", "platform": dev.platform, "buffer_gib": 1.0,
-        "iters": iters,
+        "iters": iters, "raw_gbps": round(2 * GIB / dt / 1e9, 1),
     }))
 
     # read-reduce: the buffer rides in the carry so it stays a jit
@@ -93,8 +112,31 @@ def main() -> None:
 
     dt = _timed(_reduce, (x, jnp.zeros((), jnp.float32)), iters)
     print(json.dumps({
-        "metric": "hbm_reduce_gbps", "value": round(GIB / dt / 1e9, 1),
+        "metric": "hbm_reduce_gbps",
+        "value": round(GIB / corrected(dt, iters) / 1e9, 1),
         "unit": "GB/s", "platform": dev.platform,
+        "raw_gbps": round(GIB / dt / 1e9, 1),
+    }))
+
+    # host->device transfer bandwidth: the fed-window denominator. A
+    # batch-256 ResNet input is ~77 MB; fed steps/sec is bounded by
+    # transfer_bw / batch_bytes no matter how the dispatch is arranged,
+    # so this one number decides "tunnel artifact vs framework defect"
+    # for the pipeline-fed efficiency rows (VERDICT r2 item 2).
+    import numpy as _np
+    host_buf = _np.zeros((64 << 20,), _np.uint8)  # 64 MiB
+    jax.device_put(host_buf).block_until_ready()  # warm the path
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        # += 1 defeats any content-hash/dedup cache on the relay path
+        host_buf[:4096] += 1
+        jax.device_put(host_buf).block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    print(json.dumps({
+        "metric": "host_to_device_gbps",
+        "value": round(len(host_buf) / dt / 1e9, 3), "unit": "GB/s",
+        "platform": dev.platform, "buffer_mib": 64,
     }))
 
     m = int(os.environ.get("MXU_DIM", "8192"))
@@ -103,11 +145,14 @@ def main() -> None:
     # values at 1.0 so bf16 never overflows across iterations (the
     # elementwise write is ~0.03% of the matmul time)
     scale = jnp.bfloat16(1.0 / m)
-    dt = _timed(lambda b: (b @ b) * scale, a, max(4, iters // 4))
-    tflops = 2 * m**3 / dt / 1e12
+    mm_iters = max(16, iters // 4)
+    dt = _timed(lambda b: (b @ b) * scale, a, mm_iters)
+    tflops = 2 * m**3 / corrected(dt, mm_iters) / 1e12
     print(json.dumps({
         "metric": "mxu_bf16_tflops", "value": round(tflops, 1),
         "unit": "TFLOP/s", "platform": dev.platform, "dim": m,
+        "iters": mm_iters,
+        "raw_tflops": round(2 * m**3 / dt / 1e12, 1),
         "pct_of_v5e_spec": round(tflops / 197 * 100, 1),
     }))
 

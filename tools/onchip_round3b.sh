@@ -1,0 +1,72 @@
+#!/bin/bash
+# Round-3 FOLLOW-UP on-chip session — run after onchip_round3.sh landed
+# the first measurements and the builder fixed what they exposed:
+#   - bench_hbm now measures + subtracts the tunnel dispatch RTT (the
+#     first run's 43.5 "TFLOP/s" was ~80 ms of RTT folded into a 4-iter
+#     chain) and adds a host->device transfer bandwidth row (the
+#     fed-window denominator).
+#   - The fused conv+BN / ln_matmul composites keep their Pallas forward
+#     (measured 1.0-2.5x over XLA) but default to the XLA backward
+#     (measured: the two-pass Pallas backward is 0.40-0.87x of XLA).
+#   - validate_fused_tpu gained a bench-shape compile/execute sweep (the
+#     r3 dw-kernel VMEM OOM shapes, caught only at batch-256 shapes).
+#   - bert/bert_dense_attn re-run: the first session's rows are CPU
+#     fallbacks (a concurrent builder process contended for the single
+#     device lease during the probe — operator error, see PERF_NOTES).
+# IMPORTANT: nothing else may touch JAX while this runs (single lease).
+# Usage: bash tools/onchip_round3b.sh [outdir]   (default /tmp/onchip_r3b)
+set -u
+OUT=${1:-/tmp/onchip_r3b}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+run() { # name timeout_s cmd...
+  local name=$1 t=$2; shift 2
+  echo "=== $name ($(date -u +%H:%M:%S)) ==="
+  timeout --signal=TERM --kill-after=60 "$t" "$@" \
+    >"$OUT/$name.log" 2>&1
+  local rc=$?
+  echo "    rc=$rc  tail:"
+  tail -3 "$OUT/$name.log" | sed 's/^/    /'
+  return $rc
+}
+
+run probe 180 python -u -c "
+import jax, jax.numpy as jnp
+print(jax.devices(), float(jax.jit(lambda a:(a@a).sum())(jnp.ones((256,256),jnp.bfloat16))))
+" || { echo 'relay down; aborting session'; exit 1; }
+
+# 1. corrected roofline: RTT-subtracted HBM/MXU + host->device bandwidth
+run hbm 900 env HBM_ITERS=64 python -u tools/bench_hbm.py
+
+# 2. validator incl. the new bench-shape compile/execute sweep
+run validate 1500 python -u tools/validate_fused_tpu.py
+
+# 3. flagship bench, fused blocks with the XLA backward (new default)
+run bench_fused_xlabwd 1200 python -u bench.py
+# fused blocks with the Pallas backward (the r3a regression, for the A/B)
+run bench_fused_pallasbwd 1200 env DTF_FUSED_BWD=pallas python -u bench.py
+run bench_standard 1200 env BENCH_BLOCK_IMPL=standard python -u bench.py
+
+# 4. the BERT/GPT suite the r3a session lost to the lease collision
+run bert 1200 python -u tools/bench_bert.py
+run bert_dense_attn 1200 env BENCH_ATTN=dense python -u tools/bench_bert.py
+run gpt_plain 1200 env BENCH_MODEL=gpt python -u tools/bench_bert.py
+run gpt_fused_ln 1200 env BENCH_MODEL=gpt BENCH_FUSED_LN=1 \
+  python -u tools/bench_bert.py
+run gpt_long4k 1500 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=8 \
+  BENCH_REMAT=1 python -u tools/bench_bert.py
+
+echo "=== session done; JSON lines: ==="
+grep -h '"metric"' "$OUT"/hbm.log "$OUT"/bench_*.log "$OUT"/bert*.log \
+  "$OUT"/gpt*.log 2>/dev/null
+echo "logs in $OUT"
+
+ART="$(dirname "$0")/../artifacts/onchip_r3"
+mkdir -p "$ART"
+for f in "$OUT"/*.log; do
+  cp "$f" "$ART/$(basename "$f" .log)_r3b.log" 2>/dev/null
+done
+grep -h '"metric"' "$OUT"/bench_fused_xlabwd.log 2>/dev/null | tail -1 \
+  > "$ART"/BENCH_LATEST.json || true
+echo "artifacts copied to $ART"
