@@ -31,21 +31,6 @@ def pick_block_m(M: int, k: int, n: int, *, name: str) -> int:
     )
 
 
-def pick_block_n(k: int, n: int, *, name: str) -> int:
-    """Output-column tile for the dw kernels: the [k, bn] f32 accumulator
-    stays resident, so k*bn*4 is capped. bn must divide n and be
-    lane-aligned (multiple of 128, or the whole dim)."""
-    for bn in (n, *range(2048, 127, -128)):
-        if bn > n or n % bn:
-            continue
-        if k * bn * 4 <= 4 * 1024 * 1024:
-            return bn
-    raise ValueError(
-        f"{name}: n={n} has no lane-aligned tile whose [k={k}, bn] f32 "
-        "accumulator fits VMEM; pad n to a multiple of 128"
-    )
-
-
 def _aligned_divisors(M: int, cap: int = 1024) -> list[int]:
     """8-aligned divisors of M up to ``cap`` (descending), with M itself
     as the fallback when no aligned divisor exists (Mosaic then pads the
@@ -95,7 +80,28 @@ def pick_dw_tiles(M: int, cin: int, cout: int, *, in_bytes: int,
                     continue
                 if tile_bytes(bm, bn) <= budget:
                     return bm, bn
+    if len(bms) == 1 and bms[0] == M and M % 8 != 0:
+        dim_hint = f"M={M} has no 8-aligned divisor <= 1024"
+    elif 3 * cin * 128 * 4 > budget:
+        # even the narrowest lane-aligned bn can't fit the [cin, bn]
+        # f32 accumulator — the problem is cin, not cout
+        dim_hint = f"cin={cin} is too wide for a resident f32 accumulator"
+    else:
+        dim_hint = f"cout={cout} may need padding to a multiple of 128"
     raise ValueError(
         f"{name}: no (bm, bn) tile for M={M}, cin={cin}, cout={cout} "
-        "fits the VMEM budget; pad cout to a multiple of 128"
+        f"fits the VMEM budget ({dim_hint})"
     )
+
+
+def resolve_bwd_impl(bwd_impl: str | None) -> str:
+    """The fused composites' backward selection policy (one home for the
+    env default so the two op families cannot drift): explicit argument
+    wins, else ``DTF_FUSED_BWD``, else the measured-faster "xla" path
+    (round-3 on-chip microbenches, PERF_NOTES.md)."""
+    import os
+
+    impl = bwd_impl or os.environ.get("DTF_FUSED_BWD", "xla")
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"bwd_impl must be 'xla' or 'pallas', got {impl!r}")
+    return impl

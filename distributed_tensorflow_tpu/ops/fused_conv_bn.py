@@ -49,7 +49,6 @@ def _pick_block_m(M: int, cin: int, cout: int) -> int:
     return _tiling.pick_block_m(M, cin, cout, name="fused conv1x1 kernel")
 
 
-
 _on_tpu = _tiling.on_tpu
 
 
@@ -302,12 +301,6 @@ def _xla_bwd(x, y, dy, w, scale, shift, dsum, dssq, *, prologue, relu,
     return dx, dw, dscale, dshift
 
 
-def _default_bwd_impl() -> str:
-    import os
-
-    return os.environ.get("DTF_FUSED_BWD", "xla")
-
-
 # ---------------------------------------------------------------------------
 # custom_vjp composite
 # ---------------------------------------------------------------------------
@@ -401,9 +394,7 @@ def conv1x1_bn_act(
         scale = scale.reshape(1, -1).astype(jnp.float32)
         shift = shift.reshape(1, -1).astype(jnp.float32)
     out_dtype = jnp.dtype(out_dtype or x.dtype)
-    bwd_impl = bwd_impl or _default_bwd_impl()
-    if bwd_impl not in ("xla", "pallas"):
-        raise ValueError(f"bwd_impl must be 'xla' or 'pallas', got {bwd_impl!r}")
+    bwd_impl = _tiling.resolve_bwd_impl(bwd_impl)
     op = _make_op(prologue, relu, emit_stats, out_dtype.name, bool(interpret),
                   bwd_impl)
     return op(x, w, scale, shift)

@@ -153,7 +153,6 @@ def _bwd_dx_call(x, gamma, w, dy, *, eps, interpret):
 # ---------------------------------------------------------------------------
 
 
-
 def _bwd_dw_kernel(x_ref, g_ref, b_ref, dy_ref, dw_ref, *, eps):
     x32 = x_ref[:].astype(jnp.float32)
     _, h = _ln(x32, g_ref[:], b_ref[:], eps)
@@ -228,12 +227,6 @@ def _xla_bwd(x, gamma, beta, w, dy, *, eps):
     return dx, dg, db, dw, dbias
 
 
-def _default_bwd_impl() -> str:
-    import os
-
-    return os.environ.get("DTF_FUSED_BWD", "xla")
-
-
 # ---------------------------------------------------------------------------
 # custom_vjp composite + reference
 # ---------------------------------------------------------------------------
@@ -299,9 +292,7 @@ def ln_matmul(
     if bias is None:
         bias = jnp.zeros((n,), jnp.float32)
     out_dtype = jnp.dtype(out_dtype or x.dtype)
-    bwd_impl = bwd_impl or _default_bwd_impl()
-    if bwd_impl not in ("xla", "pallas"):
-        raise ValueError(f"bwd_impl must be 'xla' or 'pallas', got {bwd_impl!r}")
+    bwd_impl = _tiling.resolve_bwd_impl(bwd_impl)
     op = _make_op(float(eps), out_dtype.name, bool(interpret), bwd_impl)
     return op(
         x,

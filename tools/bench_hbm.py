@@ -124,7 +124,10 @@ def main() -> None:
     # so this one number decides "tunnel artifact vs framework defect"
     # for the pipeline-fed efficiency rows (VERDICT r2 item 2).
     import numpy as _np
-    host_buf = _np.zeros((64 << 20,), _np.uint8)  # 64 MiB
+    # random bytes: a zeros buffer would let any compressing/deduping
+    # relay path transfer ~nothing and report compression, not bandwidth
+    host_buf = _np.random.default_rng(0).integers(
+        0, 256, 64 << 20, dtype=_np.uint8)  # 64 MiB
     jax.device_put(host_buf).block_until_ready()  # warm the path
     reps = 3
     t0 = time.perf_counter()

@@ -69,7 +69,7 @@ echo "logs in $OUT"
 # Preserve the evidence in-tree immediately (VERDICT r2 item 1: mid-round
 # artifacts, not end-of-round luck) — the session or relay may not
 # survive to a second chance. Committing is done by the operator/driver.
-ART="$(dirname "$0")/../artifacts/onchip_r3"
+ART="artifacts/onchip_r3"  # script already cd'd to the repo root
 mkdir -p "$ART"
 cp "$OUT"/*.log "$ART"/ 2>/dev/null
 grep -h '"metric"' "$OUT"/bench_fused.log 2>/dev/null | tail -1 \

@@ -62,7 +62,7 @@ grep -h '"metric"' "$OUT"/hbm.log "$OUT"/bench_*.log "$OUT"/bert*.log \
   "$OUT"/gpt*.log 2>/dev/null
 echo "logs in $OUT"
 
-ART="$(dirname "$0")/../artifacts/onchip_r3"
+ART="artifacts/onchip_r3"  # script already cd'd to the repo root
 mkdir -p "$ART"
 for f in "$OUT"/*.log; do
   cp "$f" "$ART/$(basename "$f" .log)_r3b.log" 2>/dev/null

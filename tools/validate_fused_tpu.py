@@ -267,6 +267,29 @@ def main():
                   f"grads_finite={fin}")
             ok &= good
 
+            # The Pallas backward is no longer the default, but its dw
+            # kernel is the component whose shape-dependent VMEM OOM this
+            # sweep exists to gate — compile it (AOT, no execution) so a
+            # pick_dw_tiles regression fails HERE, not mid-bench-session.
+            def pallas_bwd_loss(x, w, s, sh):
+                y, cs, cq = conv1x1_bn_act(x, w, s, sh, relu=True,
+                                           emit_stats=True,
+                                           bwd_impl="pallas")
+                return ((y.astype(jnp.float32) ** 2).mean()
+                        + cs.sum() * 1e-6 + cq.sum() * 1e-9)
+
+            try:
+                jax.jit(jax.value_and_grad(
+                    pallas_bwd_loss, argnums=(0, 1, 2, 3))).lower(
+                        bx, bw, bs, bsh).compile()
+                print(f"ok  bench-shape conv1x1 pallas-bwd compile "
+                      f"M={bM} {bci}->{bco}")
+            except Exception as e:  # noqa: BLE001 — report, don't abort
+                print(f"FAIL bench-shape conv1x1 pallas-bwd compile "
+                      f"M={bM} {bci}->{bco}: {type(e).__name__}: "
+                      f"{str(e)[:200]}")
+                ok = False
+
         ln_shapes = [  # bench_bert/gpt ln_matmul edges at bench batch
             (16384, 768, 2304), (16384, 768, 3072), (16384, 3072, 768),
             (32768, 1024, 4096),  # gpt long-context edge
@@ -292,6 +315,22 @@ def main():
                   f"M={bM} {bd}->{bn_}: loss={float(val):.3e} "
                   f"grads_finite={fin}")
             ok &= good
+
+            def ln_pallas_bwd_loss(x, g, b, w, bias):
+                y = ln_matmul(x, g, b, w, bias, bwd_impl="pallas")
+                return (y.astype(jnp.float32) ** 2).mean()
+
+            try:
+                jax.jit(jax.value_and_grad(
+                    ln_pallas_bwd_loss, argnums=(0, 1, 2, 3, 4))).lower(
+                        bx, bg, bb, bw, bbias).compile()
+                print(f"ok  bench-shape ln_matmul pallas-bwd compile "
+                      f"M={bM} {bd}->{bn_}")
+            except Exception as e:  # noqa: BLE001 — report, don't abort
+                print(f"FAIL bench-shape ln_matmul pallas-bwd compile "
+                      f"M={bM} {bd}->{bn_}: {type(e).__name__}: "
+                      f"{str(e)[:200]}")
+                ok = False
     else:
         print("skip bench-shape sweep (not on TPU; interpret mode would "
               "not exercise Mosaic VMEM limits)")
