@@ -73,6 +73,14 @@ def initialize(config: ClusterConfig | None = None) -> None:
         "JAX_COMPILATION_CACHE_DIR", ""
     )
     if cache_dir:
+        if jax.config.jax_compilation_cache_dir not in ("", None, cache_dir):
+            # the persistent-cache backend binds lazily to the FIRST dir
+            # it serves; if some earlier code (a test rig, a notebook)
+            # already warmed a cache elsewhere, reset so the configured
+            # dir actually takes effect for this process
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # cache even quick-compiling programs: resume-after-preemption
         # replays the whole startup, so every skipped compile counts.

@@ -89,7 +89,12 @@ def _parse_value(raw: str, ftype) -> Any:
         pass
     # tuples / lists / anything json-ish
     is_tuple = get_origin(ftype) is tuple or ftype is tuple
-    if is_tuple or get_origin(ftype) is list or ftype is list or raw[:1] in "[({":
+    # raw[:1] must be non-empty before the membership test: "" is a
+    # substring of every string, so a bare `--key=` (empty value, e.g.
+    # --checkpoint.directory= to disable) would wrongly take the strict
+    # JSON branch and crash instead of falling through to a raw string
+    if (is_tuple or get_origin(ftype) is list or ftype is list
+            or (raw[:1] and raw[:1] in "[({")):
         val = json.loads(raw)
         return tuple(val) if is_tuple else val
     # fall back on literal parse, then raw string

@@ -46,3 +46,13 @@ def mesh_dp4_tp2(devices):
     from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh
 
     return build_mesh(MeshSpec(data=4, model=2), devices[:8])
+
+
+# Persistent XLA compilation cache for the test rig: the fast tier is
+# dominated by CPU compile time (most tests compile in 2-8s and run in
+# ms), so warm reruns skip straight to execution. Keyed automatically by
+# jaxlib version + flags; delete the dir to force cold compiles.
+_cache_dir = os.environ.get("DTF_TEST_CACHE", "/tmp/dtf_test_xla_cache")
+if _cache_dir != "0":
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.05)

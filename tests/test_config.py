@@ -78,3 +78,15 @@ def test_optional_none_override():
     assert cfg.limit is None
     cfg = cfg_lib.apply_overrides(C(), ["limit=7"])
     assert cfg.limit == 7
+
+
+def test_empty_string_override():
+    """`--key=` (empty value) must parse as an empty string, not crash in
+    the JSON branch — it's the idiom for disabling a path-valued option
+    (e.g. --checkpoint.directory=)."""
+    from distributed_tensorflow_tpu.workloads import runner
+
+    cfg = cfg_lib.apply_overrides(
+        runner.RunConfig(), ["--checkpoint.directory="]
+    )
+    assert cfg.checkpoint.directory == ""

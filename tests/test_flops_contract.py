@@ -27,7 +27,9 @@ SHRINK = {
 }
 
 
-SLOW_PARAMS = {"resnet50_imagenet", "bert_pretrain"}  # 70s+/27s shapes
+SLOW_PARAMS = {"resnet50_imagenet", "bert_pretrain", "cifar10_cnn",
+               "wide_deep"}  # 70s+/27s/9s/7s shapes; mnist_mlp + gpt_lm
+               # keep the contract itself exercised in the fast tier
 
 
 @pytest.mark.parametrize("name", [

@@ -516,6 +516,7 @@ def test_pipelined_dropout_trains_and_grads_flow(devices):
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+@pytest.mark.slow
 def test_pipelined_composes_with_grad_accum(devices):
     """PP × ConditionalAccumulator-descendant: grad_accum_steps=2 through
     the pipelined loss must equal the accum=1 step on the same batch

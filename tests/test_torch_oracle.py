@@ -153,6 +153,7 @@ def torch_gpt_forward(params, ids, cfg):
     return x @ emb.T + _t(params["mlm_bias"])
 
 
+@pytest.mark.slow
 def test_flax_gpt_matches_independent_torch():
     """Pre-LN CAUSAL decoder vs the independent torch oracle — catches
     causal-mask offset/sign errors the flax twins share by construction."""

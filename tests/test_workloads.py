@@ -175,6 +175,7 @@ def test_eval_from_checkpoint_missing_dir_raises(tmp_path):
         ])
 
 
+@pytest.mark.slow
 def test_gpt_lm_workload_trains_and_long_context_preset():
     """The sixth workload: causal LM through the full runner; the
     long-context preset wires ring attention + remat + a seq-wildcard

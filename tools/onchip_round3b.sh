@@ -16,9 +16,9 @@
 # IMPORTANT: nothing else may touch JAX while this runs (single lease).
 # Usage: bash tools/onchip_round3b.sh [outdir]   (default /tmp/onchip_r3b)
 set -u
-OUT=${1:-/tmp/onchip_r3b}
-mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
+OUT=$(readlink -f "${1:-/tmp/onchip_r3b}")  # absolute: redirects below
+mkdir -p "$OUT"                             # must survive any later cd
 
 run() { # name timeout_s cmd...
   local name=$1 t=$2; shift 2
@@ -57,6 +57,25 @@ run gpt_fused_ln 1200 env BENCH_MODEL=gpt BENCH_FUSED_LN=1 \
 run gpt_long4k 1500 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=8 \
   BENCH_REMAT=1 python -u tools/bench_bert.py
 
+# 5. profile capture at bench config (fused fwd + XLA bwd): the XPlane
+#    trace that round-4 tuning reads. ~30 profiled steps, batch 256.
+rm -rf "$OUT/profile"   # never tar a stale prior session's trace
+run profile 1200 python -u examples/train.py resnet50_imagenet \
+  --train.num_steps=30 --train.profile=true \
+  --train.profile_dir="$OUT/profile" \
+  --model.norm_dtype=bfloat16 --model.stem=space_to_depth \
+  --model.block_impl=fused --data.global_batch_size=256 \
+  --data.image_size=224 --checkpoint.directory= \
+  --train.log_every=10
+tar -C "$OUT" -czf "$OUT/profile.tgz" profile 2>/dev/null \
+  && echo "    profile.tgz $(du -h "$OUT/profile.tgz" | cut -f1)"
+
+# 6. LAST (can stall, r3a microbench_grad rc=124): AOT-compile the
+#    non-default Pallas backward at every bench shape — "only" mode
+#    skips the parity suite + default sweep step 2 already ran
+run validate_pallas_bwd 1200 env VALIDATE_PALLAS_BWD=only \
+  python -u tools/validate_fused_tpu.py
+
 echo "=== session done; JSON lines: ==="
 grep -h '"metric"' "$OUT"/hbm.log "$OUT"/bench_*.log "$OUT"/bert*.log \
   "$OUT"/gpt*.log 2>/dev/null
@@ -67,6 +86,10 @@ mkdir -p "$ART"
 for f in "$OUT"/*.log; do
   cp "$f" "$ART/$(basename "$f" .log)_r3b.log" 2>/dev/null
 done
-grep -h '"metric"' "$OUT"/bench_fused_xlabwd.log 2>/dev/null | tail -1 \
-  > "$ART"/BENCH_LATEST.json || true
+cp "$OUT/profile.tgz" "$ART/profile_r3b.tgz" 2>/dev/null || true
+# only replace the preserved BENCH_LATEST.json when this session actually
+# produced a metric row (a truncating redirect would destroy the r3a row
+# exactly when the window dies early — the failure mode we're hedging)
+LATEST=$(grep -h '"metric"' "$OUT"/bench_fused_xlabwd.log 2>/dev/null | tail -1)
+[ -n "$LATEST" ] && printf '%s\n' "$LATEST" > "$ART"/BENCH_LATEST.json
 echo "artifacts copied to $ART"

@@ -61,9 +61,150 @@ def check_scaled(name, got, want, tol):
     return ok
 
 
+def bench_shape_sweep(r) -> bool:
+    """Compile/execute every fused-kernel shape the batch-256 ResNet-50
+    and bench BERT/GPT paths actually emit (TPU only).
+
+    Round-3 on-chip lesson: the dw kernel's VMEM footprint is
+    shape-dependent, and small-shape parity passed while the REAL bench
+    shape [12544, 512]x[12544, 2048] blew the 16 MB scoped limit at
+    compile time — this sweep is what makes the validator a gate for the
+    bench. Every check is exception-guarded: one bad shape must record
+    FAIL and keep sweeping, not abort a scarce chip window.
+
+    VALIDATE_PALLAS_BWD selects what runs:
+      "0" (default) — default-path (xla-backward) fwd+grad execute only;
+      "1"           — both the default path and the Pallas-backward
+                      AOT compiles;
+      "only"        — Pallas-backward AOT compiles alone (the late,
+                      may-stall step of onchip_round3b.sh; r3a saw a
+                      >10 min stall in this path at the s3_conv1 shape,
+                      microbench_grad rc=124).
+    """
+    from distributed_tensorflow_tpu.ops.fused_ln_matmul import ln_matmul
+
+    mode = os.environ.get("VALIDATE_PALLAS_BWD", "0")
+    run_default = mode in ("0", "1")
+    run_pallas = mode in ("1", "only")
+    if jax.default_backend() != "tpu":
+        print("skip bench-shape sweep (not on TPU; interpret mode would "
+              "not exercise Mosaic VMEM limits)")
+        return True
+    ok = True
+
+    def guarded(tag, fn):
+        nonlocal ok
+        try:
+            fn()
+            return True
+        except Exception as e:  # noqa: BLE001 — report, don't abort
+            print(f"FAIL {tag}: {type(e).__name__}: {str(e)[:200]}")
+            ok = False
+            return False
+
+    conv_shapes = [  # batch-256 ResNet-50 1x1 convs, all stages
+        (200704, 64, 256), (200704, 256, 64), (200704, 256, 128),
+        (50176, 128, 512), (50176, 512, 128), (50176, 512, 256),
+        (12544, 256, 1024), (12544, 1024, 256), (12544, 1024, 512),
+        (3136, 512, 2048), (3136, 2048, 512),
+        (12544, 512, 2048), (12544, 2048, 512),  # the r3 OOM shapes
+    ]
+    for (bM, bci, bco) in conv_shapes:
+        bx = jnp.asarray(r.randn(bM, bci) * 0.1, jnp.bfloat16)
+        bw = jnp.asarray(r.randn(bci, bco) * 0.05, jnp.bfloat16)
+        bs = jnp.asarray(r.rand(bci) + 0.5, jnp.float32)
+        bsh = jnp.asarray(r.randn(bci) * 0.1, jnp.float32)
+
+        def conv_loss(impl):
+            def go(x, w, s, sh):
+                y, cs, cq = conv1x1_bn_act(x, w, s, sh, relu=True,
+                                           emit_stats=True, bwd_impl=impl)
+                return ((y.astype(jnp.float32) ** 2).mean()
+                        + cs.sum() * 1e-6 + cq.sum() * 1e-9)
+            return go
+
+        if run_default:
+            def execute():
+                val, grads = jax.jit(jax.value_and_grad(
+                    conv_loss("xla"), argnums=(0, 1, 2, 3)))(
+                        bx, bw, bs, bsh)
+                fin = all(bool(jnp.all(jnp.isfinite(
+                    g.astype(jnp.float32)))) for g in grads)
+                if not (np.isfinite(float(val)) and fin):
+                    raise RuntimeError(
+                        f"loss={float(val)} grads_finite={fin}")
+                print(f"ok  bench-shape conv1x1 M={bM} {bci}->{bco}: "
+                      f"loss={float(val):.3e}")
+
+            guarded(f"bench-shape conv1x1 M={bM} {bci}->{bco}", execute)
+
+        if run_pallas:
+            def compile_pallas():
+                jax.jit(jax.value_and_grad(
+                    conv_loss("pallas"), argnums=(0, 1, 2, 3))).lower(
+                        bx, bw, bs, bsh).compile()
+                print(f"ok  bench-shape conv1x1 pallas-bwd compile "
+                      f"M={bM} {bci}->{bco}")
+
+            guarded(f"bench-shape conv1x1 pallas-bwd compile M={bM} "
+                    f"{bci}->{bco}", compile_pallas)
+
+    ln_shapes = [  # bench_bert/gpt ln_matmul edges at bench batch
+        (16384, 768, 2304), (16384, 768, 3072), (16384, 3072, 768),
+        (32768, 1024, 4096),  # gpt long-context edge
+    ]
+    for (bM, bd, bn_) in ln_shapes:
+        bx = jnp.asarray(r.randn(bM, bd) * 0.1, jnp.bfloat16)
+        bg = jnp.asarray(r.rand(bd) + 0.5, jnp.float32)
+        bb = jnp.asarray(r.randn(bd) * 0.1, jnp.float32)
+        bw = jnp.asarray(r.randn(bd, bn_) * 0.02, jnp.bfloat16)
+        bbias = jnp.asarray(r.randn(bn_) * 0.1, jnp.float32)
+
+        def ln_loss_of(impl):
+            def go(x, g, b, w, bias):
+                y = ln_matmul(x, g, b, w, bias, bwd_impl=impl)
+                return (y.astype(jnp.float32) ** 2).mean()
+            return go
+
+        if run_default:
+            def execute_ln():
+                val, grads = jax.jit(jax.value_and_grad(
+                    ln_loss_of("xla"), argnums=(0, 1, 2, 3, 4)))(
+                        bx, bg, bb, bw, bbias)
+                fin = all(bool(jnp.all(jnp.isfinite(
+                    g.astype(jnp.float32)))) for g in grads)
+                if not (np.isfinite(float(val)) and fin):
+                    raise RuntimeError(
+                        f"loss={float(val)} grads_finite={fin}")
+                print(f"ok  bench-shape ln_matmul M={bM} {bd}->{bn_}: "
+                      f"loss={float(val):.3e}")
+
+            guarded(f"bench-shape ln_matmul M={bM} {bd}->{bn_}",
+                    execute_ln)
+
+        if run_pallas:
+            def compile_ln_pallas():
+                jax.jit(jax.value_and_grad(
+                    ln_loss_of("pallas"), argnums=(0, 1, 2, 3, 4))).lower(
+                        bx, bg, bb, bw, bbias).compile()
+                print(f"ok  bench-shape ln_matmul pallas-bwd compile "
+                      f"M={bM} {bd}->{bn_}")
+
+            guarded(f"bench-shape ln_matmul pallas-bwd compile M={bM} "
+                    f"{bd}->{bn_}", compile_ln_pallas)
+
+    return ok
+
+
 def main():
     print("devices:", jax.devices(), flush=True)
     r = np.random.RandomState(0)
+    if os.environ.get("VALIDATE_PALLAS_BWD") == "only":
+        # the may-stall late step of a chip session: just the gated
+        # Pallas-backward compiles, no duplicate parity/default sweep
+        ok = bench_shape_sweep(r)
+        print("ALL OK" if ok else "FAILURES", flush=True)
+        raise SystemExit(0 if ok else 1)
     M, cin, cout = 512, 64, 128
     x = jnp.asarray(r.randn(M, cin), jnp.bfloat16)
     w = jnp.asarray(r.randn(cin, cout) * 0.1, jnp.bfloat16)
@@ -230,110 +371,7 @@ def main():
         ok &= compare_models(f"resnet fused-block [{rdt}]", loss_model(m_f),
                              loss_model(m_std), params, r_fwd, r_grad)
 
-    # ---- bench-shape compile/execute sweep (TPU only) -------------------
-    # Round 3 on-chip lesson: the dw kernel's VMEM footprint is
-    # shape-dependent, and small-shape parity passed while the REAL
-    # bench shape [12544, 512]x[12544, 2048] blew the 16 MB scoped limit
-    # at compile time. Every (M, cin, cout) a batch-256 ResNet-50 or the
-    # bench BERT/GPT ln_matmul path actually emits must compile and run
-    # a full fwd+grad here, or the validator is not a gate for the bench.
-    if jax.default_backend() == "tpu":
-        conv_shapes = [  # batch-256 ResNet-50 1x1 convs, all stages
-            (200704, 64, 256), (200704, 256, 64), (200704, 256, 128),
-            (50176, 128, 512), (50176, 512, 128), (50176, 512, 256),
-            (12544, 256, 1024), (12544, 1024, 256), (12544, 1024, 512),
-            (3136, 512, 2048), (3136, 2048, 512),
-            (12544, 512, 2048), (12544, 2048, 512),  # the r3 OOM shapes
-        ]
-        for (bM, bci, bco) in conv_shapes:
-            bx = jnp.asarray(r.randn(bM, bci) * 0.1, jnp.bfloat16)
-            bw = jnp.asarray(r.randn(bci, bco) * 0.05, jnp.bfloat16)
-            bs = jnp.asarray(r.rand(bci) + 0.5, jnp.float32)
-            bsh = jnp.asarray(r.randn(bci) * 0.1, jnp.float32)
-
-            def bench_loss(x, w, s, sh):
-                y, cs, cq = conv1x1_bn_act(x, w, s, sh, relu=True,
-                                           emit_stats=True)
-                return ((y.astype(jnp.float32) ** 2).mean()
-                        + cs.sum() * 1e-6 + cq.sum() * 1e-9)
-
-            val, grads = jax.jit(jax.value_and_grad(
-                bench_loss, argnums=(0, 1, 2, 3)))(bx, bw, bs, bsh)
-            fin = all(bool(jnp.all(jnp.isfinite(
-                g.astype(jnp.float32)))) for g in grads)
-            good = bool(np.isfinite(float(val))) and fin
-            print(f"{'ok ' if good else 'FAIL'} bench-shape conv1x1 "
-                  f"M={bM} {bci}->{bco}: loss={float(val):.3e} "
-                  f"grads_finite={fin}")
-            ok &= good
-
-            # The Pallas backward is no longer the default, but its dw
-            # kernel is the component whose shape-dependent VMEM OOM this
-            # sweep exists to gate — compile it (AOT, no execution) so a
-            # pick_dw_tiles regression fails HERE, not mid-bench-session.
-            def pallas_bwd_loss(x, w, s, sh):
-                y, cs, cq = conv1x1_bn_act(x, w, s, sh, relu=True,
-                                           emit_stats=True,
-                                           bwd_impl="pallas")
-                return ((y.astype(jnp.float32) ** 2).mean()
-                        + cs.sum() * 1e-6 + cq.sum() * 1e-9)
-
-            try:
-                jax.jit(jax.value_and_grad(
-                    pallas_bwd_loss, argnums=(0, 1, 2, 3))).lower(
-                        bx, bw, bs, bsh).compile()
-                print(f"ok  bench-shape conv1x1 pallas-bwd compile "
-                      f"M={bM} {bci}->{bco}")
-            except Exception as e:  # noqa: BLE001 — report, don't abort
-                print(f"FAIL bench-shape conv1x1 pallas-bwd compile "
-                      f"M={bM} {bci}->{bco}: {type(e).__name__}: "
-                      f"{str(e)[:200]}")
-                ok = False
-
-        ln_shapes = [  # bench_bert/gpt ln_matmul edges at bench batch
-            (16384, 768, 2304), (16384, 768, 3072), (16384, 3072, 768),
-            (32768, 1024, 4096),  # gpt long-context edge
-        ]
-        for (bM, bd, bn_) in ln_shapes:
-            bx = jnp.asarray(r.randn(bM, bd) * 0.1, jnp.bfloat16)
-            bg = jnp.asarray(r.rand(bd) + 0.5, jnp.float32)
-            bb = jnp.asarray(r.randn(bd) * 0.1, jnp.float32)
-            bw = jnp.asarray(r.randn(bd, bn_) * 0.02, jnp.bfloat16)
-            bbias = jnp.asarray(r.randn(bn_) * 0.1, jnp.float32)
-
-            def ln_bench_loss(x, g, b, w, bias):
-                y = ln_matmul(x, g, b, w, bias)
-                return (y.astype(jnp.float32) ** 2).mean()
-
-            val, grads = jax.jit(jax.value_and_grad(
-                ln_bench_loss, argnums=(0, 1, 2, 3, 4)))(
-                    bx, bg, bb, bw, bbias)
-            fin = all(bool(jnp.all(jnp.isfinite(
-                g.astype(jnp.float32)))) for g in grads)
-            good = bool(np.isfinite(float(val))) and fin
-            print(f"{'ok ' if good else 'FAIL'} bench-shape ln_matmul "
-                  f"M={bM} {bd}->{bn_}: loss={float(val):.3e} "
-                  f"grads_finite={fin}")
-            ok &= good
-
-            def ln_pallas_bwd_loss(x, g, b, w, bias):
-                y = ln_matmul(x, g, b, w, bias, bwd_impl="pallas")
-                return (y.astype(jnp.float32) ** 2).mean()
-
-            try:
-                jax.jit(jax.value_and_grad(
-                    ln_pallas_bwd_loss, argnums=(0, 1, 2, 3, 4))).lower(
-                        bx, bg, bb, bw, bbias).compile()
-                print(f"ok  bench-shape ln_matmul pallas-bwd compile "
-                      f"M={bM} {bd}->{bn_}")
-            except Exception as e:  # noqa: BLE001 — report, don't abort
-                print(f"FAIL bench-shape ln_matmul pallas-bwd compile "
-                      f"M={bM} {bd}->{bn_}: {type(e).__name__}: "
-                      f"{str(e)[:200]}")
-                ok = False
-    else:
-        print("skip bench-shape sweep (not on TPU; interpret mode would "
-              "not exercise Mosaic VMEM limits)")
+    ok &= bench_shape_sweep(r)
 
     print("ALL OK" if ok else "FAILURES", flush=True)
     raise SystemExit(0 if ok else 1)
