@@ -1,0 +1,77 @@
+"""Unit tests for the shared VMEM tile-selection model (ops/_tiling.py).
+
+The round-3 on-chip OOM (conv1x1_bn_bwd_dw at [12544, 512] x [12544,
+2048], 17.86 MB scoped stack vs the 16 MB core limit) is the regression
+these pin: the joint picker must keep its own accounting under budget
+for every shape the batch-256 ResNet-50 / bench transformer paths emit.
+"""
+
+import pytest
+
+from distributed_tensorflow_tpu.ops import _tiling
+
+# every 1x1-conv dw shape a batch-256 ResNet-50 emits + bench ln_matmul
+BENCH_SHAPES = [
+    (200704, 64, 256), (200704, 256, 64), (200704, 256, 128),
+    (50176, 128, 512), (50176, 512, 128), (50176, 512, 256),
+    (12544, 256, 1024), (12544, 1024, 256), (12544, 1024, 512),
+    (3136, 512, 2048), (3136, 2048, 512),
+    (12544, 512, 2048), (12544, 2048, 512),
+    (16384, 768, 2304), (16384, 768, 3072), (16384, 3072, 768),
+    (32768, 1024, 4096),
+]
+
+
+@pytest.mark.parametrize("M,cin,cout", BENCH_SHAPES)
+@pytest.mark.parametrize("emit_stats", [False, True])
+def test_bench_shapes_fit_and_divide(M, cin, cout, emit_stats):
+    bm, bn = _tiling.pick_dw_tiles(
+        M, cin, cout, in_bytes=2, emit_stats=emit_stats, name="t"
+    )
+    assert M % bm == 0 and cout % bn == 0
+    assert bm % 8 == 0 or bm == M
+    assert bn % 128 == 0 or bn == cout
+    # re-apply the picker's own accounting: chosen tile must be in budget
+    stream = 2 * (bm * cin * 2 + 2 * bm * bn * 2)
+    acc = 3 * cin * bn * 4
+    scratch = (2 if emit_stats else 1) * bm * bn * 4 + bm * cin * 4 + bm * cin * 2
+    assert stream + acc + scratch <= 13 * 1024 * 1024
+
+
+def test_r3_oom_shape_stays_under_scoped_limit():
+    """The exact shape that blew the 16 MB scoped limit on-chip: the
+    model's own upper bound for the chosen tile must leave real slack."""
+    bm, bn = _tiling.pick_dw_tiles(
+        12544, 512, 2048, in_bytes=2, emit_stats=True, name="t"
+    )
+    # the old independent-term picker chose (448, 2048) here -> 17.86 MB
+    assert (bm, bn) != (448, 2048)
+    assert bm * bn < 448 * 2048
+
+
+def test_prefers_wide_bm_then_wide_bn():
+    # comfortable shape: both dims should stay whole
+    bm, bn = _tiling.pick_dw_tiles(
+        1024, 128, 256, in_bytes=2, emit_stats=True, name="t"
+    )
+    assert bn == 256
+    assert bm >= 128
+
+
+def test_error_names_the_failing_dimension():
+    with pytest.raises(ValueError, match="M=12545"):
+        _tiling.pick_dw_tiles(12545, 4096, 8192, in_bytes=4,
+                              emit_stats=True, name="t")
+    with pytest.raises(ValueError, match="cin=2000000"):
+        _tiling.pick_dw_tiles(4096, 2000000, 128, in_bytes=2,
+                              emit_stats=True, name="t")
+
+
+def test_resolve_bwd_impl_policy(monkeypatch):
+    monkeypatch.delenv("DTF_FUSED_BWD", raising=False)
+    assert _tiling.resolve_bwd_impl(None) == "xla"
+    monkeypatch.setenv("DTF_FUSED_BWD", "pallas")
+    assert _tiling.resolve_bwd_impl(None) == "pallas"
+    assert _tiling.resolve_bwd_impl("xla") == "xla"  # explicit arg wins
+    with pytest.raises(ValueError, match="bwd_impl"):
+        _tiling.resolve_bwd_impl("cuda")
