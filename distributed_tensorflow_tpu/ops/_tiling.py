@@ -94,6 +94,48 @@ def pick_dw_tiles(M: int, cin: int, cout: int, *, in_bytes: int,
     )
 
 
+def pick_single_pass_bm(M: int, cin: int, cout: int, *, in_bytes: int,
+                        emit_stats: bool) -> int | None:
+    """Row tile for the SINGLE-PASS backward kernel (dx + dscale/dshift +
+    dw in one sweep over x/y/dy), or None when the shape cannot fit.
+
+    Motivation (round-3 on-chip): the two-pass Pallas backward streams
+    x/y/dy twice and measured 0.40-0.87x of XLA's fused backward; one
+    pass streams them once — structurally less HBM traffic than either.
+    The catch is VMEM: the whole [cin, cout] f32 dw accumulator (plus
+    its dot-product temp and the w operand) must stay resident alongside
+    the streamed tiles, so this only works for the narrower layer
+    shapes; which shapes qualify depends on dtype — in bf16 most
+    batch-256 ResNet-50 1x1s fit, in f32 the widest (512<->2048) do not.
+    This function IS the authority; never assume per-shape behavior
+    without calling it. Returns the largest 8-aligned bm >= 64 that fits
+    a conservative model; None means "use the two-pass kernels".
+
+    Model per tile: double-buffered streams (x, y, dy in; dx out);
+    resident w [cin, cout] + dw accumulator and dot temp (f32);
+    f32 scratch for g (and y when emit_stats), dh, x32, plus the
+    prologue temps (xn, relu mask, h — counted unconditionally, the
+    round-3 OOM was exactly an unmodeled-scratch miss) and the in-dtype
+    casts of h and g.
+    """
+    budget = 13 * 1024 * 1024
+    resident = (cin * cout * in_bytes          # w
+                + 2 * cin * cout * 4)          # dw accumulator + dot temp
+
+    def tile_bytes(bm: int) -> int:
+        stream = 2 * (2 * bm * cin * in_bytes + 2 * bm * cout * in_bytes)
+        scratch = ((2 if emit_stats else 1) * bm * cout * 4
+                   + 2 * bm * cin * 4
+                   + 3 * bm * cin * 4            # prologue xn/live/h f32
+                   + bm * cin * in_bytes + bm * cout * in_bytes)
+        return resident + stream + scratch
+
+    for bm in _aligned_divisors(M, cap=512):
+        if bm >= 64 and tile_bytes(bm) <= budget:
+            return bm
+    return None
+
+
 def resolve_bwd_impl(bwd_impl: str | None) -> str:
     """The fused composites' backward selection policy (one home for the
     env default so the two op families cannot drift): explicit argument

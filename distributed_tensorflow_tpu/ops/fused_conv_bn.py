@@ -256,6 +256,101 @@ def _bwd_dw_call(x, y, dy, scale, shift, dsum, dssq, *, prologue, relu,
 
 
 # ---------------------------------------------------------------------------
+# Backward B': single-pass dx + dscale/dshift + dw (one sweep over
+# x/y/dy — structurally half the HBM traffic of the two-pass pair; used
+# by bwd_impl="pallas" whenever the whole [cin, cout] f32 dw accumulator
+# fits VMEM, see _tiling.pick_single_pass_bm)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_single_kernel(*refs, prologue, relu, emit_stats):
+    if prologue:
+        (x_ref, y_ref, dy_ref, w_ref, scale_ref, shift_ref,
+         dsum_ref, dssq_ref,
+         dx_ref, dw_ref, dscale_ref, dshift_ref) = refs
+    else:
+        (x_ref, y_ref, dy_ref, w_ref, dsum_ref, dssq_ref,
+         dx_ref, dw_ref) = refs
+    g = dy_ref[:].astype(jnp.float32)
+    if emit_stats:
+        y = y_ref[:].astype(jnp.float32)
+        g = g + dsum_ref[:] + 2.0 * y * dssq_ref[:]
+    gq = g.astype(dy_ref.dtype)
+    dh = jax.lax.dot_general(
+        gq, w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+        if prologue:
+            dscale_ref[:] = jnp.zeros_like(dscale_ref)
+            dshift_ref[:] = jnp.zeros_like(dshift_ref)
+
+    x = x_ref[:].astype(jnp.float32)
+    if prologue:
+        xn = x * scale_ref[:] + shift_ref[:]
+        if relu:
+            live = (xn > 0.0).astype(jnp.float32)
+            dh = dh * live
+            h = jnp.maximum(xn, 0.0)
+        else:
+            h = xn
+        dx_ref[:] = (dh * scale_ref[:]).astype(dx_ref.dtype)
+        dscale_ref[:] += (dh * x).sum(0, keepdims=True)
+        dshift_ref[:] += dh.sum(0, keepdims=True)
+    else:
+        h = x
+        dx_ref[:] = dh.astype(dx_ref.dtype)
+    dw_ref[:] += jax.lax.dot_general(
+        h.astype(x_ref.dtype), gq,
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _bwd_single_call(x, y, dy, w, scale, shift, dsum, dssq, bm, *,
+                     prologue, relu, emit_stats, interpret):
+    M, cin = x.shape
+    cout = w.shape[1]
+    kernel = functools.partial(
+        _bwd_single_kernel, prologue=prologue, relu=relu,
+        emit_stats=emit_stats,
+    )
+    row = lambda bq, cq: pl.BlockSpec((bq, cq), lambda i: (i, 0))
+    const = lambda r, cq: pl.BlockSpec((r, cq), lambda i: (0, 0))
+    in_specs = [row(bm, cin), row(bm, cout), row(bm, cout),
+                const(cin, cout)]
+    inputs = [x, y, dy, w]
+    if prologue:
+        in_specs += [const(1, cin), const(1, cin)]
+        inputs += [scale, shift]
+    in_specs += [const(1, cout), const(1, cout)]
+    inputs += [dsum, dssq]
+    out_specs = [row(bm, cin), const(cin, cout)]
+    out_shape = [jax.ShapeDtypeStruct((M, cin), x.dtype),
+                 jax.ShapeDtypeStruct((cin, cout), jnp.float32)]
+    if prologue:
+        out_specs += [const(1, cin), const(1, cin)]
+        out_shape += [jax.ShapeDtypeStruct((1, cin), jnp.float32)] * 2
+    out = pl.pallas_call(
+        kernel,
+        grid=(M // bm,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+        name="conv1x1_bn_bwd_fused",
+    )(*inputs)
+    if prologue:
+        dx, dw, dscale, dshift = out
+        return dx, dw, dscale[0], dshift[0]
+    dx, dw = out
+    return dx, dw, None, None
+
+
+# ---------------------------------------------------------------------------
 # Backward C: the XLA-math backward (round-3 default)
 # ---------------------------------------------------------------------------
 
@@ -343,14 +438,27 @@ def _make_op(prologue, relu, emit_stats, out_dtype, interpret, bwd_impl):
             )
             dw = dw.astype(w.dtype)
         else:
-            dx, dscale, dshift = _bwd_dx_call(
-                x, y, dy, w, scale, shift, dsum, dssq, prologue=prologue,
-                relu=relu, emit_stats=emit_stats, interpret=interpret,
+            bm1 = _tiling.pick_single_pass_bm(
+                x.shape[0], x.shape[1], w.shape[1],
+                in_bytes=x.dtype.itemsize, emit_stats=emit_stats,
             )
-            dw = _bwd_dw_call(
-                x, y, dy, scale, shift, dsum, dssq, prologue=prologue,
-                relu=relu, emit_stats=emit_stats, interpret=interpret,
-            ).astype(w.dtype)
+            if bm1 is not None:
+                dx, dw, dscale, dshift = _bwd_single_call(
+                    x, y, dy, w, scale, shift, dsum, dssq, bm1,
+                    prologue=prologue, relu=relu, emit_stats=emit_stats,
+                    interpret=interpret,
+                )
+                dw = dw.astype(w.dtype)
+            else:
+                dx, dscale, dshift = _bwd_dx_call(
+                    x, y, dy, w, scale, shift, dsum, dssq,
+                    prologue=prologue, relu=relu, emit_stats=emit_stats,
+                    interpret=interpret,
+                )
+                dw = _bwd_dw_call(
+                    x, y, dy, scale, shift, dsum, dssq, prologue=prologue,
+                    relu=relu, emit_stats=emit_stats, interpret=interpret,
+                ).astype(w.dtype)
         if prologue:
             return dx, dw, dscale.reshape(scale.shape), dshift.reshape(shift.shape)
         return dx, dw, jnp.zeros_like(scale), jnp.zeros_like(shift)

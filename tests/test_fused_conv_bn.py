@@ -48,12 +48,25 @@ def test_forward_matches_reference(prologue, relu, emit_stats):
 
 
 @pytest.mark.parametrize("prologue", [False, True])
-@pytest.mark.parametrize("bwd_impl", ["xla", "pallas"])
-def test_gradients_match_reference(prologue, bwd_impl):
+@pytest.mark.parametrize("bwd_impl,shape", [
+    ("xla", (48, 24, 40)),
+    ("pallas", (48, 24, 40)),    # tiny: two-pass fallback (bm < 64)
+    ("pallas", (256, 32, 48)),   # larger: the single-pass kernel
+], ids=["xla", "pallas-two-pass", "pallas-single-pass"])
+def test_gradients_match_reference(prologue, bwd_impl, shape):
     """Full-pathway gradient check: the loss consumes y AND the emitted
     stats (through moments, like the next BN does), so the stats-output
-    cotangent path into dy is exercised."""
-    x, w, scale, shift = _mk(M=48, cin=24, cout=40)
+    cotangent path into dy is exercised. The two pallas shapes route to
+    the two-pass pair vs the single-pass kernel respectively — asserted
+    against the picker so the ids stay honest."""
+    from distributed_tensorflow_tpu.ops import _tiling
+
+    M, cin, cout = shape
+    single = _tiling.pick_single_pass_bm(
+        M, cin, cout, in_bytes=4, emit_stats=True) is not None
+    assert single == (shape == (256, 32, 48))
+
+    x, w, scale, shift = _mk(M=M, cin=cin, cout=cout)
 
     def loss(fn):
         def go(x, w, scale, shift):
@@ -114,3 +127,5 @@ def test_moments_and_affine_helpers_match_batchnorm():
     want = (y - mean) * gamma * jax.lax.rsqrt(var + 1e-5) + beta
     np.testing.assert_allclose(np.asarray(y * scale + shift),
                                np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
