@@ -373,8 +373,8 @@ def flash_attention(
     causal: bool = False,
     kv_mask: jax.Array | None = None,
     sm_scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """FlashAttention on TPU via Pallas. Same contract as
@@ -387,6 +387,17 @@ def flash_attention(
     block sizes (callers pad + pass kv_mask; models/transformer.py does)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
+    if block_q is None:
+        # env-tunable so on-chip sessions can sweep tile sizes without a
+        # code change (DTF_FLASH_BLOCK_Q/K); 128x128 is the safe default,
+        # larger K tiles cut grid overhead at long seq once measured
+        import os
+
+        block_q = int(os.environ.get("DTF_FLASH_BLOCK_Q", "128"))
+    if block_k is None:
+        import os
+
+        block_k = int(os.environ.get("DTF_FLASH_BLOCK_K", "128"))
     block_q = min(block_q, Sq)
     block_k = min(block_k, Sk)
     if Sq % block_q or Sk % block_k:
