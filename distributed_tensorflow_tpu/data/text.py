@@ -53,6 +53,48 @@ def resolved_max_predictions(cfg: TextDataConfig) -> int:
     return K
 
 
+def mlm_mask_batch(tokens: np.ndarray, cfg: TextDataConfig,
+                   rng: np.random.RandomState) -> dict[str, np.ndarray]:
+    """BERT-style MLM corruption of a [B, S] token batch: 80% [MASK] /
+    10% random / 10% keep, emitting either the gathered-head format
+    (masked_positions/masked_labels, the reference's masked_lm_positions
+    shape) or dense [B, S] labels with IGNORE_INDEX, per
+    ``resolved_max_predictions``. One definition shared by the synthetic
+    and real-corpus (token-file) MLM streams."""
+    K = resolved_max_predictions(cfg)
+    if K > 0:
+        # gathered-head format: exactly K positions per example,
+        # sampled without replacement (argsort of uniform noise)
+        positions = np.argsort(
+            rng.rand(*tokens.shape), axis=1
+        )[:, :K].astype(np.int32)
+        positions.sort(axis=1)
+        masked = np.zeros(tokens.shape, bool)
+        np.put_along_axis(masked, positions, True, axis=1)
+    else:
+        masked = rng.rand(*tokens.shape) < cfg.mask_prob
+    u = rng.rand(*tokens.shape)
+    inputs = tokens.copy()
+    # 80% -> [MASK], 10% -> random token, 10% -> keep
+    inputs[masked & (u < 0.8)] = cfg.mask_token
+    rand_tok = rng.randint(0, cfg.vocab_size, tokens.shape)
+    inputs[masked & (u >= 0.8) & (u < 0.9)] = rand_tok[
+        masked & (u >= 0.8) & (u < 0.9)
+    ]
+    if K > 0:
+        return {
+            "input_ids": inputs.astype(np.int32),
+            "masked_positions": positions,
+            "masked_labels": np.take_along_axis(
+                tokens, positions, axis=1).astype(np.int32),
+        }
+    labels = np.where(masked, tokens, IGNORE_INDEX)
+    return {
+        "input_ids": inputs.astype(np.int32),
+        "labels": labels.astype(np.int32),
+    }
+
+
 class SyntheticMLM:
     """Learnable synthetic MLM: positions alternate (free, determined) —
     token at odd index = perm[token at even index]. A masked odd token is
@@ -85,39 +127,7 @@ class SyntheticMLM:
         index += self.index_offset
         rng = batch_rng(cfg.seed, index)
         tokens = self._tokens(rng)
-
-        K = resolved_max_predictions(cfg)
-        if K > 0:
-            # gathered-head format: exactly K positions per example,
-            # sampled without replacement (argsort of uniform noise)
-            positions = np.argsort(
-                rng.rand(*tokens.shape), axis=1
-            )[:, :K].astype(np.int32)
-            positions.sort(axis=1)
-            masked = np.zeros(tokens.shape, bool)
-            np.put_along_axis(masked, positions, True, axis=1)
-        else:
-            masked = rng.rand(*tokens.shape) < cfg.mask_prob
-        u = rng.rand(*tokens.shape)
-        inputs = tokens.copy()
-        # 80% -> [MASK], 10% -> random token, 10% -> keep
-        inputs[masked & (u < 0.8)] = cfg.mask_token
-        rand_tok = rng.randint(0, cfg.vocab_size, tokens.shape)
-        inputs[masked & (u >= 0.8) & (u < 0.9)] = rand_tok[
-            masked & (u >= 0.8) & (u < 0.9)
-        ]
-        if K > 0:
-            return {
-                "input_ids": inputs.astype(np.int32),
-                "masked_positions": positions,
-                "masked_labels": np.take_along_axis(
-                    tokens, positions, axis=1).astype(np.int32),
-            }
-        labels = np.where(masked, tokens, IGNORE_INDEX)
-        return {
-            "input_ids": inputs.astype(np.int32),
-            "labels": labels.astype(np.int32),
-        }
+        return mlm_mask_batch(tokens, cfg, rng)
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         i = 0
@@ -175,23 +185,48 @@ class TokenFileLM:
         self.index_offset = index_offset
         self.local_bs = local_batch_size(cfg.global_batch_size)
 
-    def batch(self, index: int) -> dict[str, np.ndarray]:
+    def _windows(self, index: int) -> np.ndarray:
+        """[local_bs, seq_len] token windows for global batch ``index``.
+
+        The RNG here is deliberately host-AGREED (seed+index, no process
+        fold): every host draws the same global start list and takes its
+        disjoint stride slice — the per-host disjointness lives in the
+        slicing, not the seed."""
         import jax
 
         cfg = self.cfg
-        index += self.index_offset
-        n_windows = (len(self.tokens) - 1) // cfg.seq_len
         rng = np.random.RandomState((cfg.seed + index) & 0x7FFFFFFF)
+        n_windows = (len(self.tokens) - 1) // cfg.seq_len
         starts = rng.randint(0, n_windows, self.local_bs * jax.process_count())
         starts = starts[jax.process_index():: jax.process_count()] * cfg.seq_len
-        ids = np.stack([self.tokens[s : s + cfg.seq_len] for s in starts])
-        return {"input_ids": ids.astype(np.int32)}
+        return np.stack([self.tokens[s : s + cfg.seq_len] for s in starts])
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        index += self.index_offset
+        return {"input_ids": self._windows(index).astype(np.int32)}
 
     def __iter__(self):
         i = 0
         while self.num_batches is None or i < self.num_batches:
             yield self.batch(i)
             i += 1
+
+
+class TokenFileMLM(TokenFileLM):
+    """MLM batches over a real tokenized corpus — the reference BERT's
+    TFRecord masked_lm_positions pipeline, rebuilt over a flat .npy token
+    file (tools/make_token_file.py converts raw text offline). Window
+    sampling is TokenFileLM's; corruption and output format (gathered
+    positions or dense labels) are the shared ``mlm_mask_batch``."""
+
+    def batch(self, index: int) -> dict[str, np.ndarray]:
+        index += self.index_offset
+        tokens = self._windows(index).astype(np.int64)
+        # masking noise must be host-DISJOINT (unlike the window draws):
+        # batch_rng folds process_index so each host corrupts its slice
+        # independently — the pipeline.py seeding discipline
+        return mlm_mask_batch(tokens, self.cfg,
+                              batch_rng(self.cfg.seed, index))
 
 
 def make_text_dataset(cfg: TextDataConfig, num_batches: int | None = None,
@@ -202,4 +237,7 @@ def make_text_dataset(cfg: TextDataConfig, num_batches: int | None = None,
         return SyntheticLM(cfg, num_batches, index_offset)
     if cfg.dataset.startswith("tokens:"):
         return TokenFileLM(cfg.dataset[7:], cfg, num_batches, index_offset)
+    if cfg.dataset.startswith("tokens_mlm:"):
+        return TokenFileMLM(cfg.dataset[11:], cfg, num_batches,
+                            index_offset)
     raise ValueError(f"Unknown text dataset '{cfg.dataset}'")

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Tokenize raw text into the flat .npy token file the text datasets read
+(`--data.dataset=tokens:<path.npy>` for causal LM, `tokens_mlm:<path.npy>`
+for BERT MLM pretraining — data/text.py TokenFileLM/TokenFileMLM).
+
+The reference's BERT consumed TFRecords produced by an offline
+create_pretraining_data step (SURVEY.md §2a input-pipeline row); this is
+that step for this framework, kept zero-dependency/zero-egress:
+
+  wordpiece  greedy longest-match-first WordPiece over a LOCAL vocab.txt
+             (the standard BERT vocab format, one token per line, ##
+             continuation prefix) — byte-identical to the reference's
+             tokenizer on the same vocab for whitespace-clean ASCII;
+             basic-tokenization (lowercase, punctuation split) included.
+  bytes      UTF-8 bytes + specials (vocab 256+5) — no vocab file needed;
+             pair with --model.vocab_size=261.
+
+Usage:
+  python tools/make_token_file.py OUT.npy FILE [FILE...] \
+      [--tokenizer=wordpiece --vocab=vocab.txt | --tokenizer=bytes]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import unicodedata
+
+import numpy as np
+
+# byte tokenizer specials (above the 256 byte values)
+BYTE_PAD, BYTE_UNK, BYTE_CLS, BYTE_SEP, BYTE_MASK = 256, 257, 258, 259, 260
+BYTE_VOCAB = 261
+
+
+def _basic_tokens(text: str, lowercase: bool = True):
+    """BERT BasicTokenizer: whitespace-clean, lowercase+strip accents,
+    split punctuation into standalone tokens."""
+    if lowercase:
+        text = text.lower()
+        text = unicodedata.normalize("NFD", text)
+        text = "".join(c for c in text if unicodedata.category(c) != "Mn")
+    out, word = [], []
+    for ch in text:
+        if ch.isspace():
+            if word:
+                out.append("".join(word))
+                word = []
+        elif (unicodedata.category(ch).startswith("P")
+              or (33 <= ord(ch) <= 47) or (58 <= ord(ch) <= 64)
+              or (91 <= ord(ch) <= 96) or (123 <= ord(ch) <= 126)):
+            if word:
+                out.append("".join(word))
+                word = []
+            out.append(ch)
+        else:
+            word.append(ch)
+    if word:
+        out.append("".join(word))
+    return out
+
+
+class WordPiece:
+    def __init__(self, vocab_path: str, lowercase: bool = True):
+        with open(vocab_path, encoding="utf-8") as f:
+            self.vocab = {line.rstrip("\n"): i for i, line in enumerate(f)}
+        if not self.vocab:
+            raise SystemExit(f"empty vocab file: {vocab_path}")
+        if "[UNK]" not in self.vocab:
+            raise SystemExit(
+                f"{vocab_path} has no [UNK] entry — unknown words would "
+                "silently map to id 0; fix the vocab file")
+        self.unk = self.vocab["[UNK]"]
+        self.lowercase = lowercase
+
+    def encode(self, text: str) -> list[int]:
+        ids = []
+        for word in _basic_tokens(text, self.lowercase):
+            if word in self.vocab:
+                ids.append(self.vocab[word])
+                continue
+            # greedy longest-match-first with ## continuations
+            start, pieces, bad = 0, [], False
+            while start < len(word):
+                end = len(word)
+                cur = None
+                while start < end:
+                    sub = word[start:end]
+                    if start > 0:
+                        sub = "##" + sub
+                    if sub in self.vocab:
+                        cur = self.vocab[sub]
+                        break
+                    end -= 1
+                if cur is None:
+                    bad = True
+                    break
+                pieces.append(cur)
+                start = end
+            ids.extend([self.unk] if bad else pieces)
+        return ids
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out")
+    ap.add_argument("files", nargs="+")
+    ap.add_argument("--tokenizer", choices=("wordpiece", "bytes"),
+                    default="bytes")
+    ap.add_argument("--vocab", default=None,
+                    help="vocab.txt for --tokenizer=wordpiece")
+    ap.add_argument("--no-lowercase", action="store_true")
+    args = ap.parse_args()
+
+    if args.tokenizer == "wordpiece":
+        if not args.vocab:
+            raise SystemExit("--tokenizer=wordpiece requires --vocab")
+        enc = WordPiece(args.vocab, lowercase=not args.no_lowercase)
+        encode = enc.encode
+        vocab_size = len(enc.vocab)
+        mask_hint = enc.vocab.get("[MASK]", "<set manually>")
+    else:
+        def encode(text: str) -> np.ndarray:
+            # frombuffer, not a Python int list: one object per byte
+            # would cost ~30-60x the corpus size in RAM on big files
+            return np.frombuffer(
+                text.encode("utf-8"), np.uint8).astype(np.int32)
+        vocab_size = BYTE_VOCAB
+        mask_hint = BYTE_MASK
+
+    all_ids: list[np.ndarray] = []
+    total = 0
+    for path in args.files:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            ids = encode(f.read())
+        all_ids.append(np.asarray(ids, np.int32))
+        total += len(ids)
+        print(f"{path}: {len(ids)} tokens", file=sys.stderr)
+    tokens = np.concatenate(all_ids) if all_ids else np.empty(0, np.int32)
+    np.save(args.out, tokens)
+    print(f"wrote {args.out}: {total} tokens, tokenizer={args.tokenizer}, "
+          f"vocab_size={vocab_size}")
+    print("train (BERT MLM): --data.dataset=tokens_mlm:" + args.out
+          + f" --data.vocab_size={vocab_size} --model.vocab_size="
+          f"{vocab_size} --data.mask_token={mask_hint}", file=sys.stderr)
+    print("train (causal LM): --data.dataset=tokens:" + args.out
+          + f" --data.vocab_size={vocab_size} --model.vocab_size="
+          f"{vocab_size}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,8 @@ run bench_standard 1200 env BENCH_BLOCK_IMPL=standard python -u bench.py
 
 # 4. the BERT/GPT suite the r3a session lost to the lease collision
 run bert 1200 python -u tools/bench_bert.py
+run bert_wide_flash 1200 env DTF_FLASH_BLOCK_Q=256 DTF_FLASH_BLOCK_K=512 \
+  python -u tools/bench_bert.py
 run bert_dense_attn 1200 env BENCH_ATTN=dense python -u tools/bench_bert.py
 run gpt_plain 1200 env BENCH_MODEL=gpt python -u tools/bench_bert.py
 run gpt_fused_ln 1200 env BENCH_MODEL=gpt BENCH_FUSED_LN=1 \
