@@ -1,10 +1,17 @@
-"""Synthetic CTR stream for the Wide&Deep workload (BASELINE.json:11).
+"""CTR streams for the Wide&Deep workload (BASELINE.json:11).
 
-Same design as pipeline.SyntheticClassification: a fixed random teacher
-(per-feature embedding tables + linear head) labels clicks, so loss/AUC
-curves are meaningful without dataset files; per-host disjoint via
-process_index folded into the per-batch seed; Zipf-ish id draws so
-mod-sharded tables see realistic hot-id skew (SURVEY.md §7 M9).
+- SyntheticCTR: same design as pipeline.SyntheticClassification — a
+  fixed random teacher (per-feature embedding tables + linear head)
+  labels clicks, so loss/AUC curves are meaningful without dataset
+  files; per-host disjoint via process_index folded into the per-batch
+  seed; Zipf-ish id draws so mod-sharded tables see realistic hot-id
+  skew (SURVEY.md §7 M9).
+- CTRRecordDataset: real data over fixed-size binary records
+  (label f32 | dense f32xD | cat i32xF per record) riding the NATIVE
+  record loader (runtime/loader.py — threaded shuffle/shard/assembly in
+  C++ with the bit-identical Python fallback). tools/make_ctr_records.py
+  converts Criteo-format TSV into this layout; this is the reference
+  Wide&Deep's real-CTR input path, PS-free.
 """
 
 from __future__ import annotations
@@ -69,3 +76,101 @@ class SyntheticCTR:
         while self.num_batches is None or i < self.num_batches:
             yield self.batch(i)
             i += 1
+
+
+def ctr_record_dtype(dense_features: int, n_cat: int) -> np.dtype:
+    """One fixed-size record: label f32 | dense f32 x D | cat i32 x F —
+    4-byte little-endian fields so the record length is static and the
+    native fixed-record loader can mmap/stride it."""
+    return np.dtype([
+        ("label", "<f4"),
+        ("dense", "<f4", (dense_features,)),
+        ("cat", "<i4", (n_cat,)),
+    ])
+
+
+def make_ctr_record_file(path: str, label: np.ndarray, dense: np.ndarray,
+                         cat: np.ndarray) -> int:
+    """Write [N] label / [N, D] dense / [N, F] cat as a CTR record file
+    (test/tooling writer — real datasets convert offline via
+    tools/make_ctr_records.py). Returns N."""
+    N, D = dense.shape
+    F = cat.shape[1]
+    arr = np.empty(N, ctr_record_dtype(D, F))
+    arr["label"] = np.asarray(label, np.float32)
+    arr["dense"] = np.asarray(dense, np.float32)
+    arr["cat"] = np.asarray(cat, np.int32)
+    arr.tofile(path)
+    return N
+
+
+class CTRRecordDataset:
+    """{"cat" i32 [B,F], "dense" f32 [B,D], "label" f32 [B]} batches from
+    a CTR record file through the native loader: deterministic epoch
+    shuffle (SplitMix64 Fisher-Yates, identical bits native/Python),
+    per-host disjoint stride shards, resume via ``index_offset``.
+    Out-of-range ids clip to the configured vocab (defensive: the file
+    may have been hashed to a larger vocab than the model's)."""
+
+    def __init__(self, path: str, cfg: RecsysConfig,
+                 num_batches: int | None = None, index_offset: int = 0,
+                 seed: int | None = None):
+        import jax
+
+        from ..runtime.loader import RecordFileLoader
+
+        self.cfg = cfg
+        self._dt = ctr_record_dtype(cfg.dense_features,
+                                    len(cfg.vocab_sizes))
+        self._vocab = np.asarray(cfg.vocab_sizes, np.int32)
+        self._validate_layout(path)
+        self.loader = RecordFileLoader(
+            path, self._dt.itemsize,
+            local_batch_size(cfg.global_batch_size),
+            seed=cfg.seed if seed is None else seed,
+            shard=jax.process_index(),
+            n_shards=jax.process_count(), start_batch=index_offset,
+            num_batches=num_batches, decode=self._decode,
+        )
+
+    def _validate_layout(self, path: str) -> None:
+        """A record-layout mismatch (model config vs converter output)
+        would otherwise train silently on misaligned bytes — labels
+        become arbitrary floats and the id clip hides it. Two guards:
+        the converter's sidecar (authoritative when present), and the
+        file size must be a whole number of records either way."""
+        import json
+        import os
+
+        meta_path = path + ".meta.json"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            want = (meta.get("dense_features"), len(meta.get(
+                "vocab_sizes", [])), meta.get("record_bytes"))
+            have = (self.cfg.dense_features, len(self.cfg.vocab_sizes),
+                    self._dt.itemsize)
+            if want != have:
+                raise ValueError(
+                    f"{path}: layout mismatch — file has dense/cat/bytes "
+                    f"{want} (from {meta_path}) but the model config "
+                    f"implies {have}; set --model.dense_features/"
+                    f"--model.vocab_sizes to match the converter output")
+        size = os.path.getsize(path)
+        if size % self._dt.itemsize:
+            raise ValueError(
+                f"{path}: {size} bytes is not a whole number of "
+                f"{self._dt.itemsize}-byte records — wrong "
+                f"dense_features/vocab_sizes for this file?")
+
+    def _decode(self, raw: np.ndarray) -> dict[str, np.ndarray]:
+        rec = np.ascontiguousarray(raw).reshape(-1).view(self._dt)
+        cat = np.minimum(np.maximum(rec["cat"], 0), self._vocab - 1)
+        return {
+            "cat": np.ascontiguousarray(cat),
+            "dense": np.ascontiguousarray(rec["dense"]),
+            "label": np.ascontiguousarray(rec["label"]),
+        }
+
+    def __iter__(self):
+        return iter(self.loader)

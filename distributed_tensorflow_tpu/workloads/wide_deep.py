@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 
 from ..data import DataConfig
-from ..data.recsys import RecsysConfig, SyntheticCTR
+from ..data.recsys import CTRRecordDataset, RecsysConfig, SyntheticCTR
 from ..models import wide_deep as wd
 from ..parallel import MeshSpec
 from ..train import OptimizerConfig
@@ -63,6 +63,28 @@ def _canonical_tx(cfg: RunConfig):
     )
 
 
+def _dataset_fn(cfg: RunConfig, rcfg: RecsysConfig):
+    ds = cfg.data.dataset
+    if ds.startswith("ctr:"):
+        # real CTR records (tools/make_ctr_records.py) via the native
+        # fixed-record loader; synthetic stays the default teacher stream
+        return lambda start: CTRRecordDataset(
+            ds[4:], rcfg, index_offset=start)
+    return lambda start: SyntheticCTR(rcfg, index_offset=start)
+
+
+def _eval_dataset_fn(cfg: RunConfig, rcfg: RecsysConfig):
+    ds = cfg.data.dataset
+    if ds.startswith("ctr:"):
+        # distinct shuffle seed: with the training seed, eval batches
+        # 0..n-1 would be byte-identical to the FIRST-trained batches
+        # (pure memorization signal). A held-out file via a separate
+        # eval run remains the right way to measure generalization.
+        return lambda n: CTRRecordDataset(
+            ds[4:], rcfg, num_batches=n, seed=rcfg.seed + 101)
+    return lambda n: SyntheticCTR(rcfg, n, index_offset=10**6)
+
+
 def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
     model = wd.WideDeep(cfg.model, mesh)
     rcfg = _recsys_cfg(cfg)
@@ -71,8 +93,8 @@ def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
         init_fn=wd.make_init_fn(cfg.model, mesh),
         loss_fn=wd.ctr_loss_fn(model),
         eval_fn=wd.ctr_eval_fn(model),
-        dataset_fn=lambda start: SyntheticCTR(rcfg, index_offset=start),
-        eval_dataset_fn=lambda n: SyntheticCTR(rcfg, n, index_offset=10**6),
+        dataset_fn=_dataset_fn(cfg, rcfg),
+        eval_dataset_fn=_eval_dataset_fn(cfg, rcfg),
         flops_per_step=wd.flops_per_example(cfg.model)
         * cfg.data.global_batch_size,
         param_rules=wd.embedding_rules(),
