@@ -42,10 +42,14 @@ run hbm 900 env HBM_ITERS=64 python -u tools/bench_hbm.py
 # 2. validator incl. the new bench-shape compile/execute sweep
 run validate 1500 python -u tools/validate_fused_tpu.py
 
-# 3. flagship bench, fused blocks with the XLA backward (new default)
-run bench_fused_xlabwd 1200 python -u bench.py
-# fused blocks with the Pallas backward (the r3a regression, for the A/B)
-run bench_fused_pallasbwd 1200 env DTF_FUSED_BWD=pallas python -u bench.py
+# 3. flagship bench. Unpinned bench.py now A/Bs fused-vs-standard
+#    itself and reports the faster (the driver's end-of-round behavior);
+#    the explicit rows below pin BENCH_BLOCK_IMPL so each label is
+#    guaranteed to mean what it says.
+run bench_auto 1800 python -u bench.py
+run bench_fused_xlabwd 1200 env BENCH_BLOCK_IMPL=fused python -u bench.py
+run bench_fused_pallasbwd 1200 env BENCH_BLOCK_IMPL=fused \
+  DTF_FUSED_BWD=pallas python -u bench.py
 run bench_standard 1200 env BENCH_BLOCK_IMPL=standard python -u bench.py
 
 # 4. the BERT/GPT suite the r3a session lost to the lease collision
@@ -92,6 +96,6 @@ cp "$OUT/profile.tgz" "$ART/profile_r3b.tgz" 2>/dev/null || true
 # only replace the preserved BENCH_LATEST.json when this session actually
 # produced a metric row (a truncating redirect would destroy the r3a row
 # exactly when the window dies early — the failure mode we're hedging)
-LATEST=$(grep -h '"metric"' "$OUT"/bench_fused_xlabwd.log 2>/dev/null | tail -1)
+LATEST=$(grep -h '"metric"' "$OUT"/bench_auto.log 2>/dev/null | tail -1)
 [ -n "$LATEST" ] && printf '%s\n' "$LATEST" > "$ART"/BENCH_LATEST.json
 echo "artifacts copied to $ART"
