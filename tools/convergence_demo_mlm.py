@@ -1,10 +1,15 @@
 #!/usr/bin/env python
-"""MLM convergence demo: the real-text BERT pretraining path end to end —
+"""Real-text convergence demo for the transformer family, end to end —
 REAL English prose (this repo's own *.md documentation, the only genuine
 text corpus in a zero-egress image) -> tools/make_token_file.py byte
-tokenizer -> `--data.dataset=tokens_mlm:` (TokenFileMLM 80/10/10
-corruption, gathered positions) -> bert_pretrain training -> standalone
-eval restore -> held-out masked-token accuracy.
+tokenizer -> the token-file streams -> training -> standalone eval
+restore -> held-out accuracy. Two objectives share the harness:
+
+  --objective=mlm (default)  bert_pretrain over `tokens_mlm:`
+      (TokenFileMLM 80/10/10 corruption, gathered positions); gate on
+      held-out masked-byte accuracy.
+  --objective=lm             gpt_lm over `tokens:` (TokenFileLM causal
+      windows); gate on held-out next-byte accuracy.
 
 Character-level MLM with bidirectional context is genuinely learnable
 (English orthography), so the gate is meaningful: unigram guessing
@@ -42,9 +47,9 @@ VOCAB, MASK = 261, 260  # byte tokenizer: 256 bytes + 5 specials
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=1600)
+    ap.add_argument("--objective", choices=("mlm", "lm"), default="mlm")
     ap.add_argument("--min-acc", type=float, default=0.35,
-                    help="held-out masked-byte accuracy gate "
-                         "(unigram floor ~0.13)")
+                    help="held-out accuracy gate (unigram floor ~0.13)")
     args = ap.parse_args()
 
     from distributed_tensorflow_tpu import workloads
@@ -68,12 +73,17 @@ def main() -> None:
             check=True, capture_output=True,
         )
 
+    mlm = args.objective == "mlm"
+    workload = "bert_pretrain" if mlm else "gpt_lm"
+    prefix = "tokens_mlm" if mlm else "tokens"
     common = [
         f"--data.vocab_size={VOCAB}",
-        f"--data.mask_token={MASK}",
         "--data.seq_len=64",
-        "--data.max_predictions=10",
         "--data.global_batch_size=64",
+        *(
+            [f"--data.mask_token={MASK}", "--data.max_predictions=10"]
+            if mlm else []
+        ),
         f"--model.vocab_size={VOCAB}",
         "--model.num_layers=3",
         "--model.d_model=128",
@@ -84,8 +94,8 @@ def main() -> None:
         "--mesh.data=-1",
     ]
     ckdir = os.path.join(work, "ck")
-    result = workloads.run_workload("bert_pretrain", [
-        f"--data.dataset=tokens_mlm:{work}/train.npy",
+    result = workloads.run_workload(workload, [
+        f"--data.dataset={prefix}:{work}/train.npy",
         f"--train.num_steps={args.steps}",
         f"--train.log_every={min(50, args.steps)}",
         "--train.eval_batches=0",
@@ -96,16 +106,17 @@ def main() -> None:
         *common,
     ])
 
-    eval_metrics = workloads.eval_workload("bert_pretrain", [
-        f"--data.dataset=tokens_mlm:{work}/eval.npy",
+    eval_metrics = workloads.eval_workload(workload, [
+        f"--data.dataset={prefix}:{work}/eval.npy",
         f"--checkpoint.directory={ckdir}",
         "--train.eval_batches=5",
         *common,
     ])
     acc = float(eval_metrics.get("accuracy", 0.0))
     print(json.dumps({
+        "objective": args.objective,
         "train_loss": round(float(result.history[-1]["loss"]), 4),
-        "eval_masked_acc": round(acc, 4),
+        "eval_masked_acc" if mlm else "eval_next_byte_acc": round(acc, 4),
         "steps": args.steps,
         "dataset": f"repo .md prose, byte-tokenized; "
                    f"{len(train_files)} train / {len(eval_files)} "
@@ -113,7 +124,7 @@ def main() -> None:
     }))
     if acc < args.min_acc:
         raise SystemExit(
-            f"held-out masked accuracy {acc:.3f} < {args.min_acc} gate")
+            f"held-out accuracy {acc:.3f} < {args.min_acc} gate")
 
 
 if __name__ == "__main__":
