@@ -131,8 +131,11 @@ def main() -> None:
         return cfg, state, step, steps_per_sec
 
     pinned_impl = os.environ.get("BENCH_BLOCK_IMPL")
+    # BENCH_FORCE_AB=1: run the A/B selection on CPU too (plumbing test
+    # — the branch must not first execute inside a scarce chip window)
+    force_ab = os.environ.get("BENCH_FORCE_AB") == "1"
     alt = None  # (impl, steps_per_sec) of the losing variant, if A/B'd
-    if pinned_impl or not on_tpu:
+    if pinned_impl or (not on_tpu and not force_ab):
         impl = pinned_impl or "standard"
         cfg, state, step, steps_per_sec = measure_resident(impl)
     else:
