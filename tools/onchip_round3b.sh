@@ -36,23 +36,28 @@ import jax, jax.numpy as jnp
 print(jax.devices(), float(jax.jit(lambda a:(a@a).sum())(jnp.ones((256,256),jnp.bfloat16))))
 " || { echo 'relay down; aborting session'; exit 1; }
 
+# Ordered by value-per-minute: the window has died mid-session twice,
+# so the headline number and the roofline inputs go FIRST (bench_auto
+# self-protects: probe, per-impl try/except, standard fallback; it does
+# not need the validator as a gate).
+
 # 1. corrected roofline: RTT-subtracted HBM/MXU + host->device bandwidth
 run hbm 900 env HBM_ITERS=64 python -u tools/bench_hbm.py
 
-# 2. validator incl. the new bench-shape compile/execute sweep
+# 2. flagship bench — unpinned: A/Bs fused-vs-standard and reports the
+#    faster (the driver's end-of-round behavior)
+run bench_auto 1800 python -u bench.py
+
+# 3. validator incl. the bench-shape compile/execute sweep
 run validate 1500 python -u tools/validate_fused_tpu.py
 
-# 3. flagship bench. Unpinned bench.py now A/Bs fused-vs-standard
-#    itself and reports the faster (the driver's end-of-round behavior);
-#    the explicit rows below pin BENCH_BLOCK_IMPL so each label is
-#    guaranteed to mean what it says.
-run bench_auto 1800 python -u bench.py
+# 4. pinned A/B rows so each label is guaranteed to mean what it says
 run bench_fused_xlabwd 1200 env BENCH_BLOCK_IMPL=fused python -u bench.py
 run bench_fused_pallasbwd 1200 env BENCH_BLOCK_IMPL=fused \
   DTF_FUSED_BWD=pallas python -u bench.py
 run bench_standard 1200 env BENCH_BLOCK_IMPL=standard python -u bench.py
 
-# 4. the BERT/GPT suite the r3a session lost to the lease collision
+# 5. the BERT/GPT suite the r3a session lost to the lease collision
 run bert 1200 python -u tools/bench_bert.py
 run bert_wide_flash 1200 env DTF_FLASH_BLOCK_Q=256 DTF_FLASH_BLOCK_K=512 \
   python -u tools/bench_bert.py
@@ -63,7 +68,7 @@ run gpt_fused_ln 1200 env BENCH_MODEL=gpt BENCH_FUSED_LN=1 \
 run gpt_long4k 1500 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=8 \
   BENCH_REMAT=1 python -u tools/bench_bert.py
 
-# 5. profile capture at bench config (fused fwd + XLA bwd): the XPlane
+# 6. profile capture at bench config (fused fwd + XLA bwd): the XPlane
 #    trace that round-4 tuning reads. ~30 profiled steps, batch 256.
 rm -rf "$OUT/profile"   # never tar a stale prior session's trace
 run profile 1200 python -u examples/train.py resnet50_imagenet \
@@ -76,7 +81,7 @@ run profile 1200 python -u examples/train.py resnet50_imagenet \
 tar -C "$OUT" -czf "$OUT/profile.tgz" profile 2>/dev/null \
   && echo "    profile.tgz $(du -h "$OUT/profile.tgz" | cut -f1)"
 
-# 6. LAST (can stall, r3a microbench_grad rc=124): AOT-compile the
+# 7. LAST (can stall, r3a microbench_grad rc=124): AOT-compile the
 #    non-default Pallas backward at every bench shape — "only" mode
 #    skips the parity suite + default sweep step 2 already ran
 run validate_pallas_bwd 1200 env VALIDATE_PALLAS_BWD=only \
