@@ -70,3 +70,32 @@ def test_timed_steps_pulls_fresh_batches():
 def test_sync_by_value_forces_scalar():
     assert bm.sync_by_value({"loss": jnp.asarray(2.5)}) == 2.5
     assert isinstance(bm.sync_by_value({"loss": jnp.asarray(1)}), float)
+
+
+@pytest.mark.slow
+def test_bench_py_json_contract(tmp_path):
+    """The driver consumes bench.py's stdout as ONE JSON line with the
+    BASELINE metric schema; a regression here silently costs the round
+    its artifact. Runs the real script (CPU fallback path) at tiny step
+    counts and validates the contract."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "BENCH_STEPS": "3"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be exactly one line: {lines}"
+    row = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "mfu",
+                "platform", "n_chips", "global_batch", "block_impl",
+                "pipeline_efficiency"):
+        assert key in row, key
+    assert row["metric"] == "resnet50_images_per_sec_per_chip"
+    assert row["value"] > 0 and row["unit"] == "images/sec/chip"
