@@ -20,6 +20,9 @@ cd "$(dirname "$0")/.."
 OUT=$(readlink -f "${1:-/tmp/onchip_r3b}")  # absolute: redirects below
 mkdir -p "$OUT"                             # must survive any later cd
 
+ART="artifacts/onchip_r3"  # in-tree; script cd'd to the repo root
+mkdir -p "$ART"
+
 run() { # name timeout_s cmd...
   local name=$1 t=$2; shift 2
   echo "=== $name ($(date -u +%H:%M:%S)) ==="
@@ -28,6 +31,9 @@ run() { # name timeout_s cmd...
   local rc=$?
   echo "    rc=$rc  tail:"
   tail -3 "$OUT/$name.log" | sed 's/^/    /'
+  # preserve in-tree IMMEDIATELY: the round may end (or the relay die)
+  # mid-session, and only committed files survive
+  cp "$OUT/$name.log" "$ART/${name}_r3b.log" 2>/dev/null
   return $rc
 }
 
@@ -92,8 +98,6 @@ grep -h '"metric"' "$OUT"/hbm.log "$OUT"/bench_*.log "$OUT"/bert*.log \
   "$OUT"/gpt*.log 2>/dev/null
 echo "logs in $OUT"
 
-ART="artifacts/onchip_r3"  # script already cd'd to the repo root
-mkdir -p "$ART"
 for f in "$OUT"/*.log; do
   cp "$f" "$ART/$(basename "$f" .log)_r3b.log" 2>/dev/null
 done
