@@ -1,3 +1,5 @@
+import os
+
 import numpy as np
 import pytest
 
@@ -217,3 +219,59 @@ class TestTokenFileMLM:
         assert all(
             h["loss"] == h["loss"] for h in result.history  # finite
         )
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestMakeTokenFile:
+    def test_wordpiece_greedy_longest_match(self, tmp_path):
+        vocab = tmp_path / "vocab.txt"
+        vocab.write_text(
+            "[PAD]\n[UNK]\n[CLS]\n[SEP]\n[MASK]\nhello\n,\n!\n.\nworld\n"
+            "un\n##afford\n##able\ntoken\n##ization\n"
+        )
+        import sys
+        sys.path.insert(0, str(REPO))
+        from tools.make_token_file import WordPiece
+
+        enc = WordPiece(str(vocab))
+        # basic tokenization lowercases + splits punctuation; greedy
+        # longest-match-first resolves subwords with ## continuations
+        assert enc.encode("Hello, world!") == [5, 6, 9, 7]
+        assert enc.encode("unaffordable tokenization.") == [
+            10, 11, 12, 13, 14, 8]
+        # un-tokenizable word -> [UNK]
+        assert enc.encode("xyzzy") == [1]
+
+    def test_wordpiece_requires_unk(self, tmp_path):
+        vocab = tmp_path / "vocab.txt"
+        vocab.write_text("hello\nworld\n")
+        import sys
+        sys.path.insert(0, str(REPO))
+        from tools.make_token_file import WordPiece
+
+        with pytest.raises(SystemExit, match="UNK"):
+            WordPiece(str(vocab))
+
+    def test_byte_mode_roundtrip(self, tmp_path):
+        import subprocess
+        import sys
+
+        import numpy as np
+
+        src = tmp_path / "t.txt"
+        src.write_text("Hi!\n")
+        out = tmp_path / "tok.npy"
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "make_token_file.py"),
+             str(out), str(src)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        ids = np.load(out)
+        assert ids.tolist() == list(b"Hi!\n")
+        # the printed training hint must carry the byte [MASK] id (260),
+        # not the default 103 (= byte 'g') — a silent-degradation trap
+        assert "--data.mask_token=260" in proc.stderr
