@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Summarize an on-chip session's logs into a PERF_NOTES-ready digest.
+
+Reads every *.log in the given directory (default /tmp/onchip_r3b),
+pulls the JSON metric rows and key validator/microbench lines, and
+prints a markdown digest: one table row per bench metric plus notable
+pass/fail lines. Wall-clock matters when a relay window is open — this
+turns 'analyze and commit the evidence' into one command.
+
+Usage: python tools/summarize_onchip.py [logdir]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def main() -> None:
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/onchip_r3b"
+    logs = sorted(glob.glob(os.path.join(logdir, "*.log")))
+    if not logs:
+        raise SystemExit(f"no logs under {logdir}")
+
+    rows, notes = [], []
+    for path in logs:
+        name = os.path.basename(path)[:-4]
+        with open(path, errors="replace") as f:
+            text = f.read()
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                try:
+                    rows.append((name, json.loads(line)))
+                except json.JSONDecodeError:
+                    pass
+            elif line.startswith(("ALL OK", "FAILURES", "FAIL ")):
+                notes.append((name, line[:120]))
+            elif "block-impl A/B:" in line:
+                notes.append((name, line.split("] ")[-1][:120]))
+
+    print(f"## On-chip digest: {logdir} ({len(logs)} logs)\n")
+    if rows:
+        print("| step | metric | value | unit | extras |")
+        print("|---|---|---|---|---|")
+        for name, r in rows:
+            extras = {k: v for k, v in r.items()
+                      if k not in ("metric", "value", "unit")
+                      and not isinstance(v, (dict, list))}
+            extra_s = " ".join(
+                f"{k}={v}" for k, v in sorted(extras.items())
+                if k in ("mfu", "platform", "block_impl", "raw_gbps",
+                         "raw_tflops", "pct_of_v5e_spec",
+                         "pipeline_efficiency", "fed_data",
+                         "alt_block_impl", "alt_images_per_sec_per_chip",
+                         "attention_impl", "fused_ln_matmul", "seq_len",
+                         "model", "dispatch_fetch_overhead_ms"))
+            print(f"| {name} | {r['metric']} | {r['value']} "
+                  f"| {r.get('unit', '')} | {extra_s} |")
+    if notes:
+        print("\nNotable lines:")
+        for name, line in notes:
+            print(f"- `{name}`: {line}")
+
+
+if __name__ == "__main__":
+    main()
