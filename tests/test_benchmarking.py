@@ -99,3 +99,20 @@ def test_bench_py_json_contract(tmp_path):
         assert key in row, key
     assert row["metric"] == "resnet50_images_per_sec_per_chip"
     assert row["value"] > 0 and row["unit"] == "images/sec/chip"
+
+    # the unpinned-TPU A/B selection path (forced on CPU): must still be
+    # one JSON line, now with the losing variant recorded
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "BENCH_STEPS": "3",
+             "BENCH_FORCE_AB": "1"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    row = json.loads(lines[0])
+    assert row["block_impl"] in ("fused", "standard")
+    assert row["alt_block_impl"] in ("fused", "standard")
+    assert row["alt_block_impl"] != row["block_impl"]
+    assert row["alt_images_per_sec_per_chip"] > 0
