@@ -318,3 +318,37 @@ def test_convergence_demo_mlm_machinery():
     assert proc.returncode == 0, proc.stderr[-2000:]
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["eval_masked_acc"] > 0.1, result
+
+
+@pytest.mark.slow
+def test_train_and_eval_cli_scripts(tmp_path):
+    """The examples/{train,eval}.py SCRIPTS (not the API): the exact
+    commands the README/MIGRATION show users, run as subprocesses with a
+    checkpoint handoff between them. The round-3b on-chip profile step
+    drives examples/train.py directly, so script-level rot would cost a
+    chip window."""
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    ck = str(tmp_path / "ck")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "train.py"),
+         "mnist_mlp", "--train.num_steps=4", "--train.log_every=2",
+         "--data.global_batch_size=32", f"--checkpoint.directory={ck}",
+         "--checkpoint.async_save=false",
+         "--checkpoint.save_on_preemption=false",
+         "--train.eval_batches=0", "--mesh.data=-1"],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "eval.py"),
+         "mnist_mlp", f"--checkpoint.directory={ck}",
+         "--train.eval_batches=2", "--data.global_batch_size=32",
+         "--mesh.data=-1"],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "accuracy" in proc.stdout or "accuracy" in proc.stderr
