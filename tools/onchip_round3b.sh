@@ -53,6 +53,10 @@ run hbm 900 env HBM_ITERS=64 python -u tools/bench_hbm.py
 # 2. flagship bench — unpinned: A/Bs fused-vs-standard and reports the
 #    faster (the driver's end-of-round behavior)
 run bench_auto 1800 python -u bench.py
+# stamp the headline row in-tree NOW (not at session end): a mid-session
+# relay death or round end must not cost the round its TPU number
+LATEST=$(grep -h '"metric"' "$OUT"/bench_auto.log 2>/dev/null | tail -1)
+[ -n "$LATEST" ] && printf '%s\n' "$LATEST" > "$ART"/BENCH_LATEST.json
 
 # 3. validator incl. the bench-shape compile/execute sweep
 run validate 1500 python -u tools/validate_fused_tpu.py
