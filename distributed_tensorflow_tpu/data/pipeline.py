@@ -25,6 +25,11 @@ import numpy as np
 class DataConfig:
     # synthetic | npz:<path> | records:<path> | jpeg:<path>
     dataset: str = "synthetic"
+    # Explicit eval source (same syntax as `dataset`). Empty = workload
+    # default: a held-out slice for synthetic streams, or — for file-backed
+    # datasets with no natural held-out split (e.g. ctr:) — the training
+    # file itself, in which case the AUC metric is tagged `train_auc`.
+    eval_dataset: str = ""
     global_batch_size: int = 128
     image_size: int = 28
     channels: int = 1

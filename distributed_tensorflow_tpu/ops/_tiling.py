@@ -136,6 +136,29 @@ def pick_single_pass_bm(M: int, cin: int, cout: int, *, in_bytes: int,
     return None
 
 
+# (M, cin, cout) shapes where the Pallas-backward Mosaic compile (or its
+# first execution) has been OBSERVED to stall >10 min on the real v5e —
+# round-3 session A: bench_fused_kernels grad at s3_conv1 rc=124 with the
+# pick_dw_tiles tiling. Populated strictly from on-chip evidence; remove
+# an entry when a later session shows it compiles+runs sanely (the
+# validator's VALIDATE_PALLAS_BWD sweep sets DTF_FUSED_BWD_FORCE=1 and
+# times every shape precisely to produce that evidence).
+PALLAS_BWD_KNOWN_SLOW: set[tuple[int, int, int]] = {
+    (12544, 2048, 512),  # s3_conv1, batch-256 ResNet-50
+}
+
+
+def pallas_bwd_known_slow(M: int, cin: int, cout: int) -> bool:
+    """True when DTF_FUSED_BWD=pallas should refuse this shape (known
+    pathological compile) — overridable with DTF_FUSED_BWD_FORCE=1 for
+    measurement runs."""
+    import os
+
+    if os.environ.get("DTF_FUSED_BWD_FORCE") == "1":
+        return False
+    return (M, cin, cout) in PALLAS_BWD_KNOWN_SLOW
+
+
 def resolve_bwd_impl(bwd_impl: str | None) -> str:
     """The fused composites' backward selection policy (one home for the
     env default so the two op families cannot drift): explicit argument

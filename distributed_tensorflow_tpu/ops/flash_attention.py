@@ -387,19 +387,41 @@ def flash_attention(
     block sizes (callers pad + pass kv_mask; models/transformer.py does)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    if block_q is None:
-        # env-tunable so on-chip sessions can sweep tile sizes without a
-        # code change (DTF_FLASH_BLOCK_Q/K); 128x128 is the safe default,
-        # larger K tiles cut grid overhead at long seq once measured
-        import os
+    # env-tunable so on-chip sessions can sweep tile sizes without a code
+    # change (DTF_FLASH_BLOCK_Q/K); 128x128 is the safe default, larger K
+    # tiles cut grid overhead at long seq once measured. The env knobs are
+    # process-global and read at TRACE time, so a sweep value tuned for the
+    # bench shape must not break other call sites (e.g. Sq=384 under a
+    # 256 block): an env block that doesn't divide falls back to the 128
+    # default with a warning instead of raising — only an EXPLICIT
+    # block_q/block_k argument keeps the hard divisibility error.
+    import os
 
+    from_env_q = block_q is None and "DTF_FLASH_BLOCK_Q" in os.environ
+    from_env_k = block_k is None and "DTF_FLASH_BLOCK_K" in os.environ
+    if block_q is None:
         block_q = int(os.environ.get("DTF_FLASH_BLOCK_Q", "128"))
     if block_k is None:
-        import os
-
         block_k = int(os.environ.get("DTF_FLASH_BLOCK_K", "128"))
     block_q = min(block_q, Sq)
     block_k = min(block_k, Sk)
+    # fall back only when the env var was actually set AND the 128
+    # default would work — otherwise let the hard error below name the
+    # real problem (an unpadded sequence)
+    if from_env_q and Sq % block_q and Sq % min(128, Sq) == 0:
+        import warnings
+
+        warnings.warn(
+            f"DTF_FLASH_BLOCK_Q={block_q} does not divide Sq={Sq}; "
+            f"falling back to 128 for this call site")
+        block_q = min(128, Sq)
+    if from_env_k and Sk % block_k and Sk % min(128, Sk) == 0:
+        import warnings
+
+        warnings.warn(
+            f"DTF_FLASH_BLOCK_K={block_k} does not divide Sk={Sk}; "
+            f"falling back to 128 for this call site")
+        block_k = min(128, Sk)
     if Sq % block_q or Sk % block_k:
         raise ValueError(
             f"seq lens ({Sq=}, {Sk=}) must be multiples of block sizes "

@@ -77,10 +77,18 @@ def initialize(config: ClusterConfig | None = None) -> None:
             # the persistent-cache backend binds lazily to the FIRST dir
             # it serves; if some earlier code (a test rig, a notebook)
             # already warmed a cache elsewhere, reset so the configured
-            # dir actually takes effect for this process
-            from jax._src import compilation_cache as _cc
+            # dir actually takes effect for this process. Private API —
+            # best-effort only: if a jax upgrade moves it, the stale
+            # binding costs cache hits, never correctness.
+            try:
+                from jax._src import compilation_cache as _cc
 
-            _cc.reset_cache()
+                _cc.reset_cache()
+            except (ImportError, AttributeError) as e:
+                logger.warning(
+                    "could not reset the compilation cache binding "
+                    "(private jax API moved?): %s — the configured "
+                    "cache dir may not take effect this process", e)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # cache even quick-compiling programs: resume-after-preemption
         # replays the whole startup, so every skipped compile counts.

@@ -44,6 +44,11 @@ def honor_env_platform() -> None:
     env = os.environ.get("JAX_PLATFORMS")
     if env and jax.config.jax_platforms != env:
         jax.config.update("jax_platforms", env)
+    # The chip-session lock outranks any pin: a concurrent process must
+    # never contend for the single tunneled device lease (chip_lock.py).
+    from .chip_lock import pin_cpu_if_locked
+
+    pin_cpu_if_locked()
 
 
 def fall_back_to_cpu_if_unreachable(timeout_s: int = 150,

@@ -1,0 +1,120 @@
+"""Chip-session lock: make the single-device-lease protocol mechanical.
+
+The tunneled TPU exposes exactly one device lease; a second process
+initializing the accelerator platform mid-benchmark contends for it and
+silently downgrades (or wedges) the measurement session — this cost
+round 3 its entire BERT/GPT suite (PERF_NOTES.md "operator error").
+The protocol used to be a comment in a shell script; this module makes
+it a mechanism:
+
+- ``tools/chip_session.sh CMD...`` takes an exclusive flock, records its
+  pid in the lock file, exports ``DTF_CHIP_SESSION=1`` to the command's
+  whole process tree, and removes the lock on exit (any exit).
+- :func:`pin_cpu_if_locked` — called at package import and by the bench
+  harness — detects a *live* lock held by another process tree and pins
+  the current process to the CPU backend before any device is touched.
+  The session's own children are exempt via the env var; a stale lock
+  (holder pid dead) is ignored and cleaned up.
+
+Scope: any Python process that imports ``distributed_tensorflow_tpu``
+(or runs pytest, whose conftest pins CPU unconditionally) cannot steal
+the lease while a session runs. A bare ``import jax`` that never touches
+this package remains outside the guard — there is no in-repo hook for
+that (cwd ``sitecustomize`` is not imported by CPython's site init).
+
+Reference analog: TF's in-process cluster tests serialize device access
+via per-test servers ($TF multi_worker_test_base.py); the single tunneled
+lease needs the same exclusion made explicit.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["lock_path", "lock_holder", "pin_cpu_if_locked"]
+
+_DEFAULT_LOCK = "/tmp/dtf_chip_session.lock"
+
+
+def lock_path() -> str:
+    return os.environ.get("DTF_CHIP_LOCK", _DEFAULT_LOCK)
+
+
+def lock_holder() -> int | None:
+    """Pid of the live chip-session holder, or None (no lock / stale /
+    held by this process tree).
+
+    Liveness: when the session's flock sidecar exists, probe the kernel
+    flock itself — held means a live session even through SIGKILL/pid
+    churn (the kernel releases flocks on process death, so a killed
+    session reads as stale no matter what pid now owns the recorded
+    number). Without the sidecar (hand-written lock file, tests), fall
+    back to pid liveness."""
+    if os.environ.get("DTF_CHIP_SESSION") == "1":
+        return None  # we ARE the session (or one of its children)
+    try:
+        with open(lock_path()) as f:
+            pid = int(f.read().strip() or "0")
+    except (OSError, ValueError):
+        return None
+    if pid <= 0 or pid == os.getpid():
+        return None
+
+    def _stale() -> None:
+        try:  # killed session left the file behind: clean up best-effort
+            os.unlink(lock_path())
+        except OSError:
+            pass
+
+    flock_path = lock_path() + ".flock"
+    if os.path.exists(flock_path):
+        import fcntl
+
+        try:
+            with open(flock_path) as fl:
+                fcntl.flock(fl, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                # acquirable => no session holds it (auto-released on
+                # close); the pid file is leftover state
+                _stale()
+                return None
+        except BlockingIOError:
+            return pid  # genuinely held by a live session
+        except OSError:
+            pass  # unreadable sidecar: fall through to pid liveness
+    try:
+        os.kill(pid, 0)  # liveness probe, no signal delivered
+    except ProcessLookupError:
+        _stale()
+        return None
+    except PermissionError:
+        pass  # alive, owned by another uid — still counts as held
+    return pid
+
+
+def pin_cpu_if_locked(log=None) -> bool:
+    """Pin this process to the CPU backend when a live chip session owns
+    the lease. Must run before the first backend init to take effect
+    (jax backends initialize lazily). Returns True when pinned.
+
+    Deliberately overrides even an explicit JAX_PLATFORMS pin: the lock
+    exists precisely for the moment operator discipline fails, and CPU
+    is always safe for the pinned process while the alternative can
+    wedge the device lease for the measurement session.
+    """
+    pid = lock_holder()
+    if pid is None:
+        return False
+    if log is None:
+        def log(s):  # stderr, not stdout: callers may parse stdout JSON
+            import sys
+            print(s, file=sys.stderr)
+    log(f"chip-session lock held by live pid {pid} "
+        f"({lock_path()}); pinning this process to CPU")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:  # jax absent/odd: env var alone still helps
+        log(f"  (jax config update skipped: {e})")
+    return True

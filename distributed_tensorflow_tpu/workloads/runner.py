@@ -99,6 +99,13 @@ class WorkloadParts:
     tx: Any = None
     fsdp: bool = False
     batch_size: int | None = None  # examples/step for throughput logs
+    # Prefix for the eval AUC key (e.g. "train_" when the workload's eval
+    # stream draws from the training file — wide_deep ctr: fallback)
+    eval_metric_prefix: str = ""
+    # Did build() consult cfg.data.eval_dataset? Workloads that honor the
+    # flag set this True; the runner rejects an explicit eval_dataset the
+    # workload would silently ignore (no silent eval-source degradation).
+    consumed_eval_dataset: bool = False
     _jit_eval: Callable | None = dataclasses.field(default=None, repr=False)
 
 
@@ -122,6 +129,7 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
         logger.info("config:\n%s", config_lib.to_json(cfg))
 
     parts = build(cfg, mesh)
+    _check_eval_dataset_consumed(cfg, parts)
     tx = parts.tx if parts.tx is not None else make_optimizer(cfg.optimizer)
     rng = jax.random.PRNGKey(cfg.train.seed)
 
@@ -191,6 +199,21 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
     return RunResult(state, metrics_logger.history, eval_metrics, mesh)
 
 
+def _check_eval_dataset_consumed(cfg: RunConfig, parts: WorkloadParts) -> None:
+    """An explicit --data.eval_dataset the workload does not support must
+    error, not silently evaluate on the default stream (the same
+    no-masquerade rule as wide_deep's train_auc tagging)."""
+    # getattr: text workloads swap in TextDataConfig, which defines its
+    # own eval convention (held-out token files) and has no such field
+    ev = getattr(cfg.data, "eval_dataset", "")
+    if ev and not parts.consumed_eval_dataset:
+        raise ValueError(
+            f"workload {cfg.workload!r} does not support "
+            f"data.eval_dataset (got {ev!r}); its eval "
+            "stream is workload-defined — drop the flag or use a "
+            "workload that honors it (wide_deep)")
+
+
 def _run_eval(state: Any, put_batch: Callable, parts: WorkloadParts,
               num_batches: int) -> dict:
     """Shared eval loop: sums the eval_fn's summed metrics over the eval
@@ -222,7 +245,7 @@ def _run_eval(state: Any, put_batch: Callable, parts: WorkloadParts,
         # a one-class stream makes AUC undefined (NaN); omit the key
         # rather than emit the non-JSON `NaN` literal downstream
         if np.isfinite(auc):
-            result["auc"] = auc
+            result[parts.eval_metric_prefix + "auc"] = auc
     return result
 
 
@@ -245,6 +268,7 @@ def evaluate_from_checkpoint(
     cluster.initialize(cfg.cluster)
     mesh = build_mesh(cfg.mesh)
     parts = build(cfg, mesh)
+    _check_eval_dataset_consumed(cfg, parts)
     if parts.eval_fn is None or parts.eval_dataset_fn is None:
         raise ValueError(f"workload {cfg.workload!r} has no eval surface")
 

@@ -74,29 +74,49 @@ def _dataset_fn(cfg: RunConfig, rcfg: RecsysConfig):
 
 
 def _eval_dataset_fn(cfg: RunConfig, rcfg: RecsysConfig):
+    """Returns ``(dataset_fn, metric_prefix)`` — ONE decision point for
+    both the eval source and the honesty tag, so they cannot drift."""
     ds = cfg.data.dataset
+    ev = cfg.data.eval_dataset
+    if ev.startswith("ctr:"):
+        # explicit held-out record file: the honest generalization metric
+        return (lambda n: CTRRecordDataset(
+            ev[4:], rcfg, num_batches=n, seed=rcfg.seed + 101)), ""
+    if ev:
+        # an explicit-but-unrecognized eval source must not silently
+        # degrade to a train-set metric
+        raise ValueError(
+            f"wide_deep: unsupported data.eval_dataset={ev!r} "
+            "(expected 'ctr:<path>' or empty)")
     if ds.startswith("ctr:"):
-        # distinct shuffle seed: with the training seed, eval batches
-        # 0..n-1 would be byte-identical to the FIRST-trained batches
-        # (pure memorization signal). A held-out file via a separate
-        # eval run remains the right way to measure generalization.
-        return lambda n: CTRRecordDataset(
-            ds[4:], rcfg, num_batches=n, seed=rcfg.seed + 101)
-    return lambda n: SyntheticCTR(rcfg, n, index_offset=10**6)
+        # No eval file given: fall back to the TRAINING file with a
+        # distinct shuffle seed (with the training seed, eval batches
+        # 0..n-1 would be byte-identical to the FIRST-trained batches —
+        # pure memorization signal). The "train_" prefix tags the metric
+        # so this train-set number can't masquerade as generalization;
+        # pass --data.eval_dataset=ctr:<path> for a real held-out AUC.
+        return (lambda n: CTRRecordDataset(
+            ds[4:], rcfg, num_batches=n, seed=rcfg.seed + 101)), "train_"
+    return (lambda n: SyntheticCTR(rcfg, n, index_offset=10**6)), ""
 
 
 def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
     model = wd.WideDeep(cfg.model, mesh)
     rcfg = _recsys_cfg(cfg)
+    eval_fn_, eval_prefix = _eval_dataset_fn(cfg, rcfg)
     return WorkloadParts(
         tx=_canonical_tx(cfg),
         init_fn=wd.make_init_fn(cfg.model, mesh),
         loss_fn=wd.ctr_loss_fn(model),
         eval_fn=wd.ctr_eval_fn(model),
         dataset_fn=_dataset_fn(cfg, rcfg),
-        eval_dataset_fn=_eval_dataset_fn(cfg, rcfg),
+        eval_dataset_fn=eval_fn_,
         flops_per_step=wd.flops_per_example(cfg.model)
         * cfg.data.global_batch_size,
         param_rules=wd.embedding_rules(),
         batch_size=cfg.data.global_batch_size,
+        # "train_" when eval draws from the training ctr file — a
+        # train-set metric must not masquerade as generalization
+        eval_metric_prefix=eval_prefix,
+        consumed_eval_dataset=True,
     )

@@ -129,3 +129,21 @@ def test_blockwise_fully_masked_rows_are_zero():
     kv_mask = jnp.zeros((1, 128), bool)
     out = blockwise_attention(q, k, v, kv_mask=kv_mask, block_k=32)
     np.testing.assert_allclose(out, np.zeros_like(out), atol=1e-6)
+
+
+def test_flash_env_block_fallback(monkeypatch):
+    # ADVICE r3: DTF_FLASH_BLOCK_Q/K are process-global trace-time knobs;
+    # a sweep value that doesn't divide some OTHER call site's seq len
+    # must fall back to the 128 default with a warning, not raise.
+    # 384 % 256 != 0 (and 256 < 384, so min() doesn't clamp it away),
+    # while the 128 fallback divides — the ADVICE finding's exact example
+    q, k, v = make_qkv(jax.random.PRNGKey(7), B=1, H=2, S=384)
+    ref = attention_reference(q, k, v)
+    monkeypatch.setenv("DTF_FLASH_BLOCK_Q", "256")
+    monkeypatch.setenv("DTF_FLASH_BLOCK_K", "256")
+    with pytest.warns(UserWarning, match="falling back to 128"):
+        out = flash_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    # an EXPLICIT non-dividing block argument still errors loudly
+    with pytest.raises(ValueError, match="multiples of block sizes"):
+        flash_attention(q, k, v, block_q=256, block_k=256)

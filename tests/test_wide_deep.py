@@ -267,4 +267,50 @@ class TestCTRRecords:
         )
         import numpy as np
 
+        # no eval_dataset given: eval drew from the training file, so the
+        # metric is tagged train_auc (ADVICE r3) — the honest label
+        assert np.isfinite(result.eval_metrics["train_auc"])
+        assert "auc" not in result.eval_metrics
+
+    def test_explicit_eval_dataset_gets_untagged_auc(self, tmp_path):
+        from distributed_tensorflow_tpu import workloads
+
+        path, *_ = self._record_file(tmp_path, n=512, vocabs=(50, 30),
+                                     dense=4)
+        (tmp_path / "ev").mkdir()
+        epath, *_ = self._record_file(
+            tmp_path / "ev", n=256, vocabs=(50, 30), dense=4)
+        result = workloads.run_workload(
+            "wide_deep",
+            [
+                f"--data.dataset=ctr:{path}",
+                f"--data.eval_dataset=ctr:{epath}",
+                "--data.global_batch_size=64",
+                "--model.vocab_sizes=[50,30]",
+                "--model.dense_features=4",
+                "--model.embed_dim=4",
+                "--model.hidden_sizes=[16,8]",
+                "--train.num_steps=2",
+                "--train.log_every=2",
+                "--train.eval_batches=2",
+                "--checkpoint.directory=",
+            ],
+        )
+        import numpy as np
+
         assert np.isfinite(result.eval_metrics["auc"])
+        assert "train_auc" not in result.eval_metrics
+
+
+def test_unrecognized_eval_dataset_raises():
+    # an explicit-but-unsupported eval source must error loudly, not
+    # silently fall back to a train-set metric (code-review r4)
+    import pytest as _pytest
+
+    from distributed_tensorflow_tpu import workloads
+
+    with _pytest.raises(ValueError, match="eval_dataset"):
+        workloads.run_workload("wide_deep", [
+            "--data.eval_dataset=npz:/nonexistent.npz",
+            "--train.num_steps=1", "--checkpoint.directory=",
+        ])

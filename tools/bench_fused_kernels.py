@@ -77,6 +77,11 @@ def timed(fn, args, reps):
 def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else "fwd"
     reps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    # this microbench exists to MEASURE the pallas path, including shapes
+    # the landmine guard (_tiling.PALLAS_BWD_KNOWN_SLOW) would reroute
+    import os
+
+    os.environ["DTF_FUSED_BWD_FORCE"] = "1"
     r = np.random.RandomState(0)
     print(f"backend={jax.default_backend()} mode={mode} reps={reps}")
     print(f"{'shape':10s} {'M':>8s} {'cin':>5s} {'cout':>5s} "

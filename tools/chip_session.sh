@@ -1,0 +1,16 @@
+#!/bin/bash
+# Exclusive chip-session wrapper: run CMD holding the single-device-lease
+# lock. Every python process that imports distributed_tensorflow_tpu (or
+# runs pytest) while this lock is held pins itself to CPU — see
+# distributed_tensorflow_tpu/utils/chip_lock.py for the protocol.
+# Usage: bash tools/chip_session.sh CMD [ARGS...]
+set -u
+LOCK=${DTF_CHIP_LOCK:-/tmp/dtf_chip_session.lock}
+exec 9>>"$LOCK.flock"
+if ! flock -n 9; then
+  echo "chip_session: another session already holds $LOCK.flock" >&2
+  exit 97
+fi
+echo $$ >"$LOCK"
+trap 'rm -f "$LOCK"' EXIT INT TERM
+DTF_CHIP_SESSION=1 "$@"

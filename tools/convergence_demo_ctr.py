@@ -129,7 +129,10 @@ def main() -> None:
     ])
 
     eval_metrics = workloads.eval_workload("wide_deep", [
-        f"--data.dataset=ctr:{work}/eval.dat",
+        # explicit held-out eval file => the unprefixed `auc` key (eval
+        # drawn from data.dataset would be tagged train_auc)
+        f"--data.eval_dataset=ctr:{work}/eval.dat",
+        f"--data.dataset=ctr:{work}/train.dat",
         f"--checkpoint.directory={ckdir}",
         "--train.eval_batches=5",
         *common,

@@ -137,6 +137,10 @@ def main() -> None:
         total += len(ids)
         print(f"{path}: {len(ids)} tokens", file=sys.stderr)
     tokens = np.concatenate(all_ids) if all_ids else np.empty(0, np.int32)
+    # np.save silently appends ".npy" to extension-less paths; normalize
+    # up front so the printed train flags below name the real file
+    if not args.out.endswith(".npy"):
+        args.out += ".npy"
     np.save(args.out, tokens)
     print(f"wrote {args.out}: {total} tokens, tokenizer={args.tokenizer}, "
           f"vocab_size={vocab_size}")

@@ -1,0 +1,123 @@
+#!/bin/bash
+# Round-4 on-chip session — supersedes onchip_round3b.sh (same core queue,
+# VERDICT r3 item 1) plus the round-4 additions:
+#   - wide_deep embedding-tier row (VERDICT r3 item 5 — last family with
+#     zero hardware evidence)
+#   - jpeg-fed + BENCH_PUT_SYNC A/B inside the same session (item 3)
+#   - 4k flash block-size sweep point (item 4 / §5.7)
+# Runs under tools/chip_session.sh (the watcher wraps it), so every other
+# framework-importing python on the host pins itself to CPU for the
+# duration (utils/chip_lock.py — the round-3 lease collision, mechanized).
+# Usage: bash tools/onchip_round4.sh [outdir]   (default /tmp/onchip_r4)
+set -u
+cd "$(dirname "$0")/.."
+OUT=$(readlink -f "${1:-/tmp/onchip_r4}")
+mkdir -p "$OUT"
+
+ART="artifacts/onchip_r4"
+mkdir -p "$ART"
+
+run() { # name timeout_s cmd...
+  local name=$1 t=$2; shift 2
+  echo "=== $name ($(date -u +%H:%M:%S)) ==="
+  timeout --signal=TERM --kill-after=60 "$t" "$@" \
+    >"$OUT/$name.log" 2>&1
+  local rc=$?
+  echo "    rc=$rc  tail:"
+  tail -3 "$OUT/$name.log" | sed 's/^/    /'
+  # preserve in-tree IMMEDIATELY: the relay has died mid-session twice;
+  # only committed files survive a round end
+  cp "$OUT/$name.log" "$ART/${name}.log" 2>/dev/null
+  return $rc
+}
+
+run probe 180 python -u -c "
+import jax, jax.numpy as jnp
+print(jax.devices(), float(jax.jit(lambda a:(a@a).sum())(jnp.ones((256,256),jnp.bfloat16))))
+" || { echo 'relay down; aborting session'; exit 1; }
+
+# Ordered by value-per-minute (windows have died at 41 min and 75 min):
+# roofline + headline first, then the never-measured tiers, then A/Bs.
+
+# 1. corrected roofline: RTT-subtracted HBM/MXU + host->device bandwidth
+#    — decides whether 0.50 MFU is chip-bound or program-bound here
+run hbm 900 env HBM_ITERS=64 python -u tools/bench_hbm.py
+
+# 2. flagship bench — unpinned: A/Bs fused-vs-standard, reports the faster
+run bench_auto 1800 python -u bench.py
+LATEST=$(grep -h '"metric"' "$OUT"/bench_auto.log 2>/dev/null | tail -1)
+[ -n "$LATEST" ] && printf '%s\n' "$LATEST" > "$ART"/BENCH_LATEST.json
+
+# 3. first-ever transformer numbers (MXU-bound tier; lost to the r3 lease
+#    collision) — plain first so the suite's headline lands even if the
+#    window dies here
+run bert 1200 python -u tools/bench_bert.py
+run gpt_plain 1200 env BENCH_MODEL=gpt python -u tools/bench_bert.py
+run gpt_long4k 1500 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=8 \
+  BENCH_REMAT=1 python -u tools/bench_bert.py
+
+# 4. first-ever embedding-tier number (VERDICT r3 item 5)
+run wide_deep 1200 python -u tools/bench_wide_deep.py
+
+# 5. fed-window proof (VERDICT r3 item 3): jpeg-decode-fed and the
+#    PUT_SYNC A/B in the same session; bench_hbm above already reported
+#    host_to_device_gbps, making these rows self-explaining
+run bench_jpeg 1500 env BENCH_DATA=jpeg python -u bench.py
+run bench_jpeg_putsync 1500 env BENCH_DATA=jpeg BENCH_PUT_SYNC=1 python -u bench.py
+
+# 6. validator incl. the bench-shape compile/execute sweep
+run validate 1500 python -u tools/validate_fused_tpu.py
+
+# 7. pinned A/B rows (kernel-tier verdict: does fused-fwd/XLA-bwd beat
+#    standard end-to-end?)
+run bench_fused_xlabwd 1200 env BENCH_BLOCK_IMPL=fused python -u bench.py
+run bench_fused_pallasbwd 1200 env BENCH_BLOCK_IMPL=fused \
+  DTF_FUSED_BWD=pallas python -u bench.py
+run bench_standard 1200 env BENCH_BLOCK_IMPL=standard python -u bench.py
+
+# 8. transformer ablations + flash block sweep (512 and 4k tiles)
+run bert_wide_flash 1200 env DTF_FLASH_BLOCK_Q=256 DTF_FLASH_BLOCK_K=512 \
+  python -u tools/bench_bert.py
+run bert_dense_attn 1200 env BENCH_ATTN=dense python -u tools/bench_bert.py
+run gpt_fused_ln 1200 env BENCH_MODEL=gpt BENCH_FUSED_LN=1 \
+  python -u tools/bench_bert.py
+run gpt_long4k_k512 1500 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=8 \
+  BENCH_REMAT=1 DTF_FLASH_BLOCK_Q=128 DTF_FLASH_BLOCK_K=512 \
+  python -u tools/bench_bert.py
+run bert_remat 1200 env BENCH_REMAT=1 python -u tools/bench_bert.py
+# batch knee probe: does 256/chip beat 128 (HBM pressure vs MXU feed)?
+run bert_b256 1200 env BENCH_BATCH=256 BENCH_REMAT=1 python -u tools/bench_bert.py
+
+# 8b. per-shape kernel microbenches: fwd (pallas won 1.0-2.5x in r3,
+#     re-confirm) and grad with the NEW single-pass backward (r3 only
+#     measured the two-pass). grad is stall-prone (r3 s3_conv1 rc=124;
+#     that shape runs last and the step timeout contains it).
+run microbench_fwd 900 python -u tools/bench_fused_kernels.py fwd
+run microbench_grad 900 env DTF_FUSED_BWD=pallas \
+  python -u tools/bench_fused_kernels.py grad
+
+# 9. profile capture at bench config (fused fwd + XLA bwd)
+rm -rf "$OUT/profile"
+run profile 1200 python -u examples/train.py resnet50_imagenet \
+  --train.num_steps=30 --train.profile=true \
+  --train.profile_dir="$OUT/profile" \
+  --model.norm_dtype=bfloat16 --model.stem=space_to_depth \
+  --model.block_impl=fused --data.global_batch_size=256 \
+  --data.image_size=224 --checkpoint.directory= \
+  --train.log_every=10
+tar -C "$OUT" -czf "$OUT/profile.tgz" profile 2>/dev/null \
+  && echo "    profile.tgz $(du -h "$OUT/profile.tgz" | cut -f1)"
+
+# 10. LAST (can stall — r3 microbench_grad rc=124): AOT-compile the
+#     non-default Pallas backward at every bench shape
+run validate_pallas_bwd 1200 env VALIDATE_PALLAS_BWD=only \
+  python -u tools/validate_fused_tpu.py
+
+echo "=== session done; JSON lines: ==="
+grep -h '"metric"' "$OUT"/*.log 2>/dev/null
+echo "logs in $OUT"
+
+# per-step logs + BENCH_LATEST.json were preserved in-tree by run()
+# already; only the profile tarball is new work here
+cp "$OUT/profile.tgz" "$ART/profile_r4.tgz" 2>/dev/null || true
+echo "artifacts in $ART"

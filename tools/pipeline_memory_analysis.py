@@ -104,10 +104,14 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="tiny smoke grid (tests)")
+    ap.add_argument("--pod", action="store_true",
+                    help="16-device pod-shape grid (VERDICT r3 item 7): "
+                         "BERT-base over pipe=4 x data=4, global batch "
+                         "1024 — the pod-like M/S/V statement")
     args = ap.parse_args()
 
     os.environ["JAX_PLATFORMS"] = "cpu"
-    n_dev = 8
+    n_dev = 16 if args.pod else 8
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + f" --xla_force_host_platform_device_count={n_dev}"
@@ -126,6 +130,13 @@ def main() -> None:
         )
         grid = [(2, 1, 8), (2, 2, 8)]
         batch, seq = 32, 64
+    elif args.pod:
+        cfg = tfm.bert_base()
+        # S=4 x data=4 over 16 devices at pod global batch 1024; V=3
+        # is the deep-interleave point (S*V=12 = num_layers), M up to 64
+        # probes the O(M) retention term at 4x the round-3 microbatches
+        grid = [(4, V, M) for V in (1, 3) for M in (16, 32, 64)]
+        batch, seq = 1024, 512
     else:
         cfg = tfm.bert_base()
         # S*V must divide num_layers=12: V=2 pairs with S=2 only; V=3

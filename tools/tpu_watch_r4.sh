@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-4 relay watcher: probe the tunneled TPU every ~4 min; at the first
+# healthy window take the chip-session lock and fire tools/onchip_round4.sh.
+# Exits when a session has been captured (or the deadline passes) so the
+# invoking shell gets control back.
+# Usage: bash tools/tpu_watch_r4.sh [deadline_epoch_s]
+set -u
+cd "$(dirname "$0")/.."
+DEADLINE=${1:-$(($(date +%s) + 11*3600))}
+LOG=/tmp/tpu_watch_r4.log
+echo "watcher start $(date -u +%F' '%T) deadline $(date -u -d @"$DEADLINE" +%T)" | tee -a "$LOG"
+
+probe() {
+  # never probe while a chip session is live: the probe is a bare
+  # `import jax` (outside the chip_lock guard) and would contend for the
+  # single lease — the round-3 failure class. flock released => no session.
+  local LOCKF="${DTF_CHIP_LOCK:-/tmp/dtf_chip_session.lock}.flock"
+  if [ -e "$LOCKF" ] && ! flock -n "$LOCKF" true; then
+    echo "    chip session live; skipping probe" >>"$LOG"
+    return 1
+  fi
+  timeout --signal=TERM --kill-after=30 150 python -u -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+print('PROBE-OK', d, float(jax.jit(lambda a:(a@a).sum())(jnp.ones((256,256),jnp.bfloat16))))
+" >>"$LOG" 2>&1
+}
+
+n=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  n=$((n+1))
+  echo "--- probe $n $(date -u +%T)" >>"$LOG"
+  if probe; then
+    echo "=== RELAY UP at probe $n ($(date -u +%T)); firing onchip_round4.sh ===" | tee -a "$LOG"
+    bash tools/chip_session.sh bash tools/onchip_round4.sh /tmp/onchip_r4 \
+      >>"$LOG" 2>&1
+    rc=$?
+    echo "=== session rc=$rc ($(date -u +%T)) ===" | tee -a "$LOG"
+    exit $rc
+  fi
+  sleep 240
+done
+echo "watcher deadline passed without a healthy window" | tee -a "$LOG"
+exit 99

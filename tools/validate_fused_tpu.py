@@ -86,6 +86,11 @@ def bench_shape_sweep(r) -> bool:
     mode = os.environ.get("VALIDATE_PALLAS_BWD", "0")
     run_default = mode in ("0", "1")
     run_pallas = mode in ("1", "only")
+    if run_pallas:
+        # the sweep MEASURES the known-slow shapes (it is how entries in
+        # _tiling.PALLAS_BWD_KNOWN_SLOW get confirmed or retired), so it
+        # bypasses the landmine guard and times every compile
+        os.environ["DTF_FUSED_BWD_FORCE"] = "1"
     if jax.default_backend() != "tpu":
         print("skip bench-shape sweep (not on TPU; interpret mode would "
               "not exercise Mosaic VMEM limits)")
@@ -140,11 +145,14 @@ def bench_shape_sweep(r) -> bool:
 
         if run_pallas:
             def compile_pallas():
+                import time as _t
+
+                t0 = _t.perf_counter()
                 jax.jit(jax.value_and_grad(
                     conv_loss("pallas"), argnums=(0, 1, 2, 3))).lower(
                         bx, bw, bs, bsh).compile()
                 print(f"ok  bench-shape conv1x1 pallas-bwd compile "
-                      f"M={bM} {bci}->{bco}")
+                      f"M={bM} {bci}->{bco} ({_t.perf_counter()-t0:.1f}s)")
 
             guarded(f"bench-shape conv1x1 pallas-bwd compile M={bM} "
                     f"{bci}->{bco}", compile_pallas)
@@ -184,11 +192,14 @@ def bench_shape_sweep(r) -> bool:
 
         if run_pallas:
             def compile_ln_pallas():
+                import time as _t
+
+                t0 = _t.perf_counter()
                 jax.jit(jax.value_and_grad(
                     ln_loss_of("pallas"), argnums=(0, 1, 2, 3, 4))).lower(
                         bx, bg, bb, bw, bbias).compile()
                 print(f"ok  bench-shape ln_matmul pallas-bwd compile "
-                      f"M={bM} {bd}->{bn_}")
+                      f"M={bM} {bd}->{bn_} ({_t.perf_counter()-t0:.1f}s)")
 
             guarded(f"bench-shape ln_matmul pallas-bwd compile M={bM} "
                     f"{bd}->{bn_}", compile_ln_pallas)
