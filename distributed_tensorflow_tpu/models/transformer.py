@@ -78,6 +78,14 @@ class TransformerConfig:
     # recompute_grad, and the standard lever for long-sequence/large-batch
     # HBM pressure (task brief: trade FLOPs for memory).
     remat: bool = False
+    # Project q/k/v with ONE [d, 3·d] matmul ("qkv") instead of three
+    # [d, d] matmuls — one larger MXU call, one read of the residual
+    # stream instead of three (megatron-style fused QKV). GSPMD path
+    # only: incompatible with fused_ln_matmul (which owns its own
+    # projections) and with manual TP islands (tp_shards > 1); GSPMD TP
+    # shards the fused kernel columns via tp_rules and reshards to heads.
+    # Param tree differs from the unfused layout (qkv/{kernel,bias}).
+    fused_qkv: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -108,6 +116,8 @@ def gpt_small(causal_len: int = 1024) -> TransformerConfig:
 TP_PATH_RULES = (
     (r"(query|key|value)/kernel", P(None, mesh_lib.MODEL)),
     (r"(query|key|value)/bias", P(mesh_lib.MODEL)),
+    (r"qkv/kernel", P(None, mesh_lib.MODEL)),  # fused_qkv layout
+    (r"qkv/bias", P(mesh_lib.MODEL)),
     (r"attn_out/kernel", P(mesh_lib.MODEL, None)),
     (r"mlp_in/kernel", P(None, mesh_lib.MODEL)),
     (r"mlp_in/bias", P(mesh_lib.MODEL)),
@@ -196,6 +206,11 @@ class SelfAttention(nn.Module):
             raise ValueError(
                 "fused_ln_matmul is incompatible with manual TP islands"
             )
+        if cfg.fused_qkv and ln_params is not None:
+            raise ValueError(
+                "fused_qkv and fused_ln_matmul are mutually exclusive "
+                "(the LN+matmul kernel owns its own per-projection path)"
+            )
         H, D = cfg.num_heads // self.tp_shards, cfg.head_dim
         B, S, _ = x.shape
         # [B,S,Hd] -> [B,H,S,D] (ops/ layout convention)
@@ -217,6 +232,25 @@ class SelfAttention(nn.Module):
             q = split(proj("query"))
             k = split(proj("key"))
             v = split(proj("value"))
+        elif cfg.fused_qkv:
+            if self.tp_shards > 1:
+                raise ValueError(
+                    "fused_qkv is incompatible with manual TP islands "
+                    "(tp_shards > 1); use the GSPMD tp_rules path")
+            # Column order is HEAD-major ([d] -> [H, 3, D]), not
+            # projection-major ([3, H, D]): under GSPMD TP the kernel's
+            # column axis shards contiguously over `model`, and head-major
+            # grouping puts each shard's columns at whole-head boundaries
+            # (q_h/k_h/v_h co-located), so the q/k/v extraction below is
+            # shard-local — projection-major would straddle the q|k|v
+            # boundaries and force a per-layer reshard.
+            qkv = nn.Dense(
+                3 * H * D, dtype=dtype, name="qkv",
+                kernel_init=nn.initializers.normal(0.02),
+            )(x).reshape(B, S, H, 3, D)
+            q = qkv[..., 0, :].transpose(0, 2, 1, 3)  # [B,H,S,D]
+            k = qkv[..., 1, :].transpose(0, 2, 1, 3)
+            v = qkv[..., 2, :].transpose(0, 2, 1, 3)
         else:
             dense = lambda name: nn.Dense(
                 H * D, dtype=dtype, name=name,

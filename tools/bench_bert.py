@@ -20,6 +20,8 @@ Env knobs:
   BENCH_REMAT       "1" to jax.checkpoint each block (fit bigger batches)
   BENCH_ATTN        attention impl: "auto" (flash on TPU) | "dense" |
                     "blockwise" | "flash" — flash-vs-XLA-dense on chip
+  BENCH_FUSED_QKV   "1" to project q/k/v with one [d, 3d] matmul
+                    (megatron-style fused QKV) instead of three [d, d]
 """
 
 import json
@@ -84,7 +86,9 @@ def main() -> None:
             vocab_size=1024, max_len=max(seq, 128), dtype="float32",
         )
     attn = os.environ.get("BENCH_ATTN", "auto")
-    cfg = dataclasses.replace(cfg, remat=remat, attention_impl=attn)
+    fused_qkv = os.environ.get("BENCH_FUSED_QKV", "0") == "1"
+    cfg = dataclasses.replace(cfg, remat=remat, attention_impl=attn,
+                              fused_qkv=fused_qkv)
     if seq > cfg.max_len:
         raise SystemExit(f"BENCH_SEQ={seq} > max_len={cfg.max_len}")
 
@@ -167,6 +171,7 @@ def main() -> None:
         "seq_len": seq,
         "model": which,
         "fused_ln_matmul": fused_ln,
+        "fused_qkv": fused_qkv,
         "attention_impl": attn,
         "mlm_predictions": n_pred,  # None = dense head / causal LM
         "full_size_model": bool(on_tpu),

@@ -85,6 +85,7 @@ run gpt_long4k_k512 1500 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=8 \
   BENCH_REMAT=1 DTF_FLASH_BLOCK_Q=128 DTF_FLASH_BLOCK_K=512 \
   python -u tools/bench_bert.py
 run bert_remat 1200 env BENCH_REMAT=1 python -u tools/bench_bert.py
+run bert_fused_qkv 1200 env BENCH_FUSED_QKV=1 python -u tools/bench_bert.py
 # batch knee probe: does 256/chip beat 128 (HBM pressure vs MXU feed)?
 run bert_b256 1200 env BENCH_BATCH=256 BENCH_REMAT=1 python -u tools/bench_bert.py
 
