@@ -535,3 +535,25 @@ def test_tp_matches_replicated_fused_qkv(devices):
     np.testing.assert_allclose(losses_dp, losses_tp, rtol=2e-4)
     qk = state.params["layer_0"]["attn"]["qkv"]["kernel"]
     assert qk.sharding.spec == P(None, "model")
+
+
+def test_fused_qkv_tp_hlo_has_no_resharding(devices):
+    """The head-major fused-qkv column layout's design claim, pinned at
+    the HLO level: under GSPMD TP the q/k/v extraction is shard-local —
+    the compiled attention forward contains NO all-to-all and NO
+    all-gather (the only collective is attn_out's row-parallel
+    all-reduce, which unfused TP needs too)."""
+    mesh = build_mesh(MeshSpec(model=2), devices[:2])
+    cfg = tiny_cfg(fused_qkv=True, attention_impl="dense")
+    sa = tfm.SelfAttention(cfg, None)
+    x = jnp.zeros((2, 16, cfg.d_model), jnp.float32)
+    params = sa.init(jax.random.PRNGKey(0), x, None, train=False)["params"]
+    specs = sh.specs_from_path_rules(params, tfm.tp_rules())
+    put = jax.device_put(params, sh.tree_shardings(mesh, specs))
+
+    fwd = jax.jit(lambda p, x: sa.apply({"params": p}, x, None, train=False))
+    with mesh:
+        hlo = fwd.lower(put, x).compile().as_text()
+    assert "all-to-all" not in hlo, "q/k/v extraction resharded"
+    assert "all-gather" not in hlo, "projection output gathered"
+    assert "all-reduce" in hlo  # TP really distributed the math
