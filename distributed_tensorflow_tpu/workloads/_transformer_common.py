@@ -54,6 +54,19 @@ def transformer_parts(cfg: RunConfig, mesh, *, mlm: bool) -> WorkloadParts:
     if pipe > 1:
         import jax
 
+        if not mlm and mcfg.xent_chunk > 0:
+            # the pipelined loss computes its [microbatch, S, vocab]
+            # logits inside the schedule — microbatching already bounds
+            # the logits tier at B/M, and the chunked head is not
+            # composed with the pipeline yet. Loud, not silent:
+            import warnings
+
+            warnings.warn(
+                f"model.xent_chunk={mcfg.xent_chunk} is ignored on the "
+                "pipelined path (pipe > 1): the schedule computes "
+                "per-microbatch logits (B/M bounds that tier); set "
+                "--model.xent_chunk=0 to silence this warning")
+
         tp = mesh.shape.get(mesh_lib.MODEL, 1) > 1
         n_virtual = cfg.train.pipeline_virtual
         n_micro = cfg.train.pipeline_microbatches or 2 * pipe * n_virtual
@@ -81,8 +94,10 @@ def transformer_parts(cfg: RunConfig, mesh, *, mlm: bool) -> WorkloadParts:
     model = tfm.Transformer(mcfg, mesh)
     return WorkloadParts(
         init_fn=tfm.make_init_fn(model, cfg.data.seq_len),
-        loss_fn=tfm.mlm_loss_fn(model) if mlm else tfm.lm_loss_fn(model),
-        eval_fn=tfm.mlm_eval_fn(model) if mlm else tfm.lm_eval_fn(model),
+        loss_fn=(tfm.mlm_loss_fn(model) if mlm
+                 else tfm.causal_lm_loss(model, mcfg.xent_chunk)),
+        eval_fn=(tfm.mlm_eval_fn(model) if mlm
+                 else tfm.lm_eval_fn(model, mcfg.xent_chunk)),
         param_rules=tfm.tp_rules(),
         fsdp=True,
         **common,

@@ -25,7 +25,11 @@ from .runner import RunConfig, TrainSection, WorkloadParts
 
 
 def default_config() -> RunConfig:
-    model = tfm.gpt_small(causal_len=1024)
+    # xent_chunk: GPT-2's 50k vocab makes dense [B, S, vocab] loss
+    # logits the dominant memory term (13 GB f32 at B=128, S=512);
+    # the chunked loss is numerically identical (transformer.py)
+    model = dataclasses.replace(
+        tfm.gpt_small(causal_len=1024), xent_chunk=256)
     return RunConfig(
         workload="gpt_lm",
         model=model,

@@ -557,3 +557,46 @@ def test_fused_qkv_tp_hlo_has_no_resharding(devices):
     assert "all-to-all" not in hlo, "q/k/v extraction resharded"
     assert "all-gather" not in hlo, "projection output gathered"
     assert "all-reduce" in hlo  # TP really distributed the math
+
+
+def test_chunked_lm_loss_matches_dense():
+    """chunked_lm_loss_fn (scan over sequence chunks, logits never
+    materialized at [B,S,V]) is numerically identical to lm_loss_fn:
+    loss, accuracy, and every gradient leaf — including the tied
+    embedding, whose gradient accumulates across chunks."""
+    cfg = tiny_cfg(causal=True, pre_ln=True)
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 16)(jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 16)),
+        jnp.int32)
+    mask = jnp.ones((4, 16), jnp.int32).at[:, -3:].set(0)  # ragged tail
+    batch = {"input_ids": ids, "attention_mask": mask}
+
+    dense = tfm.lm_loss_fn(model)
+    for chunk in (4, 8, 16):  # multi-chunk, mid, single-chunk edge
+        chunked = tfm.chunked_lm_loss_fn(model, chunk)
+        (ld, (_, md)), gd = jax.value_and_grad(
+            lambda p: dense(p, {}, batch, rng), has_aux=True)(params)
+        (lc, (_, mc)), gc = jax.value_and_grad(
+            lambda p: chunked(p, {}, batch, rng), has_aux=True)(params)
+        np.testing.assert_allclose(float(lc), float(ld), rtol=1e-6)
+        np.testing.assert_allclose(float(mc["accuracy"]),
+                                   float(md["accuracy"]), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5),
+            gc, gd)
+
+    # non-dividing chunk errors loudly
+    with pytest.raises(ValueError, match="not divisible"):
+        tfm.chunked_lm_loss_fn(model, 5)(params, {}, batch, rng)
+
+    # the chunked EVAL stats match the dense eval exactly too (a
+    # large-vocab run must not OOM at its own final eval)
+    se_dense = tfm.lm_eval_fn(model)(params, {}, batch)
+    se_chunk = tfm.lm_eval_fn(model, 4)(params, {}, batch)
+    for k in se_dense:
+        np.testing.assert_allclose(
+            float(se_chunk[k]), float(se_dense[k]), rtol=1e-6, err_msg=k)

@@ -66,8 +66,15 @@ def main() -> None:
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
     seq = int(os.environ.get("BENCH_SEQ", "512"))
     # per-chip, like bench.py: the number scales with slice size instead
-    # of silently shrinking per chip
-    per_chip_batch = int(os.environ.get("BENCH_BATCH", "128" if on_tpu else "8"))
+    # of silently shrinking per chip. GPT's default is smaller than
+    # BERT's because the causal LM loss materializes full [B, S, vocab]
+    # logits (every position is a target — no gathered head): at
+    # B=128, S=512, V=50304 that is 13 GB in f32 before the backward,
+    # far over a v5e's HBM. B=32 bounds the logits tier at ~8 GB
+    # (bf16 + f32 + dlogits); BENCH_BATCH probes the knee either way.
+    default_batch = ("8" if not on_tpu
+                     else "32" if which == "gpt" else "128")
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", default_batch))
     global_batch = per_chip_batch * n_chips
 
     if which == "bert":
@@ -97,8 +104,13 @@ def main() -> None:
         f"attn={attn} seq={seq} global_batch={global_batch}")
 
     model = tfm.Transformer(cfg, mesh)
+    # BENCH_XENT_CHUNK (gpt only): chunk size for the sequence-chunked
+    # causal-LM loss — default 128 keeps peak logits memory at
+    # [B, 128, vocab] instead of [B, S, vocab]; 0 = dense loss A/B
+    xent_chunk = int(os.environ.get(
+        "BENCH_XENT_CHUNK", "128" if which == "gpt" else "0"))
     loss_fn = tfm.mlm_loss_fn(model) if which == "bert" \
-        else tfm.lm_loss_fn(model)
+        else tfm.causal_lm_loss(model, xent_chunk)
     tx = make_optimizer(OptimizerConfig(
         name="adamw", learning_rate=1e-4, weight_decay=0.01,
     ))
@@ -172,6 +184,7 @@ def main() -> None:
         "model": which,
         "fused_ln_matmul": fused_ln,
         "fused_qkv": fused_qkv,
+        "xent_chunk": xent_chunk,
         "attention_impl": attn,
         "mlm_predictions": n_pred,  # None = dense head / causal LM
         "full_size_model": bool(on_tpu),

@@ -53,7 +53,7 @@ LATEST=$(grep -h '"metric"' "$OUT"/bench_auto.log 2>/dev/null | tail -1)
 #    window dies here
 run bert 1200 python -u tools/bench_bert.py
 run gpt_plain 1200 env BENCH_MODEL=gpt python -u tools/bench_bert.py
-run gpt_long4k 1500 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=8 \
+run gpt_long4k 1500 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=4 \
   BENCH_REMAT=1 python -u tools/bench_bert.py
 
 # 4. first-ever embedding-tier number (VERDICT r3 item 5)
@@ -81,8 +81,15 @@ run bert_wide_flash 1200 env DTF_FLASH_BLOCK_Q=256 DTF_FLASH_BLOCK_K=512 \
 run bert_dense_attn 1200 env BENCH_ATTN=dense python -u tools/bench_bert.py
 run gpt_fused_ln 1200 env BENCH_MODEL=gpt BENCH_FUSED_LN=1 \
   python -u tools/bench_bert.py
-run gpt_long4k_k512 1500 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=8 \
+run gpt_long4k_k512 1500 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=4 \
   BENCH_REMAT=1 DTF_FLASH_BLOCK_Q=128 DTF_FLASH_BLOCK_K=512 \
+  python -u tools/bench_bert.py
+# GPT batch knee: does 64/chip fit past the [B,S,vocab] logits tier?
+run gpt_b64 1200 env BENCH_MODEL=gpt BENCH_BATCH=64 BENCH_REMAT=1 \
+  python -u tools/bench_bert.py
+# chunked-xent A/B: the dense [B,S,vocab] loss at the same batch
+# (expected to lose on memory pressure or OOM — that IS the datum)
+run gpt_dense_xent 1200 env BENCH_MODEL=gpt BENCH_XENT_CHUNK=0 \
   python -u tools/bench_bert.py
 run bert_remat 1200 env BENCH_REMAT=1 python -u tools/bench_bert.py
 run bert_fused_qkv 1200 env BENCH_FUSED_QKV=1 python -u tools/bench_bert.py
