@@ -92,6 +92,13 @@ class TransformerConfig:
     # LMs at real batch sizes (13 GB f32 at B=128, S=512, V=50304).
     # 0 = dense loss. Identical math either way (parity-tested).
     xent_chunk: int = 0
+    # Input dtype of the tied-embedding vocab projection. "float32"
+    # (default) is the exact path; "bfloat16" runs the head matmul on
+    # the fast MXU tier with f32 accumulation — the standard LLM head
+    # recipe. At GPT-2 shapes the head is ~25-30% of model FLOPs and an
+    # f32 matmul runs at ~1/4 the bf16 MXU rate, so this is a large
+    # lever for causal LMs; softmax/xent always run in f32 regardless.
+    head_dtype: str = "float32"
 
     @property
     def head_dim(self) -> int:
@@ -491,7 +498,7 @@ class Transformer(nn.Module):
                          kernel_init=nn.initializers.normal(0.02))(x)
             x = nn.gelu(x)
             x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x).astype(dtype)
-        logits = tok.attend(x.astype(jnp.float32))
+        logits = _head_projection(x, tok.embedding, cfg.head_dtype)
         bias = self.param("mlm_bias", nn.initializers.zeros,
                           (cfg.vocab_size,), jnp.float32)
         return logits + bias
@@ -774,7 +781,7 @@ def pipelined_apply(
         y = nn.LayerNorm(dtype=jnp.float32).apply(
             {"params": ends["mlm_ln"]}, y
         ).astype(dtype)
-    logits = y.astype(jnp.float32) @ embed_tbl.astype(jnp.float32).T
+    logits = _head_projection(y, embed_tbl, cfg.head_dtype)
     return logits + ends["mlm_bias"]
 
 
@@ -921,16 +928,28 @@ def lm_eval_fn(model: Transformer, xent_chunk: int = 0):
             {"params": params}, ids, batch.get("attention_mask"),
             train=False, mutable=["losses"], return_hidden=True,
         )
-        return _chunked_xent_stats(h, labels, params, xent_chunk)
+        return _chunked_xent_stats(h, labels, params, xent_chunk,
+                                   model.cfg.head_dtype)
 
     return eval_fn
 
 
-def _chunked_xent_stats(h, labels, params, chunk_size: int):
+def _head_projection(x, embedding, head_dtype: str):
+    """The tied-embedding vocab projection, f32 logits out — ONE
+    definition shared by the model head and the chunked loss/eval so
+    the two cannot drift. head_dtype="float32" reproduces
+    ``Embed.attend`` exactly (f32 dot); "bfloat16" runs the matmul on
+    the fast MXU tier with f32 accumulation."""
+    hd = jnp.dtype(head_dtype)
+    return jnp.dot(x.astype(hd), embedding.astype(hd).T,
+                   preferred_element_type=jnp.float32)
+
+
+def _chunked_xent_stats(h, labels, params, chunk_size: int,
+                        head_dtype: str = "float32"):
     """Summed xent stats from hidden states, vocab head applied per
     sequence chunk (shared by chunked_lm_loss_fn and the chunked eval;
-    same projection math as the model head — Embed.attend promotes to
-    f32, then the f32 mlm_bias adds)."""
+    projection via the same :func:`_head_projection` as the model head)."""
     emb = params["tok_embed"]["embedding"]
     bias = params["mlm_bias"]
     B, S, d = h.shape
@@ -945,7 +964,7 @@ def _chunked_xent_stats(h, labels, params, chunk_size: int):
     @jax.checkpoint
     def body(carry, inp):
         hc, lc = inp
-        logits = jnp.dot(hc.astype(jnp.float32), emb.T) + bias
+        logits = _head_projection(hc, emb, head_dtype) + bias
         s = _xent_eval_stats(logits, lc)
         return (carry[0] + s["loss_sum"], carry[1] + s["correct"],
                 carry[2] + s["count"]), None
@@ -1054,7 +1073,8 @@ def chunked_lm_loss_fn(model: Transformer, chunk_size: int):
             return_hidden=True,
         )
         labels = _shifted_lm_labels(ids, batch.get("attention_mask"))
-        s = _chunked_xent_stats(h, labels, params, chunk_size)
+        s = _chunked_xent_stats(h, labels, params, chunk_size,
+                                model.cfg.head_dtype)
         count = jnp.maximum(s["count"], 1)
         loss = s["loss_sum"] / count + collect_aux_loss(mut)
         return loss, (model_state, {"accuracy": s["correct"] / count})

@@ -57,15 +57,15 @@ def transformer_parts(cfg: RunConfig, mesh, *, mlm: bool) -> WorkloadParts:
         if not mlm and mcfg.xent_chunk > 0:
             # the pipelined loss computes its [microbatch, S, vocab]
             # logits inside the schedule — microbatching already bounds
-            # the logits tier at B/M, and the chunked head is not
-            # composed with the pipeline yet. Loud, not silent:
-            import warnings
+            # the logits tier at B/M, so the chunked head is simply not
+            # needed there. Info, not a warning: xent_chunk is a stock
+            # default (gpt_lm), and a default must not warn about itself.
+            import logging
 
-            warnings.warn(
-                f"model.xent_chunk={mcfg.xent_chunk} is ignored on the "
-                "pipelined path (pipe > 1): the schedule computes "
-                "per-microbatch logits (B/M bounds that tier); set "
-                "--model.xent_chunk=0 to silence this warning")
+            logging.getLogger(__name__).info(
+                "pipelined path: model.xent_chunk=%d not applied — the "
+                "schedule's per-microbatch logits already bound the "
+                "logits tier at B/M", mcfg.xent_chunk)
 
         tp = mesh.shape.get(mesh_lib.MODEL, 1) > 1
         n_virtual = cfg.train.pipeline_virtual

@@ -575,10 +575,10 @@ def test_chunked_lm_loss_matches_dense():
     batch = {"input_ids": ids, "attention_mask": mask}
 
     dense = tfm.lm_loss_fn(model)
+    (ld, (_, md)), gd = jax.value_and_grad(
+        lambda p: dense(p, {}, batch, rng), has_aux=True)(params)
     for chunk in (4, 8, 16):  # multi-chunk, mid, single-chunk edge
         chunked = tfm.chunked_lm_loss_fn(model, chunk)
-        (ld, (_, md)), gd = jax.value_and_grad(
-            lambda p: dense(p, {}, batch, rng), has_aux=True)(params)
         (lc, (_, mc)), gc = jax.value_and_grad(
             lambda p: chunked(p, {}, batch, rng), has_aux=True)(params)
         np.testing.assert_allclose(float(lc), float(ld), rtol=1e-6)
@@ -600,3 +600,32 @@ def test_chunked_lm_loss_matches_dense():
     for k in se_dense:
         np.testing.assert_allclose(
             float(se_chunk[k]), float(se_dense[k]), rtol=1e-6, err_msg=k)
+
+
+def test_bf16_head_dtype():
+    """head_dtype="bfloat16" (fast-MXU vocab projection, f32 accum):
+    close to the exact f32 head, identical between the dense and chunked
+    paths (both route through _head_projection), and f32 remains
+    bit-identical to the historical Embed.attend path by construction."""
+    cfg32 = tiny_cfg(causal=True, pre_ln=True)
+    cfg16 = tiny_cfg(causal=True, pre_ln=True, head_dtype="bfloat16")
+    m32, m16 = tfm.Transformer(cfg32), tfm.Transformer(cfg16)
+    params, _ = tfm.make_init_fn(m32, 16)(jax.random.PRNGKey(0))
+    ids = jnp.asarray(
+        np.random.RandomState(1).randint(0, cfg32.vocab_size, (2, 16)),
+        jnp.int32)
+    l32 = m32.apply({"params": params}, ids)
+    l16 = m16.apply({"params": params}, ids)
+    assert l32.dtype == l16.dtype == jnp.float32
+    # bf16 rounding of ~unit-scale logits: loose absolute tolerance
+    np.testing.assert_allclose(np.asarray(l16), np.asarray(l32),
+                               atol=0.05, rtol=0.05)
+    assert not np.array_equal(np.asarray(l16), np.asarray(l32))
+
+    # chunked loss == dense loss EXACTLY at bf16 head too (same
+    # _head_projection on both sides)
+    batch = {"input_ids": ids}
+    rng = jax.random.PRNGKey(2)
+    ld, _ = tfm.lm_loss_fn(m16)(params, {}, batch, rng)
+    lc, _ = tfm.chunked_lm_loss_fn(m16, 4)(params, {}, batch, rng)
+    np.testing.assert_allclose(float(lc), float(ld), rtol=1e-6)

@@ -94,8 +94,12 @@ def main() -> None:
         )
     attn = os.environ.get("BENCH_ATTN", "auto")
     fused_qkv = os.environ.get("BENCH_FUSED_QKV", "0") == "1"
+    # BENCH_HEAD_DTYPE=bfloat16 runs the tied-embedding vocab projection
+    # on the fast MXU tier (f32 accumulation) — the ~25-30%-of-FLOPs
+    # GPT head currently runs f32 at ~1/4 rate; f32 default = exact path
+    head_dtype = os.environ.get("BENCH_HEAD_DTYPE", "float32")
     cfg = dataclasses.replace(cfg, remat=remat, attention_impl=attn,
-                              fused_qkv=fused_qkv)
+                              fused_qkv=fused_qkv, head_dtype=head_dtype)
     if seq > cfg.max_len:
         raise SystemExit(f"BENCH_SEQ={seq} > max_len={cfg.max_len}")
 
@@ -106,9 +110,12 @@ def main() -> None:
     model = tfm.Transformer(cfg, mesh)
     # BENCH_XENT_CHUNK (gpt only): chunk size for the sequence-chunked
     # causal-LM loss — default 128 keeps peak logits memory at
-    # [B, 128, vocab] instead of [B, S, vocab]; 0 = dense loss A/B
-    xent_chunk = int(os.environ.get(
-        "BENCH_XENT_CHUNK", "128" if which == "gpt" else "0"))
+    # [B, 128, vocab] instead of [B, S, vocab]; 0 = dense loss A/B.
+    # The default only engages when it divides BENCH_SEQ (a default must
+    # not make previously-valid seq lengths fail); an explicit env value
+    # stays strict and raises on non-dividing shapes.
+    default_chunk = "128" if which == "gpt" and seq % 128 == 0 else "0"
+    xent_chunk = int(os.environ.get("BENCH_XENT_CHUNK", default_chunk))
     loss_fn = tfm.mlm_loss_fn(model) if which == "bert" \
         else tfm.causal_lm_loss(model, xent_chunk)
     tx = make_optimizer(OptimizerConfig(
@@ -185,6 +192,7 @@ def main() -> None:
         "fused_ln_matmul": fused_ln,
         "fused_qkv": fused_qkv,
         "xent_chunk": xent_chunk,
+        "head_dtype": head_dtype,
         "attention_impl": attn,
         "mlm_predictions": n_pred,  # None = dense head / causal LM
         "full_size_model": bool(on_tpu),

@@ -91,6 +91,9 @@ run gpt_b64 1200 env BENCH_MODEL=gpt BENCH_BATCH=64 BENCH_REMAT=1 \
 # (expected to lose on memory pressure or OOM — that IS the datum)
 run gpt_dense_xent 1200 env BENCH_MODEL=gpt BENCH_XENT_CHUNK=0 \
   python -u tools/bench_bert.py
+# bf16 vocab-head A/B: the ~25-30%-of-FLOPs head on the fast MXU tier
+run gpt_head_bf16 1200 env BENCH_MODEL=gpt BENCH_HEAD_DTYPE=bfloat16 \
+  python -u tools/bench_bert.py
 run bert_remat 1200 env BENCH_REMAT=1 python -u tools/bench_bert.py
 run bert_fused_qkv 1200 env BENCH_FUSED_QKV=1 python -u tools/bench_bert.py
 # batch knee probe: does 256/chip beat 128 (HBM pressure vs MXU feed)?
