@@ -956,7 +956,9 @@ def _chunked_xent_stats(h, labels, params, chunk_size: int,
     C = min(chunk_size, S)
     if S % C:
         raise ValueError(
-            f"seq len {S} not divisible by xent chunk size {C}")
+            f"seq len {S} not divisible by xent chunk size {C} — set "
+            f"model.xent_chunk (BENCH_XENT_CHUNK in the bench) to a "
+            f"divisor of the sequence length, or 0 for the dense loss")
     N = S // C
     hs = h.reshape(B, N, C, d).swapaxes(0, 1)      # [N, B, C, d]
     ls = labels.reshape(B, N, C).swapaxes(0, 1)    # [N, B, C]

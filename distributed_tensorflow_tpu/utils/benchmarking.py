@@ -32,7 +32,54 @@ import numpy as np
 __all__ = [
     "honor_env_platform", "describe_devices", "sync_by_value",
     "timed_steps", "fall_back_to_cpu_if_unreachable",
+    "probe_cache_path", "read_probe_cache", "write_probe_cache",
 ]
+
+
+def probe_cache_path() -> str:
+    """Location of the shared relay-probe cache (watcher + bench
+    harnesses agree through DTF_PROBE_CACHE)."""
+    import os
+
+    return os.environ.get("DTF_PROBE_CACHE", "/tmp/dtf_relay_probe.json")
+
+
+def read_probe_cache(ttl_s: float) -> bool | None:
+    """Last relay-probe verdict if fresh: True (healthy) / False (down) /
+    None (no cache, stale, or unreadable).
+
+    The watcher probes every few minutes and records each verdict via
+    :func:`write_probe_cache`; the driver-invoked bench must not burn a
+    scarce healthy window re-deriving what the watcher just measured
+    (VERDICT r4 weak #1), nor hang 150 s re-discovering a dead relay.
+    """
+    import json
+
+    try:
+        with open(probe_cache_path()) as f:
+            rec = json.load(f)
+        age = time.time() - float(rec["ts"])
+        if 0 <= age <= ttl_s:
+            return bool(rec["healthy"])
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return None
+
+
+def write_probe_cache(healthy: bool, source: str = "probe") -> None:
+    """Record a relay-probe verdict (atomic rename; best-effort)."""
+    import json
+    import os
+
+    path = probe_cache_path()
+    try:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), "healthy": bool(healthy),
+                       "source": source}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
 
 
 def honor_env_platform() -> None:
@@ -51,47 +98,138 @@ def honor_env_platform() -> None:
     pin_cpu_if_locked()
 
 
-def fall_back_to_cpu_if_unreachable(timeout_s: int = 150,
-                                    log=lambda s: None) -> bool:
-    """Pin this process to CPU when the tunneled accelerator is
-    unreachable (the axon relay has died mid-session repeatedly —
-    PERF_NOTES.md). Backend init BLOCKS forever when the relay is down,
-    so the probe runs device init in a subprocess under an external
-    timeout; the killed child never acquired a device lease.
+# The one probe payload every harness agrees on (tools/probe.py runs the
+# same bytes): init the backend, ASSERT the accelerator platform (a
+# silent CPU fallback must read as DOWN, never as healthy-in-cache), and
+# force one tiny jit through the relay — init alone can succeed while
+# the compile path is wedged (round-3 remote_compile HTTP 500s).
+PROBE_PAYLOAD = (
+    "import jax, jax.numpy as jnp\n"
+    "d = jax.devices()\n"
+    "assert d and (d[0].platform == 'tpu'\n"
+    "              or getattr(d[0], 'device_kind', '')"
+    ".upper().startswith('TPU')), d\n"
+    "print('PROBE-OK', d,\n"
+    "      float(jax.jit(lambda a: (a @ a).sum())"
+    "(jnp.ones((256, 256), jnp.bfloat16))))\n"
+)
 
-    Only the ambient platform config ("axon" baked into the environment,
-    or unset) falls back; an operator's explicit JAX_PLATFORMS pin is
-    honored untouched. BENCH_SKIP_PROBE=1 skips the probe (sweeps/
-    retries that already know the relay state). Returns True when the
-    fallback was applied."""
-    import os
+
+def _probe_subprocess(timeout_s: float, log) -> bool | None:
+    """One relay probe (PROBE_PAYLOAD) in a subprocess under an external
+    timeout. True = healthy, False = init/compile failed or wrong
+    platform, None = hung past the timeout (backend init BLOCKS forever
+    when the relay is down; the killed child never acquired a device
+    lease)."""
     import subprocess
     import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c", PROBE_PAYLOAD],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+        if proc.returncode == 0:
+            for line in proc.stdout.strip().splitlines()[-1:]:
+                log(line)  # the PROBE-OK line: devices + jit result
+            return True
+        log("accelerator probe failed; stderr tail:")
+        for line in proc.stderr.splitlines()[-5:]:
+            log("  " + line)
+        return False
+    except subprocess.TimeoutExpired:
+        log(f"accelerator probe hung >{timeout_s}s (relay down?)")
+        return None
+
+
+def probe_with_retry(timeout_s: float, log=lambda s: None,
+                     first_timeout_s: float | None = None) -> bool:
+    """THE relay-probe policy, shared by the bench ladder and
+    tools/probe.py so cache semantics cannot drift: run PROBE_PAYLOAD,
+    believe any definitive verdict at once, and retry a single HANG at
+    the full budget — a lone slow probe must not read as a dead relay.
+    ``first_timeout_s`` lets the cached-healthy path use a short
+    confirming budget for the first attempt."""
+    verdict = _probe_subprocess(first_timeout_s or timeout_s, log)
+    if verdict is None:
+        verdict = _probe_subprocess(timeout_s, log)
+    return verdict is True
+
+
+def fall_back_to_cpu_if_unreachable(timeout_s: int = 90,
+                                    log=lambda s: None,
+                                    ttl_s: float = 300.0) -> bool:
+    """Pin this process to CPU when the tunneled accelerator is
+    unreachable (the axon relay has died mid-session repeatedly —
+    PERF_NOTES.md). Decision ladder, cheapest evidence first:
+
+    1. An explicit non-ambient ``JAX_PLATFORMS`` pin or
+       ``BENCH_SKIP_PROBE=1`` wins untouched (sweeps/retries that
+       already know the relay state).
+    2. A LIVE chip-session lock pins CPU immediately — the probe itself
+       is a bare device init and would contend for the single lease
+       (the round-3 collision class; chip_lock.py).
+    3. A fresh watcher probe verdict (``write_probe_cache``, TTL
+       ``ttl_s``): "down" falls back with zero probe latency; "healthy"
+       still runs one SHORT confirming probe (the relay can die within
+       the TTL, and trusting a stale "healthy" would hang the driver's
+       backend init forever — a lost row, worse than a CPU row).
+    4. No/stale cache: probe at ``timeout_s``, retrying a hang once
+       (VERDICT r4 item 3 — don't lose a real window to one slow probe).
+
+    Every probe verdict is written back to the cache for the next
+    harness in line. Returns True when the CPU fallback was applied."""
+    import os
 
     env_pin = os.environ.get("JAX_PLATFORMS", "").strip()
     if env_pin not in ("", "axon"):
         return False
     if os.environ.get("BENCH_SKIP_PROBE") == "1":
         return False
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True, text=True,
-        )
-        if proc.returncode == 0:
-            # cache the healthy result for this process tree: children
-            # (sweeps, retries) skip the duplicate backend-init probe
-            os.environ["BENCH_SKIP_PROBE"] = "1"
-            return False
-        log("accelerator probe failed; falling back to CPU. stderr tail:")
-        for line in proc.stderr.splitlines()[-5:]:
-            log("  " + line)
-    except subprocess.TimeoutExpired:
-        log(f"accelerator probe hung >{timeout_s}s (relay down?); "
-            "falling back to CPU")
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    jax.config.update("jax_platforms", "cpu")
-    return True
+
+    from .chip_lock import pin_cpu_if_locked
+
+    if pin_cpu_if_locked(log=log):
+        return True
+
+    def fall_back() -> bool:
+        log("falling back to CPU")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        return True
+
+    def healthy() -> bool:
+        # cache the healthy result for this process tree: children
+        # (sweeps, retries) skip the duplicate backend-init probe
+        os.environ["BENCH_SKIP_PROBE"] = "1"
+        write_probe_cache(True, source="bench")
+        return False
+
+    cached = read_probe_cache(ttl_s)
+    if cached is False:
+        log(f"relay probe cache says DOWN (<{ttl_s:.0f}s old); "
+            "skipping the probe")
+        return fall_back()
+    if cached is True:
+        log(f"relay probe cache says healthy (<{ttl_s:.0f}s old); "
+            "running short confirming probe")
+        # short first budget; probe_with_retry keeps a hung confirm from
+        # poisoning the shared cache without a full-budget second look
+        if probe_with_retry(timeout_s, log,
+                            first_timeout_s=min(45.0, timeout_s)):
+            return healthy()
+        write_probe_cache(False, source="bench")
+        return fall_back()
+
+    # No/stale cache: full-budget probe. Healthy init through the relay
+    # is ~16-20 s measured (r3 probe.log, r5 transcripts), so 90 s is
+    # already a generous multiple; two hangs are a dead relay, not a
+    # slow one.
+    ok = probe_with_retry(timeout_s, log)
+    write_probe_cache(ok, source="bench")
+    if ok:
+        return healthy()
+    return fall_back()
 
 
 def describe_devices() -> tuple[list, int, str, bool]:

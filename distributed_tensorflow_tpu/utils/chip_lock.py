@@ -19,8 +19,14 @@ it a mechanism:
 Scope: any Python process that imports ``distributed_tensorflow_tpu``
 (or runs pytest, whose conftest pins CPU unconditionally) cannot steal
 the lease while a session runs. A bare ``import jax`` that never touches
-this package remains outside the guard — there is no in-repo hook for
-that (cwd ``sitecustomize`` is not imported by CPython's site init).
+this package has no automatic in-repo hook (cwd ``sitecustomize`` is not
+imported by CPython's site init, and the one sitecustomize slot is the
+environment-owned ``/root/.axon_site``); the session therefore writes a
+sourceable env file at ``<lock>.env`` (``export JAX_PLATFORMS=cpu`` +
+``unset PALLAS_AXON_POOL_IPS`` — the env pin alone is NOT enough for a
+fresh interpreter here, see tools/chip_session.sh) for ad-hoc shells,
+and relay probes go through ``tools/probe.py``, which refuses to probe
+while the flock is held (VERDICT r4 item 4).
 
 Reference analog: TF's in-process cluster tests serialize device access
 via per-test servers ($TF multi_worker_test_base.py); the single tunneled
@@ -40,7 +46,7 @@ def lock_path() -> str:
     return os.environ.get("DTF_CHIP_LOCK", _DEFAULT_LOCK)
 
 
-def lock_holder() -> int | None:
+def lock_holder(_retry: bool = True) -> int | None:
     """Pid of the live chip-session holder, or None (no lock / stale /
     held by this process tree).
 
@@ -60,23 +66,49 @@ def lock_holder() -> int | None:
     if pid <= 0 or pid == os.getpid():
         return None
 
-    def _stale() -> None:
+    flock_path = lock_path() + ".flock"
+
+    def _stale(sidecar: bool = False) -> None:
         try:  # killed session left the file behind: clean up best-effort
             os.unlink(lock_path())
         except OSError:
             pass
+        if sidecar:
+            # Also drop the orphaned sidecar: a later hand-written pid
+            # file next to it would otherwise be judged solely by the
+            # flock probe forever (ADVICE r4). Only when the kernel lock
+            # was just observed acquirable — a held flock is a live
+            # session and its sidecar must survive.
+            try:
+                os.unlink(flock_path)
+            except OSError:
+                pass
 
-    flock_path = lock_path() + ".flock"
     if os.path.exists(flock_path):
         import fcntl
 
         try:
             with open(flock_path) as fl:
                 fcntl.flock(fl, fcntl.LOCK_EX | fcntl.LOCK_NB)
-                # acquirable => no session holds it (auto-released on
-                # close); the pid file is leftover state
-                _stale()
-                return None
+                # acquirable => no session holds THIS inode. But between
+                # our open and the flock, another checker may have
+                # unlinked it and a NEW session recreated + locked a
+                # fresh sidecar — unlinking the path now would delete
+                # the LIVE session's files (the same TOCTOU
+                # chip_session.sh closes with its -ef verify). Only
+                # clean up when the locked fd still IS the path.
+                try:
+                    st_fd, st_path = os.fstat(fl.fileno()), os.stat(flock_path)
+                    current = (st_fd.st_dev, st_fd.st_ino) == \
+                              (st_path.st_dev, st_path.st_ino)
+                except OSError:
+                    current = False  # path gone: nothing to clean
+                if current:
+                    _stale(sidecar=True)
+                    return None
+                if _retry:  # sidecar replaced under us: re-evaluate once
+                    return lock_holder(_retry=False)
+                return pid  # unsettled race: CPU pin is the safe default
         except BlockingIOError:
             return pid  # genuinely held by a live session
         except OSError:
@@ -111,6 +143,10 @@ def pin_cpu_if_locked(log=None) -> bool:
     log(f"chip-session lock held by live pid {pid} "
         f"({lock_path()}); pinning this process to CPU")
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # Children too: a fresh interpreter ignores the env pin (the axon
+    # sitecustomize overrides it — see tools/chip_session.sh), so also
+    # drop the bootstrap gate from anything this process spawns.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     try:
         import jax
 

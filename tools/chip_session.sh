@@ -3,14 +3,50 @@
 # lock. Every python process that imports distributed_tensorflow_tpu (or
 # runs pytest) while this lock is held pins itself to CPU — see
 # distributed_tensorflow_tpu/utils/chip_lock.py for the protocol.
+#
+# Bare-`import jax` scripts that never import the framework are outside
+# that guard (there is no in-repo sitecustomize hook: site init imports
+# the environment-owned /root/.axon_site/sitecustomize.py first). Two
+# mitigations while the session runs:
+#   - an env file at $LOCK.env exporting JAX_PLATFORMS=cpu, for any
+#     shell to source before running ad-hoc python
+#     (`source /tmp/dtf_chip_session.lock.env 2>/dev/null`)
+#   - the protocol: relay probes go through tools/probe.py, which
+#     refuses to touch the device while the flock is held.
 # Usage: bash tools/chip_session.sh CMD [ARGS...]
 set -u
 LOCK=${DTF_CHIP_LOCK:-/tmp/dtf_chip_session.lock}
-exec 9>>"$LOCK.flock"
-if ! flock -n 9; then
+# Acquire-and-verify loop: a stale-lock checker (chip_lock._stale) may
+# unlink the sidecar between our open and flock — we could then hold a
+# lock on an UNLINKED inode while a later session locks a fresh one,
+# breaking mutual exclusion. After locking, verify fd 9 still names the
+# path (-ef compares device+inode); reopen on mismatch. The checker
+# also holds the flock for the instant it unlinks, so one transient
+# flock failure gets brief retries before reading as a live session.
+got=
+for attempt in 1 2 3 4 5; do
+  exec 9>>"$LOCK.flock"
+  if flock -n 9; then
+    if [ "$LOCK.flock" -ef "/proc/$$/fd/9" ]; then got=1; break; fi
+    # sidecar unlinked under us: reopen the fresh inode and re-lock
+  else
+    sleep 0.2
+  fi
+done
+if [ -z "$got" ]; then
   echo "chip_session: another session already holds $LOCK.flock" >&2
   exit 97
 fi
 echo $$ >"$LOCK"
-trap 'rm -f "$LOCK"' EXIT INT TERM
+# MEASURED (round 5): JAX_PLATFORMS=cpu alone does NOT pin a bare-jax
+# process here — the axon sitecustomize's register() overrides the
+# env-derived config default, and backend init then dials the relay
+# (hangs when it's down, contends when it's up). The effective pin for
+# a fresh interpreter is disabling the bootstrap gate as well.
+{
+  echo '# chip session live; removed on exit'
+  echo 'export JAX_PLATFORMS=cpu'
+  echo 'unset PALLAS_AXON_POOL_IPS'
+} >"$LOCK.env"
+trap 'rm -f "$LOCK" "$LOCK.env"' EXIT INT TERM
 DTF_CHIP_SESSION=1 "$@"

@@ -37,7 +37,8 @@ sys.path.insert(0, REPO)
 V5E_HBM_GIB = 16.0
 
 
-def analyze(S: int, V: int, M: int, *, batch: int, seq: int, cfg, data_ax=1):
+def analyze(S: int, V: int, M: int, *, batch: int, seq: int, cfg,
+            data_ax=1, mlm=True):
     import jax
     import jax.numpy as jnp
     import optax
@@ -61,26 +62,30 @@ def analyze(S: int, V: int, M: int, *, batch: int, seq: int, cfg, data_ax=1):
     state, sspecs = init_train_state(
         init_fn, tx, mesh, jax.random.PRNGKey(0), param_specs=specs,
     )
+    piped = (tfm.pipelined_mlm_loss_fn if mlm else tfm.pipelined_lm_loss_fn)
     step = make_train_step(
-        tfm.pipelined_mlm_loss_fn(cfg, mesh, n_microbatches=M,
-                                  n_virtual=V),
+        piped(cfg, mesh, n_microbatches=M, n_virtual=V),
         tx, StepOptions(),
     )
     jitted = jit_train_step(step, mesh, sspecs)
-    # gathered-head MLM format — the bert_pretrain default; K from the
-    # ONE definition of the auto rule (data/text.py)
-    from distributed_tensorflow_tpu.data.text import (
-        TextDataConfig, resolved_max_predictions,
-    )
+    if mlm:
+        # gathered-head MLM format — the bert_pretrain default; K from
+        # the ONE definition of the auto rule (data/text.py)
+        from distributed_tensorflow_tpu.data.text import (
+            TextDataConfig, resolved_max_predictions,
+        )
 
-    K = resolved_max_predictions(
-        TextDataConfig(seq_len=seq, max_predictions=-1))
-    batch_tree = {
-        "input_ids": jnp.zeros((batch, seq), jnp.int32),
-        "masked_positions": jnp.tile(jnp.arange(K, dtype=jnp.int32),
-                                     (batch, 1)),
-        "masked_labels": jnp.zeros((batch, K), jnp.int32),
-    }
+        K = resolved_max_predictions(
+            TextDataConfig(seq_len=seq, max_predictions=-1))
+        batch_tree = {
+            "input_ids": jnp.zeros((batch, seq), jnp.int32),
+            "masked_positions": jnp.tile(jnp.arange(K, dtype=jnp.int32),
+                                         (batch, 1)),
+            "masked_labels": jnp.zeros((batch, K), jnp.int32),
+        }
+    else:
+        # causal-LM: labels are shifted input_ids inside the loss
+        batch_tree = {"input_ids": jnp.zeros((batch, seq), jnp.int32)}
     batch_tree = jax.tree.map(
         lambda x: jax.device_put(
             x, NamedSharding(mesh, sh.batch_spec(x.ndim))), batch_tree,
@@ -108,9 +113,35 @@ def main() -> None:
                     help="16-device pod-shape grid (VERDICT r3 item 7): "
                          "BERT-base over pipe=4 x data=4, global batch "
                          "1024 — the pod-like M/S/V statement")
+    ap.add_argument("--check", metavar="JSON",
+                    help="single-config estimate for the runner's "
+                         "pipeline-memory guard (VERDICT r4 item 8a): "
+                         '{"model": <TransformerConfig dict>, "S":, '
+                         '"V":, "M":, "batch":, "seq":, "mlm":}. '
+                         "Prints ONE JSON row.")
     args = ap.parse_args()
 
     os.environ["JAX_PLATFORMS"] = "cpu"
+    if args.check:
+        req = json.loads(args.check)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={req['S']}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+        from distributed_tensorflow_tpu.models import transformer as tfm
+        from distributed_tensorflow_tpu.utils import config as config_lib
+
+        cfg = config_lib.from_dict(tfm.TransformerConfig, req["model"])
+        row = analyze(req["S"], req["V"], req["M"], batch=req["batch"],
+                      seq=req["seq"], cfg=cfg, data_ax=1,
+                      mlm=bool(req.get("mlm", True)))
+        print(json.dumps(row), flush=True)
+        return
+
     n_dev = 16 if args.pod else 8
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")

@@ -40,8 +40,11 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # commit the evidence immediately: only committed files survive a
     # round end, and the session may land with no builder turns left
     git add artifacts/onchip_r4 >>"$LOG" 2>&1
+    # pathspec-restricted: must not sweep unrelated staged work into the
+    # auto-commit (ADVICE r4)
     git commit -m "Round-4 on-chip session artifacts (auto-committed by the relay watcher)" \
-      >>"$LOG" 2>&1 || echo "watcher: nothing to commit" >>"$LOG"
+      -- artifacts/onchip_r4 >>"$LOG" 2>&1 \
+      || echo "watcher: nothing to commit" >>"$LOG"
     exit $rc
   fi
   sleep 240
