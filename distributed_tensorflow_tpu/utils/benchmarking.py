@@ -158,7 +158,7 @@ def probe_with_retry(timeout_s: float, log=lambda s: None,
 
 def fall_back_to_cpu_if_unreachable(timeout_s: int = 90,
                                     log=lambda s: None,
-                                    ttl_s: float = 300.0) -> bool:
+                                    ttl_s: float = 480.0) -> bool:
     """Pin this process to CPU when the tunneled accelerator is
     unreachable (the axon relay has died mid-session repeatedly —
     PERF_NOTES.md). Decision ladder, cheapest evidence first:
@@ -176,6 +176,12 @@ def fall_back_to_cpu_if_unreachable(timeout_s: int = 90,
        backend init forever — a lost row, worse than a CPU row).
     4. No/stale cache: probe at ``timeout_s``, retrying a hang once
        (VERDICT r4 item 3 — don't lose a real window to one slow probe).
+
+    The default ``ttl_s`` covers one full watcher cycle in the worst
+    (outage) case — 240 s sleep + up to 180 s of hung probe — plus a
+    real margin for interpreter/subprocess overhead per cycle, so a
+    DOWN verdict stays fresh across it and the driver never re-pays the
+    180 s discovery; a HEALTHY verdict that old is still confirm-probed.
 
     Every probe verdict is written back to the cache for the next
     harness in line. Returns True when the CPU fallback was applied."""

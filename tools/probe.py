@@ -15,7 +15,9 @@ Why a tool instead of `python -c "import jax; jax.devices()"`:
   backend init blocks forever when the relay is down.
 
 Exit codes: 0 healthy, 1 down/hung, 2 skipped (chip session live).
-Usage: python tools/probe.py [timeout_s]   (default 120)
+Usage: python tools/probe.py [timeout_s]   (default 90, the budget every
+call site and the cache-TTL arithmetic standardize on; healthy init is
+16-20 s measured)
 """
 
 import os
@@ -26,7 +28,7 @@ sys.path.insert(0, REPO)
 
 
 def main() -> int:
-    timeout_s = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
+    timeout_s = float(sys.argv[1]) if len(sys.argv) > 1 else 90.0
 
     from distributed_tensorflow_tpu.utils import benchmarking as bm
     from distributed_tensorflow_tpu.utils import chip_lock
