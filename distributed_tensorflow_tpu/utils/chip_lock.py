@@ -143,6 +143,11 @@ def pin_cpu_if_locked(log=None) -> bool:
     log(f"chip-session lock held by live pid {pid} "
         f"({lock_path()}); pinning this process to CPU")
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # Record WHY this process tree is CPU-pinned, at the moment the
+    # decision is made: consumers (bench.py's chip_session_live stamp)
+    # must not re-probe the lock later — the session can start/stop in
+    # between and flip the answer (review r5).
+    os.environ["DTF_CHIP_PINNED"] = "1"
     # Children too: a fresh interpreter ignores the env pin (the axon
     # sitecustomize overrides it — see tools/chip_session.sh), so also
     # drop the bootstrap gate from anything this process spawns.

@@ -250,6 +250,42 @@ def test_probe_tool_writes_cache_and_respects_lock(tmp_path, monkeypatch):
 
 
 @pytest.mark.slow
+def test_bench_row_stamps_live_chip_session(tmp_path):
+    """A driver-captured CPU row that ran concurrently with an on-chip
+    session must say so (chip_session_live) — it is not a relay-down
+    row; the TPU evidence is landing in artifacts/ at that moment."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    holder = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(240)"])
+    try:
+        lock = tmp_path / "chip.lock"
+        lock.write_text(str(holder.pid))
+        env = {k: v for k, v in os.environ.items()
+               # PALLAS_AXON_POOL_IPS: measured flaky-hang cause for
+               # child interpreters (see the probe-tool test above)
+               if k not in ("DTF_CHIP_SESSION", "JAX_PLATFORMS",
+                            "PALLAS_AXON_POOL_IPS", "DTF_CHIP_PINNED")}
+        env.update({"DTF_CHIP_LOCK": str(lock), "BENCH_STEPS": "3",
+                    "DTF_PROBE_CACHE": str(tmp_path / "probe.json")})
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py")],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert row["platform"] == "cpu"
+        assert row["chip_session_live"] is True
+        assert "pinning this process to CPU" in proc.stderr
+    finally:
+        holder.kill()
+        holder.wait()
+
+
+@pytest.mark.slow
 def test_bench_py_json_contract(tmp_path):
     """The driver consumes bench.py's stdout as ONE JSON line with the
     BASELINE metric schema; a regression here silently costs the round
