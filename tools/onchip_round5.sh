@@ -72,6 +72,8 @@ run() { # name timeout_s cmd...
     if ! python -u tools/probe.py 90 >>"$OUT/reprobe.log" 2>&1; then
       echo "!!! relay dead after $name; aborting session (logs kept)"
       cp "$OUT/reprobe.log" "$ART/reprobe.log" 2>/dev/null
+      python tools/summarize_onchip.py "$OUT" >"$ART/DIGEST.md" \
+        2>/dev/null  # partial digest: whatever landed before the death
       commit_art "aborted after $name (relay died mid-session)"
       exit 95
     fi
@@ -191,5 +193,9 @@ run validate_pallas_bwd 1200 env VALIDATE_PALLAS_BWD=only \
 
 echo "=== session done; JSON lines: ==="
 grep -h '"metric"' "$OUT"/*.log 2>/dev/null
+# digest lands WITH the artifacts: even a session that ends after the
+# last builder turn ships its own analysis (stdlib-only, no device use)
+python tools/summarize_onchip.py "$OUT" >"$ART/DIGEST.md" 2>/dev/null \
+  && echo "    digest -> $ART/DIGEST.md"
 echo "logs in $OUT; artifacts in $ART"
 commit_art "session complete"
