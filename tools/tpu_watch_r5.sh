@@ -42,7 +42,12 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     git commit -m "Round-5 on-chip session artifacts (auto-committed by the relay watcher)" \
       -- artifacts/onchip_r5 >>"$LOG" 2>&1 \
       || echo "watcher: nothing left to commit" >>"$LOG"
-    exit $rc
+    # a COMPLETE session retires the watcher; an incomplete one (probe
+    # flapped at start, or the mid-session dead-relay abort) re-arms —
+    # a later window can re-run the queue (r3's window was 41 min; the
+    # outage pattern allows another)
+    [ $rc -eq 0 ] && exit 0
+    echo "session incomplete (rc=$rc); re-arming" | tee -a "$LOG"
   fi
   sleep 240
 done
