@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..utils.compat import shard_map
+
 
 @dataclasses.dataclass(frozen=True)
 class ResNetConfig:
@@ -260,7 +262,7 @@ class FusedBottleneckBlock(nn.Module):
         args = (x, w1, w2, w3, wp_in, g1, b1, g2, b2, g3, b3, gp_in, bp_in)
         if axis_names:
             bspec = P(axis_names, None, None, None)
-            fn = jax.shard_map(
+            fn = shard_map(
                 block_fn,
                 mesh=self.mesh,
                 in_specs=(bspec,) + (P(),) * 12,

@@ -207,9 +207,21 @@ class SelfAttention(nn.Module):
     tp_shards: int = 1
 
     @nn.compact
-    def __call__(self, x, mask, *, train: bool, ln_params=None):
+    def __call__(self, x, mask, *, train: bool, ln_params=None,
+                 cache=None, decode_pos=None):
+        # ``cache`` = {"k","v"} [B,H,M,D] per-layer KV buffers (serve/
+        # kv_cache.py) and ``decode_pos`` [B,S] the absolute positions of
+        # the S incoming tokens: the new k/v are written at those offsets
+        # and attention runs over the updated buffers via the masked dense
+        # path (ops.attention.cached_attention) — returns (out, new_cache).
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
+        if cache is not None and self.tp_shards > 1:
+            raise ValueError(
+                "KV-cached decode supports GSPMD TP only; manual TP "
+                "islands (tp_shards > 1) hold local head slices the "
+                "cache layout does not model"
+            )
         if cfg.num_heads % self.tp_shards:
             raise ValueError(
                 f"num_heads={cfg.num_heads} not divisible by "
@@ -273,8 +285,19 @@ class SelfAttention(nn.Module):
             k = split(dense("key")(x))
             v = split(dense("value")(x))
 
+        new_cache = None
         seq_shards = self.mesh.shape[mesh_lib.SEQ] if self.mesh is not None else 1
-        if cfg.seq_impl is not None and seq_shards > 1:
+        if cache is not None:
+            from ..ops.attention import append_kv, cached_attention
+
+            start = decode_pos[:, 0]
+            ck = append_kv(cache["k"], k, start)
+            cv = append_kv(cache["v"], v, start)
+            new_cache = {"k": ck, "v": cv}
+            # masked full attention over the cache: the flash kernel does
+            # not apply at Sq=1 / per-sequence offsets (see cached_attention)
+            out = cached_attention(q, ck, cv, q_pos=decode_pos)
+        elif cfg.seq_impl is not None and seq_shards > 1:
             out = sequence_parallel_attention(
                 q, k, v, self.mesh, impl=cfg.seq_impl,
                 causal=cfg.causal, kv_mask=mask,
@@ -318,7 +341,8 @@ class SelfAttention(nn.Module):
         else:
             out = nn.Dense(cfg.d_model, dtype=dtype, name="attn_out",
                            kernel_init=nn.initializers.normal(0.02))(out)
-        return nn.Dropout(cfg.dropout, deterministic=not train)(out)
+        out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
+        return out if cache is None else (out, new_cache)
 
 
 class Block(nn.Module):
@@ -333,10 +357,13 @@ class Block(nn.Module):
     tp_shards: int = 1
 
     @nn.compact
-    def __call__(self, x, mask, train: bool):
+    def __call__(self, x, mask, train: bool, cache=None, decode_pos=None):
         # ``train`` is positional (not kw-only) so nn.remat can mark it
         # static (static_argnums counts the module itself as arg 0) —
         # but deliberately has no default: every call site must decide.
+        # ``cache``/``decode_pos``: KV-cached decode (see SelfAttention);
+        # the return becomes (x, new_cache). Never combined with remat —
+        # decode is forward-only.
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         tp = self.tp_shards
@@ -345,7 +372,18 @@ class Block(nn.Module):
         if tp > 1 and cfg.d_ff % tp:
             raise ValueError(f"d_ff={cfg.d_ff} not divisible by tp={tp}")
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
-        attn = SelfAttention(cfg, self.mesh, tp_shards=tp, name="attn")
+        base_attn = SelfAttention(cfg, self.mesh, tp_shards=tp, name="attn")
+
+        new_cache = [None]  # box: closed over by the three attn call sites
+
+        def attn(h, **kw):
+            if cache is None:
+                return base_attn(h, mask, train=train, **kw)
+            y, new_cache[0] = base_attn(
+                h, mask, train=train, cache=cache, decode_pos=decode_pos,
+                **kw,
+            )
+            return y
 
         if self.use_moe:
             from ..ops.moe import MoEConfig, MoEMLP
@@ -406,7 +444,7 @@ class Block(nn.Module):
 
             B, S, d = x.shape
             ln1 = _LNParams(d, name="ln1")()
-            x = x + attn(x, mask, train=train, ln_params=ln1)
+            x = x + attn(x, ln_params=ln1)
             ls2, lb2 = _LNParams(d, name="ln2")()
             wi, bi = _DenseParams(cfg.d_ff, d, name="mlp_in")()
             h = ln_matmul(
@@ -415,12 +453,12 @@ class Block(nn.Module):
             ).reshape(B, S, cfg.d_ff)
             x = x + mlp_tail(h)
         elif cfg.pre_ln:
-            x = x + attn(ln("ln1")(x).astype(dtype), mask, train=train)
+            x = x + attn(ln("ln1")(x).astype(dtype))
             x = x + mlp(ln("ln2")(x).astype(dtype))
         else:  # post-LN (BERT)
-            x = ln("ln1")(x + attn(x, mask, train=train)).astype(dtype)
+            x = ln("ln1")(x + attn(x)).astype(dtype)
             x = ln("ln2")(x + mlp(x)).astype(dtype)
-        return x
+        return x if cache is None else (x, new_cache[0])
 
 
 class Transformer(nn.Module):
@@ -444,10 +482,39 @@ class Transformer(nn.Module):
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, *,
                  train: bool = False, positions=None,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False,
+                 kv_cache=None, decode_pos=None):
+        # ``kv_cache`` (serve.kv_cache.KVCache: k/v [L,B,H,M,D]) with
+        # ``decode_pos`` [B,S] switches on the serving path: the S incoming
+        # tokens sit at those ABSOLUTE positions (prefill: arange(P);
+        # decode: the per-sequence write index, S=1), attention runs over
+        # the per-layer cache buffers, and the return is (logits, new
+        # kv_cache). Causal models only; ``attention_mask`` is rejected —
+        # validity is the contiguous-fill predicate (ops.cached_attention).
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         B, S = input_ids.shape
+        if kv_cache is not None:
+            if not cfg.causal:
+                raise ValueError("KV-cached decode requires causal=True")
+            if decode_pos is None:
+                raise ValueError("kv_cache requires decode_pos [B,S]")
+            if attention_mask is not None:
+                raise ValueError(
+                    "attention_mask cannot be honored on the kv_cache "
+                    "path: validity is the contiguous-fill predicate "
+                    "(ops.cached_attention), which assumes real tokens "
+                    "start at slot position 0 — left-padded prompts "
+                    "would silently attend to garbage"
+                )
+            if train:
+                raise ValueError("KV-cached decode is inference-only")
+            if return_hidden:
+                raise ValueError(
+                    "kv_cache with return_hidden would drop the updated "
+                    "cache (the hidden-state early return predates the "
+                    "(logits, new_cache) contract)"
+                )
         tok = nn.Embed(cfg.vocab_size, cfg.d_model, name="tok_embed",
                        embedding_init=nn.initializers.normal(0.02))
         x = tok(input_ids)
@@ -455,7 +522,13 @@ class Transformer(nn.Module):
             "pos_embed", nn.initializers.normal(0.02),
             (cfg.max_len, cfg.d_model), jnp.float32,
         )
-        x = (x + pos[None, :S]).astype(dtype)
+        if kv_cache is not None:
+            # per-sequence absolute positions (clip guards the padded tail
+            # of a bucketed prefill, whose rows are discarded anyway)
+            x = (x + pos[jnp.clip(decode_pos, 0, cfg.max_len - 1)]
+                 ).astype(dtype)
+        else:
+            x = (x + pos[None, :S]).astype(dtype)
         if not cfg.pre_ln:
             x = nn.LayerNorm(dtype=jnp.float32, name="embed_ln")(x).astype(dtype)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
@@ -465,15 +538,25 @@ class Transformer(nn.Module):
         # O(1)-block activation memory (cfg.remat docstring). argnums:
         # 0 = module, 1 = x, 2 = mask, 3 = train (static python bool).
         block_cls = (
-            nn.remat(Block, static_argnums=(3,)) if cfg.remat else Block
+            nn.remat(Block, static_argnums=(3,))
+            if cfg.remat and kv_cache is None else Block
         )
+        new_k, new_v = [], []
         for i in range(cfg.num_layers):
             use_moe = (
                 cfg.num_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
             )
-            x = block_cls(cfg, self.mesh, use_moe, name=f"layer_{i}")(
-                x, mask, train
-            )
+            block = block_cls(cfg, self.mesh, use_moe, name=f"layer_{i}")
+            if kv_cache is not None:
+                x, lc = block(
+                    x, mask, train,
+                    cache={"k": kv_cache.k[i], "v": kv_cache.v[i]},
+                    decode_pos=decode_pos,
+                )
+                new_k.append(lc["k"])
+                new_v.append(lc["v"])
+            else:
+                x = block(x, mask, train)
         if cfg.pre_ln:
             x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x).astype(dtype)
 
@@ -501,6 +584,13 @@ class Transformer(nn.Module):
         logits = _head_projection(x, tok.embedding, cfg.head_dtype)
         bias = self.param("mlm_bias", nn.initializers.zeros,
                           (cfg.vocab_size,), jnp.float32)
+        if kv_cache is not None:
+            # same dataclass type in, same out — no serve/ import here,
+            # so models/ stays independent of the serving subsystem
+            new_cache = dataclasses.replace(
+                kv_cache, k=jnp.stack(new_k), v=jnp.stack(new_v)
+            )
+            return logits + bias, new_cache
         return logits + bias
 
 

@@ -55,6 +55,56 @@ def attention_reference(
     ).astype(q.dtype)
 
 
+def append_kv(
+    buf: jax.Array, new: jax.Array, start: jax.Array
+) -> jax.Array:
+    """Write ``new`` [B,H,S,D] into the KV ring buffer ``buf`` [B,H,M,D]
+    at per-sequence offsets ``start`` [B] (the continuous-batching write
+    index: each slot in the decode batch is at a different position).
+    The written positions are ``start[b] .. start[b]+S-1``; callers
+    guarantee ``start[b]+S <= M`` (the scheduler's max-len eviction)."""
+    return jax.vmap(
+        lambda cb, nb, s: jax.lax.dynamic_update_slice_in_dim(
+            cb, nb.astype(cb.dtype), s, axis=1
+        )
+    )(buf, new, start)
+
+
+def cached_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    q_pos: jax.Array,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Masked full attention over a KV cache — the decode/prefill form.
+
+    ``q`` [B,H,S,D] are the current step's queries at ABSOLUTE positions
+    ``q_pos`` [B,S] (prefill: 0..P-1; decode: the per-sequence write
+    index, S=1); ``k``/``v`` [B,H,M,D] are the full cache buffers. Key
+    slot ``j`` participates iff ``j <= q_pos`` — causality and
+    valid-length masking in one predicate, because the cache is filled
+    contiguously from 0, so every slot at or below the newest written
+    position holds a real token and everything above is stale garbage.
+
+    This is the fallback the flash kernel can't cover: Pallas flash
+    attention wants Sq a block multiple and a monotone causal frontier,
+    while decode is Sq=1 against M cached keys with per-sequence offsets.
+    Dense f32 softmax(QKᵀ)V matches ``attention_reference`` numerics, so
+    cached decode is bit-comparable to the uncached forward."""
+    M = k.shape[2]
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * _scale(q, sm_scale)
+    mask = jnp.arange(M)[None, None, :] <= q_pos[:, :, None]  # [B,S,M]
+    logits = jnp.where(mask[:, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", probs.astype(v.dtype), v
+    ).astype(q.dtype)
+
+
 def blockwise_attention(
     q: jax.Array,
     k: jax.Array,

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..utils.compat import axis_size, shard_map
 
 from ..parallel import mesh as mesh_lib
 
@@ -79,7 +79,7 @@ def mod_sharded_lookup(
     [ceil(V/n), D] shard. One psum over ``axis`` replaces the reference's
     PS gather round-trip (§3.1: variable read = gRPC hop per step).
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     part = _owned_lookup(ids, local_table, lax.axis_index(axis), n)
     return lax.psum(part, axis)
 
@@ -111,7 +111,7 @@ def batch_sharded_lookup(
     sharded over ``axis``. all_gather ids → local contributions →
     reduce_scatter back to the caller's batch slice. Wire-equivalent to the
     TPUEmbedding all_to_all exchange, static-shaped."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     all_ids = lax.all_gather(ids, axis, axis=0, tiled=True)
     part = _owned_lookup(all_ids, local_table, lax.axis_index(axis), n)
     return lax.psum_scatter(part, axis, scatter_dimension=0, tiled=True)

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compat import axis_size as _compat_axis_size
+
 AxisNames = str | tuple[str, ...]
 Groups = Sequence[Sequence[int]] | None
 
@@ -44,7 +46,7 @@ EMULATED_GROUP_AXIS_LIMIT = 8
 
 
 def _check_emulated_groups(axis: str, groups, verb: str) -> None:
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     if n > EMULATED_GROUP_AXIS_LIMIT:
         raise ValueError(
             f"{verb}(groups=...) over axis {axis!r} of size {n}: the "
@@ -78,7 +80,7 @@ def _group_mask(axis: str, groups) -> jax.Array:
     That is the idiomatic TPU-native form of the reference's NCCL
     communicator subgroups / CrossReplicaSum ``group_assignment``
     ($TF tpu_ops.py:32-40)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     groups_arr = jnp.asarray(groups)  # (G, M), a partition of range(n)
     g = groups_arr.shape[0]
     membership = jnp.zeros((g, n), jnp.float32)  # membership[g, i] = i in group g
@@ -196,7 +198,7 @@ def ring_permute(x, axis: str, *, shift: int = 1):
     """Rotate shards around the axis ring (device i → i+shift mod N): the
     K/V-block rotation of ring attention (SURVEY.md §5.7). ICI's torus makes
     each hop a single physical link."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm=perm)
 
@@ -206,4 +208,4 @@ def axis_index(axis: AxisNames):
 
 
 def axis_size(axis: str) -> int:
-    return lax.axis_size(axis)
+    return _compat_axis_size(axis)

@@ -64,6 +64,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import mesh as mesh_lib
+from ..utils.compat import shard_map
 
 
 def stage_param_specs(stage_params: Any) -> Any:
@@ -226,7 +227,7 @@ def pipeline_apply(
         _pipeline_body, stage_fn, n_stages=n_stages, n_microbatches=M,
         n_virtual=V,
     )
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(param_specs, x_spec, aux_specs, P()),

@@ -1,0 +1,35 @@
+"""TPU-native inference serving: KV-cached decode with continuous batching.
+
+The fifth subsystem — the first that makes the framework an inference
+stack rather than a trainer. Composition over the existing layers, per
+the TF-Replicator thin-layer lesson (PAPERS.md): the cache is an
+ordinary pytree placed by parallel/sharding.py rules, the decode path is
+the SAME ``models.Transformer`` with a ``kv_cache`` argument, attention
+falls back to the masked dense form where the flash kernel doesn't apply
+(ops.attention.cached_attention), and the engine is a host-drives/
+device-computes loop like train/loop.py. See docs/serving.md.
+"""
+
+from .decode import (  # noqa: F401
+    decode_step,
+    jit_decode_step,
+    jit_prefill,
+    prefill,
+    prefill_bucket,
+)
+from .engine import ServeEngine, StepStats  # noqa: F401
+from .kv_cache import (  # noqa: F401
+    CACHE_LOGICAL,
+    KVCache,
+    cache_specs,
+    init_cache,
+    shard_cache,
+)
+from .sampling import sample  # noqa: F401
+from .scheduler import (  # noqa: F401
+    FINISH_EOS,
+    FINISH_MAX_LEN,
+    FINISH_MAX_NEW,
+    Request,
+    Scheduler,
+)

@@ -1,0 +1,108 @@
+"""Prefill and single-token decode steps over the slotted KV cache.
+
+The two jit units of the serving engine:
+
+- **prefill** — run one request's prompt through the model with a
+  single-slot view of the cache (gather the slot's [L,1,H,M,D] rows,
+  apply, scatter back). Writes K/V for positions ``0..P-1`` and returns
+  the next-token logits from the last REAL prompt position (prompts are
+  padded to a bucket length so each bucket compiles once; padded rows
+  produce garbage logits that are never read, and the garbage K/V they
+  write above ``P`` stays masked until real tokens overwrite it).
+- **decode_step** — one token for EVERY slot at once ([num_slots, 1]
+  inputs at per-slot write positions). Idle slots decode garbage that is
+  simply never delivered — uniform shapes keep ONE compiled program hot
+  regardless of which subset of slots is live, which is the continuous-
+  batching contract: admission/eviction never triggers a recompile.
+
+Numerics: the cache path runs the same f32 masked softmax(QKᵀ)V as the
+dense reference (ops.attention.cached_attention docstring), so cached
+decode logits match the uncached full-context forward — asserted to
+rtol 1e-4 and 64-step greedy equality in tests/test_serve.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.transformer import Transformer
+from .kv_cache import KVCache
+
+
+def prefill(
+    model: Transformer,
+    params,
+    cache: KVCache,
+    slot: jax.Array,
+    tokens: jax.Array,
+    length: jax.Array,
+) -> tuple[jax.Array, KVCache]:
+    """Prefill one slot. ``tokens`` [P] int32 (padded prompt), ``length``
+    the real prompt length, ``slot`` the target cache row. Returns
+    (next-token logits [vocab] f32, updated cache)."""
+    P = tokens.shape[0]
+    row = lambda buf: lax.dynamic_slice_in_dim(buf, slot, 1, axis=1)
+    slot_cache = dataclasses.replace(cache, k=row(cache.k), v=row(cache.v))
+    pos = jnp.arange(P, dtype=jnp.int32)[None]
+    logits, slot_cache = model.apply(
+        {"params": params}, tokens[None], kv_cache=slot_cache,
+        decode_pos=pos,
+    )
+    put = lambda buf, upd: lax.dynamic_update_slice_in_dim(
+        buf, upd, slot, axis=1
+    )
+    new_cache = dataclasses.replace(
+        cache, k=put(cache.k, slot_cache.k), v=put(cache.v, slot_cache.v)
+    )
+    return logits[0, length - 1], new_cache
+
+
+def decode_step(
+    model: Transformer,
+    params,
+    cache: KVCache,
+    tokens: jax.Array,
+    lengths: jax.Array,
+) -> tuple[jax.Array, KVCache]:
+    """One decode step for all slots. ``tokens`` [num_slots] — each
+    slot's most recent token; ``lengths`` [num_slots] — each slot's
+    write index (= tokens already in its cache). Returns (next-token
+    logits [num_slots, vocab] f32, updated cache)."""
+    logits, cache = model.apply(
+        {"params": params}, tokens[:, None], kv_cache=cache,
+        decode_pos=lengths[:, None],
+    )
+    return logits[:, 0], cache
+
+
+def jit_prefill(model: Transformer):
+    """Compiled prefill; one compile per (prompt-bucket, cache shape).
+
+    The cache argument is DONATED: XLA aliases it into the returned
+    cache, so a step updates the resident buffers in place instead of
+    paying a full cache copy (and 2× cache HBM) per call — same reason
+    train/step.py donates the train state. Callers must rebind
+    (``logits, cache = fn(params, cache, ...)``), never reuse the old
+    pytree; the engine already does."""
+    return jax.jit(partial(prefill, model), donate_argnums=(1,))
+
+
+def jit_decode_step(model: Transformer):
+    """Compiled decode step; one compile per cache shape. The cache is
+    donated (see jit_prefill)."""
+    return jax.jit(partial(decode_step, model), donate_argnums=(1,))
+
+
+def prefill_bucket(length: int, *, minimum: int = 8) -> int:
+    """Pad a prompt length to the next power of two (≥ ``minimum``): a
+    handful of compiled prefill programs cover every prompt length, the
+    classic bucketing trade against XLA's static shapes."""
+    b = minimum
+    while b < length:
+        b *= 2
+    return b

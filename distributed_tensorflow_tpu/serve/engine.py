@@ -1,0 +1,214 @@
+"""ServeEngine — the user-facing submit/step/stream loop.
+
+Ties the pieces together: a jit-compiled prefill and decode step
+(decode.py) over one resident KVCache (kv_cache.py), driven by the
+continuous-batching scheduler (scheduler.py), with sampling.py choosing
+tokens. One engine ``step()`` is the serving analog of one train step:
+
+1. **Admit + prefill.** Every request the scheduler can place into a
+   free slot is prefilled (one compiled program per prompt bucket), and
+   its first token is sampled from the last prompt position's logits.
+2. **Decode.** One fused decode step advances EVERY slot by one token
+   ([num_slots, 1] inputs — idle slots compute garbage that is never
+   delivered, keeping a single compiled program hot at any occupancy).
+3. **Deliver + evict.** Sampled tokens are appended via the scheduler,
+   which evicts finished requests (EOS / max-new / max-len) so their
+   slots are re-admissible on the NEXT step's admit phase.
+
+Everything device-side is shape-static; everything dynamic (queue
+state, per-slot write indices, request lifetimes) lives host-side in
+plain Python/numpy — the same host-drives/device-computes split as the
+training loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import Transformer, TransformerConfig, make_init_fn
+from . import decode as decode_lib
+from . import sampling
+from .kv_cache import KVCache, init_cache
+from .scheduler import Request, Scheduler
+
+
+@dataclasses.dataclass
+class StepStats:
+    """What one engine step did (tools/bench_serve.py aggregates these)."""
+
+    admitted: int = 0
+    decoded_slots: int = 0
+    occupancy: float = 0.0
+    #: (uid, token) pairs in delivery order — a uid can appear twice in
+    #: one step (its prefill token AND its first decode token)
+    tokens: list[tuple[int, int]] = dataclasses.field(default_factory=list)
+    finished: list[int] = dataclasses.field(default_factory=list)
+
+
+class ServeEngine:
+    """KV-cached continuous-batching inference over a causal Transformer.
+
+    >>> eng = ServeEngine.with_random_params(cfg, num_slots=4)
+    >>> uid = eng.submit([5, 17, 3], max_new_tokens=16)
+    >>> for tok in eng.stream([5, 17, 3]):
+    ...     print(tok)
+    """
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params,
+        *,
+        num_slots: int = 4,
+        max_len: int | None = None,
+        cache_dtype=None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: int = 0,
+    ):
+        if not cfg.causal:
+            raise ValueError("ServeEngine requires a causal (decoder) model")
+        self.cfg = cfg
+        self.params = params
+        self.model = Transformer(cfg)
+        self.cache: KVCache = init_cache(
+            cfg, num_slots, max_len=max_len, dtype=cache_dtype
+        )
+        self.sched = Scheduler(num_slots, self.cache.max_len)
+        self.temperature = temperature
+        self.top_k = top_k
+        self._rng = jax.random.PRNGKey(seed)
+        # per-slot host state: cache write index and most recent token
+        self._written = np.zeros(num_slots, np.int32)
+        self._last = np.zeros(num_slots, np.int32)
+        self._prefill = decode_lib.jit_prefill(self.model)
+        self._decode = decode_lib.jit_decode_step(self.model)
+
+    @classmethod
+    def with_random_params(
+        cls, cfg: TransformerConfig, *, seed: int = 0, **kw
+    ) -> "ServeEngine":
+        """Random-weight engine for demos/benches (examples/serve.py)."""
+        params, _ = make_init_fn(Transformer(cfg), min(8, cfg.max_len))(
+            jax.random.PRNGKey(seed)
+        )
+        return cls(cfg, params, seed=seed, **kw)
+
+    # -- public API --------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Iterable[int],
+        max_new_tokens: int = 32,
+        eos_id: int | None = None,
+    ) -> int:
+        return self.sched.submit(prompt, max_new_tokens, eos_id)
+
+    def step(self) -> StepStats:
+        """Admit + prefill newly placed requests, then advance every
+        active slot by one decode token. Returns per-step stats."""
+        stats = StepStats()
+        for slot, req in self.sched.admit():
+            stats.admitted += 1
+            self._do_prefill(slot, req, stats)
+        active = self.sched.active_slots()
+        if active:
+            self._do_decode(active, stats)
+        return stats
+
+    def stream(
+        self,
+        prompt: Iterable[int],
+        max_new_tokens: int = 32,
+        eos_id: int | None = None,
+    ) -> Iterator[int]:
+        """Submit one request and yield its tokens as they are decoded
+        (other queued requests keep making progress in the same steps)."""
+        uid = self.submit(prompt, max_new_tokens, eos_id)
+        delivered = 0
+        while True:
+            self.step()
+            req = self._find(uid)
+            while delivered < len(req.generated):
+                yield req.generated[delivered]
+                delivered += 1
+            if req.done:
+                self.sched.finished.pop(uid, None)  # delivered in full
+                return
+
+    def run(self) -> dict[int, Request]:
+        """Drain queue + slots to completion; returns (and forgets)
+        uid → Request, so repeated run() calls don't accumulate."""
+        while self.sched.has_work:
+            self.step()
+        return self.sched.drain_finished()
+
+    # -- internals ---------------------------------------------------------
+
+    def _find(self, uid: int) -> Request:
+        req = self.sched.finished.get(uid)
+        if req is not None:
+            return req
+        for r in self.sched.slots:
+            if r is not None and r.uid == uid:
+                return r
+        for r in self.sched.queue:
+            if r.uid == uid:
+                return r
+        raise KeyError(f"unknown request uid {uid}")
+
+    def _next_rng(self) -> jax.Array | None:
+        if self.temperature <= 0.0:
+            return None
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _deliver(self, slot: int, token: int, stats: StepStats) -> None:
+        req = self.sched.slots[slot]
+        stats.tokens.append((req.uid, token))
+        finished = self.sched.append_token(slot, token)
+        if finished is not None:
+            stats.finished.append(finished.uid)
+            self._written[slot] = 0  # idle slots park their write index at 0
+
+    def _do_prefill(self, slot: int, req: Request, stats: StepStats) -> None:
+        P = len(req.prompt)
+        bucket = min(decode_lib.prefill_bucket(P), self.cache.max_len)
+        toks = np.zeros(bucket, np.int32)
+        toks[:P] = req.prompt
+        logits, self.cache = self._prefill(
+            self.params, self.cache, slot, toks, P
+        )
+        tok = int(
+            sampling.sample(
+                logits, self._next_rng(),
+                temperature=self.temperature, top_k=self.top_k,
+            )
+        )
+        self._written[slot] = P
+        self._last[slot] = tok
+        self._deliver(slot, tok, stats)
+
+    def _do_decode(self, active: list[int], stats: StepStats) -> None:
+        stats.decoded_slots = len(active)
+        stats.occupancy = len(active) / self.sched.num_slots
+        logits, self.cache = self._decode(
+            self.params, self.cache,
+            jnp.asarray(self._last), jnp.asarray(self._written),
+        )
+        toks = np.asarray(
+            sampling.sample(
+                logits, self._next_rng(),
+                temperature=self.temperature, top_k=self.top_k,
+            )
+        )
+        for slot in active:
+            self._written[slot] += 1  # the decode wrote k/v at the old index
+            tok = int(toks[slot])
+            self._last[slot] = tok
+            self._deliver(slot, tok, stats)

@@ -1,0 +1,99 @@
+"""Slotted KV cache — the resident state of the decode engine.
+
+Layout: one pair of buffers for the whole model, layers stacked on the
+leading axis::
+
+    k, v : [num_layers, num_slots, num_heads, max_len, head_dim]
+
+``num_slots`` is the fixed decode-batch width (continuous batching keeps
+it full by admitting a queued request the moment a slot frees up —
+scheduler.py); ``max_len`` is the per-slot token budget. Each slot is a
+ring-less append buffer with a per-sequence write index owned by the
+engine: a slot's positions ``0..written-1`` hold real tokens and
+everything above is stale garbage that ``cached_attention``'s
+``j <= q_pos`` predicate masks, so slot reuse needs NO zeroing — a new
+request's prefill simply overwrites from position 0.
+
+Sharding: the cache is a pytree like any other, so the rules of
+parallel/sharding.py apply unchanged (docs/serving.md): the ``heads``
+dim shards over ``model`` exactly as the attention weights do under
+TP_RULES (a TP shard holds the K/V of its own heads — no gather), and
+the ``slots`` dim shards over the batch axes ``(data, fsdp)`` like any
+input batch. ``CACHE_LOGICAL`` names the dims; ``cache_specs`` maps them
+through a logical-rule table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import TransformerConfig
+from ..parallel import sharding
+
+
+@dataclasses.dataclass
+class KVCache:
+    """k/v: [num_layers, num_slots, num_heads, max_len, head_dim]."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[3]
+
+    def nbytes(self) -> int:
+        return self.k.nbytes + self.v.nbytes
+
+
+jax.tree_util.register_dataclass(
+    KVCache, data_fields=["k", "v"], meta_fields=[]
+)
+
+#: Logical dim names of each cache buffer, resolvable by the same rule
+#: tables that place the model weights (sharding.spec_from_logical).
+CACHE_LOGICAL = ("layers", "batch", "heads", "len", "kv")
+
+
+def init_cache(
+    cfg: TransformerConfig,
+    num_slots: int,
+    max_len: int | None = None,
+    dtype: str | jnp.dtype | None = None,
+) -> KVCache:
+    """Zero-filled cache for ``cfg``. ``max_len`` defaults to the model's
+    context window; ``dtype`` to the model compute dtype (bf16 on TPU —
+    halving cache HBM is usually the right serving trade; tests pin
+    float32 for exact parity with the uncached forward)."""
+    M = cfg.max_len if max_len is None else max_len
+    if M > cfg.max_len:
+        raise ValueError(
+            f"cache max_len={M} exceeds the model context window "
+            f"(cfg.max_len={cfg.max_len}: pos_embed has no row for it)"
+        )
+    dt = jnp.dtype(cfg.dtype if dtype is None else dtype)
+    shape = (cfg.num_layers, num_slots, cfg.num_heads, M, cfg.head_dim)
+    return KVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
+
+
+def cache_specs(rules: sharding.LogicalRules | None = None) -> KVCache:
+    """PartitionSpec pytree for the cache under ``rules`` (default
+    TP_RULES: heads → ``model``, slots → ``(data, fsdp)``). Feed to
+    ``sharding.shard_tree`` / ``jax.jit`` in/out shardings."""
+    rules = sharding.TP_RULES if rules is None else rules
+    spec = sharding.spec_from_logical(CACHE_LOGICAL, rules)
+    return KVCache(k=spec, v=spec)
+
+
+def shard_cache(
+    cache: KVCache, mesh, rules: sharding.LogicalRules | None = None
+) -> KVCache:
+    """Place the cache on a mesh per ``cache_specs`` (device_put)."""
+    return sharding.shard_tree(cache, mesh, cache_specs(rules))

@@ -1,0 +1,38 @@
+"""Token sampling: greedy / temperature / top-k.
+
+One function, batch-shaped: ``sample(logits [..., V], rng)``. Greedy
+(``temperature <= 0``) is pure argmax — deterministic, rng ignored —
+which is what the decode-parity tests and the bench use. Temperature
+scales logits before a Gumbel draw (``jax.random.categorical``); top-k
+first floors everything below the k-th logit so the tail can never be
+drawn. All in f32 — the head already emits f32 logits (models/
+transformer.py head_dtype docstring), and sampling is far off the FLOPs
+critical path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import NEG_INF
+
+
+def sample(
+    logits: jax.Array,
+    rng: jax.Array | None = None,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """logits [..., V] → token ids [...] (int32)."""
+    logits = logits.astype(jnp.float32)
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if rng is None:
+        raise ValueError("temperature > 0 requires an rng key")
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,149 @@
+"""Continuous-batching scheduler — deterministic, jax-free, CPU-testable.
+
+Policy (the MLPerf lesson applied to serving: batching discipline, not
+FLOPs, decides utilization — PAPERS.md):
+
+- **FIFO admission.** Requests queue in submission order; the moment a
+  decode slot frees, the head of the queue is admitted into it. No
+  reordering, no priorities — fairness is positional.
+- **Fixed decode-batch slots.** The decode batch is ``num_slots`` wide,
+  always. The scheduler's job is to keep occupancy at 1.0 whenever the
+  queue is non-empty (asserted by tools/bench_serve.py).
+- **Evict on EOS / max-new / max-len.** A request leaves its slot the
+  step it finishes: its own ``eos_id``, its ``max_new_tokens`` budget,
+  or the slot's ``max_len`` cache budget (prompt + written tokens). The
+  freed slot is re-admissible in the SAME engine step — prefill/decode
+  interleaving with no idle step.
+
+All state is plain Python (deque + list), so every invariant — no slot
+leaks, FIFO order, eviction conditions — is testable with no model and
+no device (tests/test_serve.py::test_scheduler_invariants).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Iterable
+
+#: why a request finished
+FINISH_EOS = "eos"
+FINISH_MAX_NEW = "max_new_tokens"
+FINISH_MAX_LEN = "max_len"
+
+
+@dataclasses.dataclass
+class Request:
+    uid: int
+    prompt: tuple[int, ...]
+    max_new_tokens: int
+    eos_id: int | None = None
+    generated: list[int] = dataclasses.field(default_factory=list)
+    finish_reason: str | None = None
+
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+
+class Scheduler:
+    """FIFO continuous batching over ``num_slots`` decode slots, each
+    with a ``max_len``-token KV budget (prompt + generated)."""
+
+    def __init__(self, num_slots: int, max_len: int):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.queue: deque[Request] = deque()
+        self.slots: list[Request | None] = [None] * num_slots
+        self._next_uid = 0
+        #: uid → Request, completion order. Retained until the caller
+        #: collects results (ServeEngine.run / stream); long-lived
+        #: servers must drain_finished() or history accumulates forever.
+        self.finished: dict[int, Request] = {}
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Iterable[int],
+        max_new_tokens: int = 32,
+        eos_id: int | None = None,
+    ) -> int:
+        """Enqueue a request; returns its uid."""
+        prompt = tuple(int(t) for t in prompt)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.max_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the per-slot cache "
+                f"budget max_len={self.max_len}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        req = Request(self._next_uid, prompt, max_new_tokens, eos_id)
+        self._next_uid += 1
+        self.queue.append(req)
+        return req.uid
+
+    def admit(self) -> list[tuple[int, Request]]:
+        """Move queued requests into free slots, FIFO; returns the newly
+        placed (slot, request) pairs — the engine prefills exactly
+        these."""
+        placed = []
+        for slot in range(self.num_slots):
+            if not self.queue:
+                break
+            if self.slots[slot] is None:
+                req = self.queue.popleft()
+                self.slots[slot] = req
+                placed.append((slot, req))
+        return placed
+
+    # -- decode-loop bookkeeping -------------------------------------------
+
+    def active_slots(self) -> list[int]:
+        return [i for i, r in enumerate(self.slots) if r is not None]
+
+    @property
+    def occupancy(self) -> float:
+        return len(self.active_slots()) / self.num_slots
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(
+            r is not None for r in self.slots
+        )
+
+    def append_token(self, slot: int, token: int) -> Request | None:
+        """Record a sampled token for the request in ``slot``; evict and
+        return the request if this token finishes it, else None.
+
+        Cache accounting: after ``g`` generated tokens, continuing
+        requires writing token ``g`` at cache position ``P + g - 1``, so
+        the slot is out of budget once ``P + g > max_len`` — the request
+        keeps that final token (it was sampled from in-budget state) and
+        frees the slot before an out-of-bounds write can happen."""
+        req = self.slots[slot]
+        if req is None:
+            raise ValueError(f"append_token on empty slot {slot}")
+        req.generated.append(int(token))
+        g, P = len(req.generated), len(req.prompt)
+        if req.eos_id is not None and int(token) == req.eos_id:
+            req.finish_reason = FINISH_EOS
+        elif g >= req.max_new_tokens:
+            req.finish_reason = FINISH_MAX_NEW
+        elif P + g > self.max_len:
+            req.finish_reason = FINISH_MAX_LEN
+        if req.done:
+            self.slots[slot] = None
+            self.finished[req.uid] = req
+            return req
+        return None
+
+    def drain_finished(self) -> dict[int, Request]:
+        """Hand over (and forget) all completed requests — the memory
+        bound for a long-lived engine: call after delivering results."""
+        done, self.finished = self.finished, {}
+        return done

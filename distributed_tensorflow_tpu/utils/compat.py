@@ -1,0 +1,60 @@
+"""Cross-version jax import shims — ONE home for moved/deprecated aliases.
+
+jax has moved ``shard_map`` twice: 0.4.x exposes it only at
+``jax.experimental.shard_map.shard_map``; newer releases promote it to
+``jax.shard_map`` (and eventually drop the experimental path). The
+replication-check kwarg was renamed too (``check_rep`` → ``check_vma``).
+Every module and test in this repo imports ``shard_map`` from here so a
+jax upgrade is a one-line change instead of a grep-and-pray sweep — the
+same reason the reference harness funneled its ``tf.compat`` touches
+through one module.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # jax >= 0.5.3: promoted to the top-level namespace
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+@functools.wraps(_shard_map)
+def shard_map(*args, **kwargs):
+    """``shard_map`` with the replication-check kwarg spelled either way:
+    callers may pass ``check_vma`` (new) or ``check_rep`` (old) and the
+    one the installed jax understands is forwarded."""
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(*args, **kwargs)
+
+
+try:  # jax >= 0.6: first-class axis-size query
+    from jax.lax import axis_size  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x idiom
+    def axis_size(axis_name):
+        """Size of a named mapped axis. ``psum`` of the literal ``1`` is
+        constant-folded to the axis size at trace time — the historical
+        spelling before ``lax.axis_size`` existed."""
+        import jax
+
+        return jax.lax.psum(1, axis_name)
+
+
+def cost_analysis_dict(compiled) -> dict:
+    """``Compiled.cost_analysis()`` as a flat dict across jax versions:
+    0.4.x returns a one-element LIST of per-device dicts, newer jax the
+    dict itself. Returns {} when the backend offers no analysis."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if ca else {}
+
+
+__all__ = ["axis_size", "cost_analysis_dict", "shard_map"]

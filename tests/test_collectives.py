@@ -7,7 +7,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from distributed_tensorflow_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from distributed_tensorflow_tpu.parallel import collectives as col

@@ -1,0 +1,248 @@
+"""serve/ subsystem tests: KV-cached decode parity against the uncached
+forward (the numerics acceptance gate), scheduler invariants under a
+randomized request stream, cache sharding specs, and sampling."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu import serve
+from distributed_tensorflow_tpu.models import transformer as tfm
+from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh
+from distributed_tensorflow_tpu.serve import scheduler as sched_lib
+
+
+def tiny_decoder(**kw):
+    base = dict(
+        vocab_size=128, max_len=96, num_layers=2, d_model=32, num_heads=4,
+        d_ff=64, dropout=0.0, dtype="float32", causal=True, pre_ln=True,
+    )
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def decoder():
+    cfg = tiny_decoder()
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 8)(jax.random.PRNGKey(0))
+    return cfg, model, params
+
+
+# ---------------------------------------------------------------------------
+# Numerics: cached decode == uncached forward
+# ---------------------------------------------------------------------------
+
+
+def test_cached_decode_matches_uncached_forward(decoder):
+    """Acceptance gate: per-step cached logits match the uncached
+    full-context forward to rtol 1e-4 AND the greedy token sequences are
+    identical for >= 64 steps."""
+    cfg, model, params = decoder
+    prompt = [5, 17, 3, 99, 42, 7, 11]
+    P_len, steps = len(prompt), 64
+
+    cache = serve.init_cache(cfg, 1, dtype="float32")
+    logits, cache = serve.prefill(
+        model, params, cache, 0, jnp.asarray(prompt, jnp.int32), P_len
+    )
+    step = serve.jit_decode_step(model)
+    cached_logits, toks = [logits], [int(jnp.argmax(logits))]
+    written = P_len
+    for _ in range(steps - 1):
+        logits, cache = step(
+            params, cache,
+            jnp.asarray([toks[-1]], jnp.int32),
+            jnp.asarray([written], jnp.int32),
+        )
+        written += 1
+        cached_logits.append(logits[0])
+        toks.append(int(jnp.argmax(logits[0])))
+
+    # one uncached forward over prompt + all-but-last generated token:
+    # position P-1+i predicts token i
+    seq = jnp.asarray([prompt + toks[:-1]], jnp.int32)
+    full = model.apply({"params": params}, seq)[0, P_len - 1:]
+    np.testing.assert_allclose(
+        np.stack([np.asarray(l) for l in cached_logits]), np.asarray(full),
+        rtol=1e-4, atol=1e-5,
+    )
+    assert toks == [int(t) for t in jnp.argmax(full, -1)]
+
+
+def test_prefill_bucket_invariance(decoder):
+    """Padding the prompt to a larger bucket must not change the next-
+    token logits or the written cache rows."""
+    cfg, model, params = decoder
+    prompt = jnp.asarray([9, 4, 77, 2, 60], jnp.int32)
+    P_len = 5
+    outs = []
+    for bucket in (8, 16, 32):
+        cache = serve.init_cache(cfg, 1, dtype="float32")
+        toks = jnp.zeros(bucket, jnp.int32).at[:P_len].set(prompt)
+        logits, cache = serve.prefill(
+            model, params, cache, 0, toks, P_len
+        )
+        outs.append((np.asarray(logits), np.asarray(cache.k[:, :, :, :P_len])))
+    for logits, krows in outs[1:]:
+        np.testing.assert_allclose(logits, outs[0][0], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(krows, outs[0][1], rtol=1e-5, atol=1e-6)
+
+
+def test_engine_request_isolation(decoder):
+    """Continuous batching must not leak state across slots: each
+    request's greedy completion equals its solo-engine completion, even
+    when requests queue and reuse slots."""
+    cfg, _, params = decoder
+    prompts = [[5, 17, 3], [88, 12, 61, 40, 2], [7], [33, 33, 9, 1]]
+
+    solo = []
+    for p in prompts:
+        eng = serve.ServeEngine(cfg, params, num_slots=1)
+        solo.append(list(eng.stream(p, max_new_tokens=12)))
+
+    eng = serve.ServeEngine(cfg, params, num_slots=2)  # forces queueing
+    uids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+    done = eng.run()
+    assert sorted(done) == sorted(uids)
+    for uid, want in zip(uids, solo):
+        assert done[uid].generated == want
+        assert done[uid].finish_reason == sched_lib.FINISH_MAX_NEW
+
+
+def test_engine_eos_and_max_len_eviction(decoder):
+    """EOS stops a request the step it is sampled; a prompt near the
+    cache budget finishes with the max_len reason and never writes out
+    of bounds."""
+    cfg, _, params = decoder
+    # find a token the greedy stream actually emits, then replay with it
+    # as the EOS id: the request must stop at its first occurrence
+    probe = serve.ServeEngine(cfg, params, num_slots=1)
+    toks = list(probe.stream([5, 17, 3], max_new_tokens=10))
+    eos = toks[4]
+    eng = serve.ServeEngine(cfg, params, num_slots=1)
+    uid = eng.submit([5, 17, 3], max_new_tokens=50, eos_id=eos)
+    done = eng.run()
+    assert done[uid].finish_reason == sched_lib.FINISH_EOS
+    assert done[uid].generated[-1] == eos
+    assert eos not in done[uid].generated[:-1]
+
+    long_prompt = list(range(1, cfg.max_len - 1))  # P = max_len - 2
+    eng = serve.ServeEngine(cfg, params, num_slots=1)
+    uid = eng.submit(long_prompt, max_new_tokens=50)
+    done = eng.run()
+    assert done[uid].finish_reason == sched_lib.FINISH_MAX_LEN
+    # g_max: writing token g needs position P + g - 1 <= max_len - 1
+    assert len(done[uid].generated) == cfg.max_len - len(long_prompt) + 1
+
+
+# ---------------------------------------------------------------------------
+# Scheduler invariants (no model, no device)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_invariants_random_stream():
+    """Randomized request stream, fixed seed: no slot leaks, FIFO
+    admission, correct eviction reasons, full drain."""
+    rng = random.Random(1234)
+    num_slots, max_len = 4, 32
+    s = sched_lib.Scheduler(num_slots, max_len)
+    n_reqs = 40
+    eos_id = 7
+    uids = []
+    for _ in range(n_reqs):
+        plen = rng.randint(1, max_len)
+        uids.append(s.submit(
+            [rng.randrange(100) for _ in range(plen)],
+            max_new_tokens=rng.randint(1, 12),
+            eos_id=eos_id if rng.random() < 0.5 else None,
+        ))
+    assert uids == sorted(uids)  # uids are issued in submission order
+
+    admitted_order = []
+    for step in range(10_000):
+        if not s.has_work:
+            break
+        placed = s.admit()
+        admitted_order.extend(r.uid for _, r in placed)
+        # FIFO + full occupancy: with work still queued, no slot is free
+        if s.queue:
+            assert s.occupancy == 1.0
+        # no slot double-booking
+        live = [r.uid for r in s.slots if r is not None]
+        assert len(live) == len(set(live))
+        for slot in s.active_slots():
+            s.append_token(slot, rng.randrange(100))
+    else:
+        pytest.fail("scheduler did not drain")
+
+    assert admitted_order == uids  # FIFO fairness
+    assert not s.queue and s.active_slots() == []  # no slot leaks
+    assert len(s.finished) == n_reqs
+    assert sorted(s.finished) == uids  # keyed by uid, every request lands
+    for r in s.finished.values():
+        g, p = len(r.generated), len(r.prompt)
+        assert 1 <= g <= r.max_new_tokens
+        if r.finish_reason == sched_lib.FINISH_EOS:
+            assert r.eos_id is not None and r.generated[-1] == r.eos_id
+        elif r.finish_reason == sched_lib.FINISH_MAX_NEW:
+            assert g == r.max_new_tokens
+        elif r.finish_reason == sched_lib.FINISH_MAX_LEN:
+            assert p + g > max_len and p + (g - 1) <= max_len
+        else:
+            pytest.fail(f"unknown finish reason {r.finish_reason}")
+
+
+def test_scheduler_rejects_invalid():
+    s = sched_lib.Scheduler(2, 16)
+    with pytest.raises(ValueError):
+        s.submit([])
+    with pytest.raises(ValueError):
+        s.submit(list(range(17)))  # prompt > max_len
+    with pytest.raises(ValueError):
+        s.submit([1], max_new_tokens=0)
+    with pytest.raises(ValueError):
+        s.append_token(0, 1)  # empty slot
+
+
+# ---------------------------------------------------------------------------
+# Cache sharding + sampling
+# ---------------------------------------------------------------------------
+
+
+def test_cache_specs_follow_sharding_rules():
+    """The cache pytree shards by the same logical rules as the model:
+    heads over `model`, slots over the batch axes (docs/serving.md)."""
+    spec = serve.cache_specs()
+    assert spec.k == P(None, ("data", "fsdp"), "model", None, None)
+    assert spec.v == spec.k
+
+    cfg = tiny_decoder()
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2))
+    cache = serve.init_cache(cfg, num_slots=4, dtype="float32")
+    sharded = serve.shard_cache(cache, mesh)
+    assert sharded.k.sharding == NamedSharding(mesh, spec.k)
+    # heads=4 over model=2, slots=4 over data*fsdp=4
+    assert sharded.k.addressable_shards[0].data.shape == (
+        cfg.num_layers, 1, 2, cfg.max_len, cfg.head_dim
+    )
+
+
+def test_sampling_modes():
+    logits = jnp.asarray([[0.0, 3.0, 1.0, -2.0], [5.0, 0.1, 0.2, 0.3]])
+    greedy = serve.sample(logits)
+    assert greedy.tolist() == [1, 0] and greedy.dtype == jnp.int32
+
+    key = jax.random.PRNGKey(0)
+    for i in range(20):
+        t = serve.sample(
+            logits, jax.random.fold_in(key, i), temperature=0.7, top_k=2
+        )
+        assert t[0] in (1, 2) and t[1] in (0, 3)  # top-2 of each row
+
+    with pytest.raises(ValueError):
+        serve.sample(logits, None, temperature=1.0)

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Import/collection smoke gate — seconds, not minutes.
+#
+# `pytest --collect-only` imports every test module (and through them the
+# whole package) without running a single test, so an import regression —
+# like the `from jax import shard_map` breakage this gate was added for
+# (ISSUE 1) — fails loudly here instead of silently dropping two modules
+# from the suite. Run it before pushing; CI runs it before the full suite.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ --collect-only -q \
+    -p no:cacheprovider "$@"
