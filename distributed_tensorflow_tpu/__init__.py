@@ -20,6 +20,7 @@ _pin_cpu_if_locked()
 
 from . import data  # noqa: F401
 from . import models  # noqa: F401
+from . import obs  # noqa: F401
 from . import parallel  # noqa: F401
 from . import serve  # noqa: F401
 from . import train  # noqa: F401

@@ -1,0 +1,24 @@
+"""Unified telemetry: metrics registry, host span tracing, exporters.
+
+The observability layer the reference substrate scattered across session
+hooks (StepCounterHook / SummarySaverHook / ProfilerHook on
+MonitoredTrainingSession.run) rebuilt as one subsystem with a single
+design rule: every metric is a MERGEABLE SUFFICIENT STATISTIC (counters
+and histogram buckets add; quantiles are derived at read time from
+fixed log-spaced buckets). serve/engine.py and train/callbacks.py
+record into a Registry; obs/export.py renders Prometheus text
+exposition or appends JSONL events, chief-gated. See
+docs/observability.md.
+"""
+
+from .registry import (  # noqa: F401
+    LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+    log_buckets,
+)
+from .trace import Span, Tracer, default_tracer, span  # noqa: F401
+from .export import JsonlLogger, render, serve_http  # noqa: F401

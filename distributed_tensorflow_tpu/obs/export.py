@@ -1,0 +1,174 @@
+"""Exporters: Prometheus text exposition + append-only JSONL event log.
+
+Two surfaces, zero dependencies:
+
+- ``render(registry)`` produces Prometheus text-exposition format
+  (version 0.0.4) as a string — counters as-is, gauges as-is,
+  histograms as cumulative ``_bucket{le=...}`` series plus ``_sum`` /
+  ``_count``. ``serve_http`` wraps it in a stdlib ``http.server``
+  scrape endpoint on a daemon thread; nothing outside the stdlib is
+  imported, so the export path works on a bare CI box.
+- ``JsonlLogger`` appends one JSON object per line to a local file —
+  the structured-event analog of the reference's summary event files,
+  for runs with no Prometheus to scrape. Chief-only by default
+  (parallel/cluster.is_chief), matching every other singleton-host
+  writer in the framework (checkpoint metadata, TensorBoard events):
+  N hosts × identical registries would be N copies of the same data.
+
+Merge-then-render is the multi-host story: registries are mergeable
+sufficient statistics (obs/registry.py), so a fleet aggregator can
+``merge()`` per-host snapshots and render once — percentiles stay exact
+to bucket resolution across hosts, unlike averaging per-host p99s.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Any
+
+from .registry import Histogram, Registry, default_registry
+
+__all__ = ["render", "serve_http", "JsonlLogger"]
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: shortest exact-ish decimal. Non-finite
+    values render as the format's NaN/+Inf/-Inf tokens — a diverged-loss
+    gauge must not kill the scrape endpoint."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _label_str(labels, extra: tuple[tuple[str, str], ...] = ()) -> str:
+    pairs = tuple(labels) + tuple(extra)
+    if not pairs:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (k, v.replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in pairs
+    )
+    return "{" + body + "}"
+
+
+def render(registry: Registry | None = None) -> str:
+    """Prometheus text exposition of every metric in the registry.
+
+    ``# HELP``/``# TYPE`` emitted once per metric name (label children
+    share them, as the format requires).
+    """
+    registry = registry or default_registry()
+    lines: list[str] = []
+    seen_header: set[str] = set()
+    for m in registry.collect():
+        if m.name not in seen_header:
+            seen_header.add(m.name)
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+        if isinstance(m, Histogram):
+            cum = 0
+            for bound, c in zip(m.bounds, m.counts):
+                cum += int(c)
+                lines.append(
+                    f"{m.name}_bucket"
+                    f"{_label_str(m.labels, (('le', _fmt(bound)),))} {cum}"
+                )
+            lines.append(
+                f"{m.name}_bucket"
+                f"{_label_str(m.labels, (('le', '+Inf'),))} {m.count}"
+            )
+            lines.append(f"{m.name}_sum{_label_str(m.labels)} {_fmt(m.sum)}")
+            lines.append(f"{m.name}_count{_label_str(m.labels)} {m.count}")
+        else:
+            lines.append(f"{m.name}{_label_str(m.labels)} {_fmt(m.value)}")
+    return "\n".join(lines) + "\n"
+
+
+def serve_http(registry: Registry | None = None, port: int = 9464,
+               addr: str = "127.0.0.1"):
+    """Start a daemon-thread scrape endpoint; GET /metrics renders the
+    registry live. Returns the ``http.server`` instance (call
+    ``.shutdown()`` to stop; port 0 picks a free port, read it back from
+    ``server.server_address``)."""
+    import http.server
+
+    reg = registry or default_registry()
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API name)
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_error(404)
+                return
+            body = render(reg).encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # scrapes are not log events
+            pass
+
+    server = http.server.ThreadingHTTPServer((addr, port), _Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="obs-metrics-http")
+    t.start()
+    return server
+
+
+class JsonlLogger:
+    """Append-only JSONL event log, chief-gated.
+
+    Each ``event()`` writes one line ``{"t": <unix time>, "event": kind,
+    ...fields}``; ``write_snapshot()`` dumps the full registry as one
+    event, giving a greppable time series without any scrape
+    infrastructure. Non-chief processes construct fine and no-op, so
+    call sites need no rank checks.
+    """
+
+    def __init__(self, path: str, registry: Registry | None = None,
+                 chief_only: bool = True, clock=time.time):
+        self.path = path
+        self.registry = registry or default_registry()
+        self.clock = clock
+        if chief_only:
+            from ..parallel import cluster
+
+            self.enabled = cluster.is_chief()
+        else:
+            self.enabled = True
+        self._fh = open(path, "a") if self.enabled else None
+        self._lock = threading.Lock()
+
+    def event(self, kind: str, **fields: Any) -> None:
+        if self._fh is None:
+            return
+        rec = {"t": round(self.clock(), 6), "event": kind, **fields}
+        with self._lock:
+            self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._fh.flush()
+
+    def write_snapshot(self, **fields: Any) -> None:
+        """One event carrying the whole registry state."""
+        self.event("snapshot", metrics=self.registry.snapshot(), **fields)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,133 @@
+"""Host-side span tracer — nested wall-clock spans with optional
+jax.profiler pass-through.
+
+The train/serve loops are host-drives/device-computes: device time shows
+up in jax.profiler's XPlane traces, but HOST decisions (admission,
+prefill bucketing, checkpoint blocking, data stalls) are invisible
+there. A ``Span`` is the host-side unit: a named context manager that
+records wall-clock duration, nesting depth, and a dotted path
+("step.prefill.sample"), and — when ``annotate=True`` and a jax profiler
+trace is active — wraps the region in ``jax.profiler.TraceAnnotation``
+so the same name appears on the device timeline in TensorBoard, lining
+host spans up against the XLA programs they dispatched.
+
+Spans can feed an obs.registry.Registry: every completed span observes
+its duration into a ``trace_span_seconds{span=<path>}`` histogram, so
+p50/p99 of any instrumented region falls out of the same export path as
+the serve/train metrics.
+
+Thread model: the active-span stack is a ``threading.local`` — each
+thread gets independent nesting; a shared Tracer aggregates all of them
+(registry updates are mergeable statistics, see obs/registry.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+from .registry import Registry
+
+__all__ = ["Span", "Tracer", "span", "default_tracer"]
+
+SPAN_HISTOGRAM = "trace_span_seconds"
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """One completed region: ``path`` is the dot-joined ancestry."""
+
+    name: str
+    path: str
+    start: float  # tracer-clock timestamp (perf_counter origin)
+    duration: float
+    depth: int
+
+
+class Tracer:
+    """Collects completed spans (bounded ring) and optionally mirrors
+    durations into a metrics registry.
+
+    >>> tr = Tracer(registry=reg)
+    >>> with tr.span("step"):
+    ...     with tr.span("prefill"):
+    ...         ...
+    >>> tr.events[-1].path
+    'step'
+    """
+
+    def __init__(
+        self,
+        registry: Registry | None = None,
+        *,
+        max_events: int = 4096,
+        annotate: bool = True,
+        clock=time.perf_counter,
+    ):
+        self.registry = registry
+        self.annotate = annotate
+        self.clock = clock
+        #: completed spans, oldest dropped past ``max_events``
+        self.events: deque[Span] = deque(maxlen=max_events)
+        self.dropped = 0
+        self._tls = threading.local()
+
+    def _stack(self) -> list[str]:
+        if not hasattr(self._tls, "stack"):
+            self._tls.stack = []
+        return self._tls.stack
+
+    @property
+    def current_path(self) -> str:
+        """Dotted path of the innermost open span ('' at top level)."""
+        return ".".join(self._stack())
+
+    @contextmanager
+    def span(self, name: str):
+        """Open a nested span; records on exit (exceptions included —
+        a span that dies still reports its duration)."""
+        stack = self._stack()
+        stack.append(name)
+        path = ".".join(stack)
+        depth = len(stack) - 1
+        annotation = None
+        if self.annotate:
+            try:
+                import jax.profiler
+
+                annotation = jax.profiler.TraceAnnotation(path)
+                annotation.__enter__()
+            except Exception:  # no jax / profiler backend: host-only span
+                annotation = None
+        t0 = self.clock()
+        try:
+            yield self
+        finally:
+            dt = self.clock() - t0
+            if annotation is not None:
+                annotation.__exit__(None, None, None)
+            stack.pop()
+            if len(self.events) == self.events.maxlen:
+                self.dropped += 1
+            self.events.append(Span(name, path, t0, dt, depth))
+            if self.registry is not None:
+                self.registry.histogram(
+                    SPAN_HISTOGRAM,
+                    "wall-clock duration of host trace spans",
+                    span=path,
+                ).observe(dt)
+
+
+_default = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _default
+
+
+def span(name: str):
+    """Module-level convenience: a span on the default tracer."""
+    return _default.span(name)

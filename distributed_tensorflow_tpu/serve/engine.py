@@ -24,17 +24,25 @@ training loop.
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterable, Iterator
+import time
+from typing import Callable, Iterable, Iterator
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import Transformer, TransformerConfig, make_init_fn
+from ..obs.registry import Registry
 from . import decode as decode_lib
 from . import sampling
 from .kv_cache import KVCache, init_cache
-from .scheduler import Request, Scheduler
+from .scheduler import (
+    FINISH_EOS,
+    FINISH_MAX_LEN,
+    FINISH_MAX_NEW,
+    Request,
+    Scheduler,
+)
 
 
 @dataclasses.dataclass
@@ -48,6 +56,13 @@ class StepStats:
     #: one step (its prefill token AND its first decode token)
     tokens: list[tuple[int, int]] = dataclasses.field(default_factory=list)
     finished: list[int] = dataclasses.field(default_factory=list)
+    #: host wall-clock split of this step: prefill phase (all admits,
+    #: compile-warm), decode phase (one fused step), and the whole call.
+    #: Timings block on sampled-token transfer, so they are real compute
+    #: latencies, not dispatch times.
+    wall_s: float = 0.0
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
 
 
 class ServeEngine:
@@ -70,6 +85,8 @@ class ServeEngine:
         temperature: float = 0.0,
         top_k: int = 0,
         seed: int = 0,
+        registry: Registry | None = None,
+        clock: Callable[[], float] = time.perf_counter,
     ):
         if not cfg.causal:
             raise ValueError("ServeEngine requires a causal (decoder) model")
@@ -79,7 +96,8 @@ class ServeEngine:
         self.cache: KVCache = init_cache(
             cfg, num_slots, max_len=max_len, dtype=cache_dtype
         )
-        self.sched = Scheduler(num_slots, self.cache.max_len)
+        self.clock = clock
+        self.sched = Scheduler(num_slots, self.cache.max_len, clock=clock)
         self.temperature = temperature
         self.top_k = top_k
         self._rng = jax.random.PRNGKey(seed)
@@ -88,6 +106,37 @@ class ServeEngine:
         self._last = np.zeros(num_slots, np.int32)
         self._prefill = decode_lib.jit_prefill(self.model)
         self._decode = decode_lib.jit_decode_step(self.model)
+        # telemetry: one registry per engine by default (isolated,
+        # mergeable upstream); pass obs.default_registry() to publish
+        # into the process-wide scrape surface. Handles are resolved
+        # once here — the decode hot loop only does .observe()/.inc().
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        self._m_queue_wait = r.histogram(
+            "serve_queue_wait_seconds", "submit → slot admission")
+        self._m_ttft = r.histogram(
+            "serve_ttft_seconds", "submit → first token delivered")
+        self._m_tpot = r.histogram(
+            "serve_tpot_seconds",
+            "mean per-output-token decode latency of a finished request")
+        self._m_step = r.histogram(
+            "serve_step_seconds", "one engine step (admit+prefill+decode)")
+        self._m_prefill = r.histogram(
+            "serve_prefill_seconds", "prefill phase of an engine step")
+        self._m_decode = r.histogram(
+            "serve_decode_seconds", "fused decode phase of an engine step")
+        self._m_occupancy = r.gauge(
+            "serve_occupancy", "active slots / num_slots at last decode")
+        self._m_admitted = r.counter(
+            "serve_admitted_total", "requests admitted into a slot")
+        self._m_tokens = r.counter(
+            "serve_tokens_total", "tokens delivered (prefill + decode)")
+        self._m_finished = {
+            reason: r.counter(
+                "serve_finished_total", "finished requests by eviction reason",
+                reason=reason)
+            for reason in (FINISH_EOS, FINISH_MAX_NEW, FINISH_MAX_LEN)
+        }
 
     @classmethod
     def with_random_params(
@@ -111,14 +160,29 @@ class ServeEngine:
 
     def step(self) -> StepStats:
         """Admit + prefill newly placed requests, then advance every
-        active slot by one decode token. Returns per-step stats."""
+        active slot by one decode token. Returns per-step stats and
+        records them into ``self.registry``."""
         stats = StepStats()
+        t0 = self.clock()
         for slot, req in self.sched.admit():
             stats.admitted += 1
+            self._m_admitted.inc()
+            self._m_queue_wait.observe(req.t_admit - req.t_submit)
             self._do_prefill(slot, req, stats)
+        t1 = self.clock()
         active = self.sched.active_slots()
         if active:
             self._do_decode(active, stats)
+        t2 = self.clock()
+        stats.prefill_s = t1 - t0
+        stats.decode_s = t2 - t1
+        stats.wall_s = t2 - t0
+        self._m_step.observe(stats.wall_s)
+        if stats.admitted:
+            self._m_prefill.observe(stats.prefill_s)
+        if active:
+            self._m_decode.observe(stats.decode_s)
+            self._m_occupancy.set(stats.occupancy)
         return stats
 
     def stream(
@@ -171,10 +235,21 @@ class ServeEngine:
     def _deliver(self, slot: int, token: int, stats: StepStats) -> None:
         req = self.sched.slots[slot]
         stats.tokens.append((req.uid, token))
+        self._m_tokens.inc()
         finished = self.sched.append_token(slot, token)
+        if len(req.generated) == 1:
+            self._m_ttft.observe(req.t_first_token - req.t_submit)
         if finished is not None:
             stats.finished.append(finished.uid)
             self._written[slot] = 0  # idle slots park their write index at 0
+            self._m_finished[finished.finish_reason].inc()
+            # Mean decode latency per output token, one observation per
+            # finished request (so hist count == finished requests). A
+            # single-token request has no decode interval → observes 0.
+            g = len(finished.generated)
+            self._m_tpot.observe(
+                (finished.t_finish - finished.t_first_token) / max(g - 1, 1)
+            )
 
     def _do_prefill(self, slot: int, req: Request, stats: StepStats) -> None:
         P = len(req.prompt)

@@ -23,8 +23,9 @@ no device (tests/test_serve.py::test_scheduler_invariants).
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
-from typing import Iterable
+from typing import Callable, Iterable
 
 #: why a request finished
 FINISH_EOS = "eos"
@@ -40,6 +41,14 @@ class Request:
     eos_id: int | None = None
     generated: list[int] = dataclasses.field(default_factory=list)
     finish_reason: str | None = None
+    # lifecycle timestamps (scheduler clock), the raw material for the
+    # serve latency metrics (docs/observability.md): queue wait =
+    # t_admit - t_submit, TTFT = t_first_token - t_submit, per-token
+    # decode latency = (t_finish - t_first_token) / (generated - 1).
+    t_submit: float | None = None
+    t_admit: float | None = None
+    t_first_token: float | None = None
+    t_finish: float | None = None
 
     @property
     def done(self) -> bool:
@@ -50,11 +59,13 @@ class Scheduler:
     """FIFO continuous batching over ``num_slots`` decode slots, each
     with a ``max_len``-token KV budget (prompt + generated)."""
 
-    def __init__(self, num_slots: int, max_len: int):
+    def __init__(self, num_slots: int, max_len: int,
+                 clock: Callable[[], float] = time.perf_counter):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         self.num_slots = num_slots
         self.max_len = max_len
+        self.clock = clock  # injectable for deterministic latency tests
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * num_slots
         self._next_uid = 0
@@ -82,7 +93,8 @@ class Scheduler:
             )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        req = Request(self._next_uid, prompt, max_new_tokens, eos_id)
+        req = Request(self._next_uid, prompt, max_new_tokens, eos_id,
+                      t_submit=self.clock())
         self._next_uid += 1
         self.queue.append(req)
         return req.uid
@@ -97,6 +109,7 @@ class Scheduler:
                 break
             if self.slots[slot] is None:
                 req = self.queue.popleft()
+                req.t_admit = self.clock()
                 self.slots[slot] = req
                 placed.append((slot, req))
         return placed
@@ -130,6 +143,8 @@ class Scheduler:
             raise ValueError(f"append_token on empty slot {slot}")
         req.generated.append(int(token))
         g, P = len(req.generated), len(req.prompt)
+        if g == 1:
+            req.t_first_token = self.clock()
         if req.eos_id is not None and int(token) == req.eos_id:
             req.finish_reason = FINISH_EOS
         elif g >= req.max_new_tokens:
@@ -137,6 +152,7 @@ class Scheduler:
         elif P + g > self.max_len:
             req.finish_reason = FINISH_MAX_LEN
         if req.done:
+            req.t_finish = self.clock()
             self.slots[slot] = None
             self.finished[req.uid] = req
             return req

@@ -17,6 +17,7 @@ from typing import Any
 import jax
 import numpy as np
 
+from ..obs.registry import Registry, default_registry
 from ..parallel import cluster
 from ..utils import flops as flops_lib
 
@@ -48,7 +49,7 @@ class MetricsLogger(Callback):
 
     def __init__(self, every_n: int = 100, batch_size: int | None = None,
                  model_flops_per_step: float | None = None,
-                 history: bool = False):
+                 history: bool = False, clock=time.perf_counter):
         """``model_flops_per_step``: FORWARD FLOPs per step (the framework
         contract — every model's flops_per_example is fwd-only). This
         callback is the single place the ×3 training multiplier
@@ -56,19 +57,25 @@ class MetricsLogger(Callback):
         self.every_n = every_n
         self.batch_size = batch_size
         self.model_flops = model_flops_per_step
+        self.clock = clock
         self._t0: float | None = None
         self._step0 = 0
         self.history: list[dict] = [] if history else None
         self.last: dict[str, float] = {}
+        #: step `last` was fetched at — consumers reusing `last` (e.g.
+        #: SummaryWriter) MUST check this, or a cadence mismatch writes
+        #: stale scalars under a newer global_step.
+        self.last_step: int | None = None
 
     def on_train_start(self, trainer):
         self._t0 = None
+        self.last, self.last_step = {}, None
 
     def on_step_end(self, trainer, step, metrics):
         if step % self.every_n != 0:
             return
         fetched = {k: float(np.asarray(v)) for k, v in metrics.items()}
-        now = time.perf_counter()
+        now = self.clock()
         if self._t0 is not None:
             dt = now - self._t0
             steps_per_sec = (step - self._step0) / max(dt, 1e-9)
@@ -81,7 +88,7 @@ class MetricsLogger(Callback):
                     steps_per_sec, jax.device_count()
                 )
         self._t0, self._step0 = now, step
-        self.last = fetched
+        self.last, self.last_step = fetched, step
         if self.history is not None:
             self.history.append({"step": step, **fetched})
         if cluster.is_chief():
@@ -89,6 +96,19 @@ class MetricsLogger(Callback):
                 f"{k}={v:.6g}" for k, v in sorted(fetched.items())
             )
             logger.info("step %d: %s", step, msg)
+
+
+def _fresh_scalars(metrics_logger: "MetricsLogger | None", step: int,
+                   metrics: dict[str, Any]) -> dict[str, float]:
+    """Scalars for ``step``: reuse the paired logger's fetched dict ONLY
+    if its fetch happened at this very step (it ran earlier in the
+    callback list with an aligned cadence) — `last` from an older step
+    consumed under the current step would silently shift every curve
+    (the SummaryWriter stale-scalar bug). Otherwise fetch directly,
+    paying the same cadence'd device sync the logger would."""
+    if metrics_logger is not None and metrics_logger.last_step == step:
+        return dict(metrics_logger.last)
+    return {k: float(np.asarray(v)) for k, v in metrics.items()}
 
 
 class SummaryWriter(Callback):
@@ -115,11 +135,8 @@ class SummaryWriter(Callback):
     def on_step_end(self, trainer, step, metrics):
         if self._writer is None or step % self.every_n != 0:
             return
-        if self.metrics_logger is not None and self.metrics_logger.last:
-            scalars = dict(self.metrics_logger.last)
-        else:
-            scalars = {k: float(np.asarray(v)) for k, v in metrics.items()}
-        for k, v in scalars.items():
+        for k, v in _fresh_scalars(self.metrics_logger, step,
+                                   metrics).items():
             self._writer.add_scalar(f"train/{k}", v, global_step=step)
 
     def on_train_end(self, trainer):
@@ -127,6 +144,68 @@ class SummaryWriter(Callback):
             self._writer.flush()
             self._writer.close()
             self._writer = None
+
+
+class TelemetryCallback(Callback):
+    """Canonical metrics sink: mirrors the train loop into an
+    obs.Registry (scrape-able via obs.export, mergeable across hosts) —
+    the registry-backed replacement for reading ``MetricsLogger.last``/
+    ``history`` out of band.
+
+    Two cadences, preserving the async steady state:
+
+    - EVERY step: a host-clock step-latency observation into the
+      ``train_step_seconds`` histogram plus a ``train_steps_total``
+      tick. Pure host arithmetic — never touches the device metrics, so
+      the loop's dispatch-ahead pipelining is unchanged.
+    - Every ``every_n`` steps: scalar gauges (``train_<name>``). Reuses
+      the paired MetricsLogger's already-fetched dict when its fetch
+      happened at this step (same staleness rule as SummaryWriter);
+      otherwise fetches directly — the same cadence'd device sync every
+      other observer pays.
+    """
+
+    def __init__(self, registry: Registry | None = None, every_n: int = 100,
+                 metrics_logger: "MetricsLogger | None" = None,
+                 clock=time.perf_counter):
+        self.registry = registry if registry is not None else default_registry()
+        self.every_n = every_n
+        self.metrics_logger = metrics_logger
+        self.clock = clock
+        self._t_prev: float | None = None
+        self._step_prev = 0
+        self._m_step = self.registry.histogram(
+            "train_step_seconds", "host wall-clock between step dispatches")
+        self._m_steps = self.registry.counter(
+            "train_steps_total", "train steps completed")
+        self._m_gstep = self.registry.gauge(
+            "train_global_step", "latest completed global step")
+
+    @staticmethod
+    def _gauge_name(key: str) -> str:
+        sane = "".join(c if c.isalnum() or c == "_" else "_" for c in key)
+        return f"train_{sane}"
+
+    def on_train_start(self, trainer):
+        self._t_prev = None
+
+    def on_step_end(self, trainer, step, metrics):
+        now = self.clock()
+        if self._t_prev is not None:
+            # mean host latency per step since the last observation (the
+            # loop calls us every step, so this is one step's wall time)
+            n = max(step - self._step_prev, 1)
+            self._m_step.observe((now - self._t_prev) / n)
+        self._t_prev, self._step_prev = now, step
+        self._m_steps.inc()
+        self._m_gstep.set(step)
+        if step % self.every_n != 0:
+            return
+        for k, v in _fresh_scalars(self.metrics_logger, step,
+                                   metrics).items():
+            self.registry.gauge(
+                self._gauge_name(k), "train metric (cadence-sampled)"
+            ).set(v)
 
 
 class NaNGuard(Callback):

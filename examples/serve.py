@@ -66,6 +66,16 @@ def main(argv=None):
         print(f"req {req.uid}: prompt={list(req.prompt)}")
         print(f"        -> {req.generated}  ({req.finish_reason})")
 
+    # request-level telemetry the engine recorded along the way
+    # (docs/observability.md; scrape-able via obs.serve_http)
+    reg = eng.registry
+    ms = lambda s: f"{s * 1e3:.1f}ms"  # noqa: E731
+    ttft, tpot = reg.get("serve_ttft_seconds"), reg.get("serve_tpot_seconds")
+    print(f"\ntelemetry: ttft p50={ms(ttft.percentile(0.5))} "
+          f"p99={ms(ttft.percentile(0.99))}  "
+          f"tpot p50={ms(tpot.percentile(0.5))}  "
+          f"tokens={int(reg.get('serve_tokens_total').value)}")
+
 
 if __name__ == "__main__":
     main()

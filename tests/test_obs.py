@@ -1,0 +1,393 @@
+"""obs/ subsystem tests: registry merge semantics, histogram percentile
+accuracy against numpy quantiles, Prometheus render invariants, span
+tracing, JSONL export, and the serve-engine telemetry acceptance gate
+(ISSUE 2: sum of latency-histogram counts == finished requests)."""
+
+import json
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu import obs
+from distributed_tensorflow_tpu.obs import registry as reg_lib
+
+
+# ---------------------------------------------------------------------------
+# Registry primitives
+# ---------------------------------------------------------------------------
+
+
+def test_get_or_create_identity_and_type_conflict():
+    r = obs.Registry()
+    c1 = r.counter("requests_total", "help text")
+    c2 = r.counter("requests_total")
+    assert c1 is c2
+    # distinct label sets are distinct children
+    a = r.counter("finished_total", reason="eos")
+    b = r.counter("finished_total", reason="max_len")
+    assert a is not b
+    with pytest.raises(ValueError):
+        r.gauge("requests_total")  # name already a counter
+    with pytest.raises(ValueError):
+        r.counter("0bad name")
+
+
+def test_counter_and_gauge_semantics():
+    r = obs.Registry()
+    c = r.counter("c_total")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("g")
+    g.set(7)
+    g.set(3)
+    assert g.value == 3.0
+
+
+def test_histogram_observe_and_bucket_edges():
+    r = obs.Registry()
+    h = r.histogram("h_seconds", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 1.0, 5.0, 100.0, 1e6):  # 1.0 and 100.0 land ON bounds
+        h.observe(v)
+    assert h.counts.tolist() == [2, 1, 1, 1]  # le semantics + overflow
+    assert h.count == 5
+    assert h.sum == pytest.approx(0.5 + 1 + 5 + 100 + 1e6)
+    with pytest.raises(ValueError):
+        r.histogram("h_seconds", buckets=(1.0, 2.0))  # bucket mismatch
+    with pytest.raises(ValueError):
+        obs.Histogram("bad", buckets=())
+
+
+def test_histogram_percentiles_match_numpy_quantiles():
+    """Log-bucket read-back must sit within one bucket ratio of the true
+    quantile, for distributions spanning several decades."""
+    rng = np.random.RandomState(7)
+    per_decade = 20
+    ratio = 10 ** (1 / per_decade)
+    buckets = obs.log_buckets(1e-5, 10.0, per_decade=per_decade)
+    for vals in (
+        rng.lognormal(-5.0, 1.5, 20_000),
+        rng.exponential(0.01, 20_000),
+        np.abs(rng.normal(0.001, 0.0005, 20_000)) + 1e-5,
+    ):
+        h = obs.Histogram("lat_seconds", buckets=buckets)
+        for v in vals:
+            h.observe(v)
+        for q in (0.1, 0.5, 0.9, 0.99):
+            est = h.percentile(q)
+            true = float(np.quantile(vals, q))
+            assert est == pytest.approx(true, rel=ratio - 1 + 0.01), (
+                f"q={q}: est {est} vs numpy {true}"
+            )
+
+
+def test_histogram_percentile_edges():
+    h = obs.Histogram("h", buckets=(1.0, 2.0))
+    assert np.isnan(h.percentile(0.5))  # empty
+    h.observe(100.0)  # overflow-only
+    assert h.percentile(0.5) == 2.0  # floor: last finite bound
+    with pytest.raises(ValueError):
+        h.percentile(1.5)
+
+
+def test_registry_merge_semantics():
+    """Counters/histograms add; gauges take the freshest write; missing
+    metrics are adopted as independent copies."""
+    a, b = obs.Registry(), obs.Registry()
+    a.counter("c_total").inc(2)
+    b.counter("c_total").inc(5)
+    ha = a.histogram("h", buckets=(1.0, 2.0))
+    hb = b.histogram("h", buckets=(1.0, 2.0))
+    ha.observe(0.5)
+    hb.observe(1.5)
+    hb.observe(10.0)
+    a.gauge("g").set(1.0)
+    gb = b.gauge("g")
+    gb.set(9.0)
+    gb.set(2.0)  # b wrote twice → fresher than a's single write
+    b.counter("only_in_b_total").inc(3)
+
+    a.merge(b)
+    assert a.get("c_total").value == 7
+    assert a.get("h").counts.tolist() == [1, 1, 1]
+    assert a.get("h").sum == pytest.approx(12.0)
+    assert a.get("g").value == 2.0
+    assert a.get("only_in_b_total").value == 3
+    # adoption copies — mutating the source must not alias
+    b.counter("only_in_b_total").inc()
+    assert a.get("only_in_b_total").value == 3
+
+    # merged-in-both == observed-in-one: sufficient-statistic exactness
+    c = obs.Registry()
+    hc = c.histogram("h", buckets=(1.0, 2.0))
+    for v in (0.5, 1.5, 10.0):
+        hc.observe(v)
+    assert hc.counts.tolist() == a.get("h").counts.tolist()
+
+    with pytest.raises(ValueError):
+        ha.merge_from(obs.Histogram("h", buckets=(1.0, 3.0)))
+
+
+def test_gauge_repeated_merge_from_live_source():
+    """Scrape-aggregator pattern: merging the SAME live registry
+    repeatedly must keep tracking fresh gauge writes (seq must not
+    inflate past the source's)."""
+    host, agg = obs.Registry(), obs.Registry()
+    g = host.gauge("occ")
+    for v in (1.0, 2.0, 3.0):
+        g.set(v)
+        g.set(v * 10)  # two writes per cycle: seq grows faster than 1
+        agg.merge(host)
+        assert agg.get("occ").value == v * 10
+
+
+def test_render_survives_non_finite_values():
+    """A diverged-loss gauge must not kill the scrape endpoint."""
+    r = obs.Registry()
+    r.gauge("train_loss").set(float("nan"))
+    r.gauge("g_inf").set(float("inf"))
+    r.gauge("g_ninf").set(float("-inf"))
+    text = obs.render(r)
+    assert "train_loss NaN" in text
+    assert "g_inf +Inf" in text and "g_ninf -Inf" in text
+
+
+def test_registry_reset_keeps_handles():
+    r = obs.Registry()
+    c, h, g = r.counter("c_total"), r.histogram("h"), r.gauge("g")
+    c.inc(5)
+    h.observe(0.1)
+    g.set(4)
+    r.reset()
+    assert c.value == 0 and h.count == 0 and h.sum == 0 and g.value == 0
+    c.inc()  # same handle still registered
+    assert r.get("c_total").value == 1
+
+
+# ---------------------------------------------------------------------------
+# Prometheus render
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [-+0-9.eE]+$|^# (HELP|TYPE) .+$"
+)
+
+
+def test_render_format_and_invariants():
+    r = obs.Registry()
+    r.counter("req_total", "requests").inc(3)
+    r.gauge("occ", "occupancy").set(0.5)
+    h = r.histogram("lat_seconds", "latency", buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.005, 0.05, 0.5):
+        h.observe(v)
+    r.counter("fin_total", reason='we"ird\\label').inc()
+
+    text = obs.render(r)
+    lines = text.splitlines()
+    assert text.endswith("\n")
+    for line in lines:
+        assert _SAMPLE_RE.match(line), f"bad exposition line: {line!r}"
+    assert "# TYPE req_total counter" in lines
+    assert "# TYPE lat_seconds histogram" in lines
+    assert "req_total 3" in lines
+    # buckets are CUMULATIVE and end at +Inf == count
+    cums = [
+        int(m.group(1))
+        for m in re.finditer(r'lat_seconds_bucket\{le="[^"]+"\} (\d+)', text)
+    ]
+    assert cums == sorted(cums) and cums[-1] == 4
+    assert "lat_seconds_count 4" in lines
+    # label escaping survives
+    assert 'reason="we\\"ird\\\\label"' in text
+    # HELP/TYPE emitted once per name even with label children
+    assert text.count("# TYPE fin_total") == 1
+
+
+def test_http_scrape_endpoint():
+    r = obs.Registry()
+    r.counter("hits_total").inc(2)
+    server = obs.serve_http(r, port=0)
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "hits_total 2" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=5
+            )
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_nesting_and_registry_feed():
+    fake_t = [0.0]
+
+    def clock():
+        fake_t[0] += 1.0
+        return fake_t[0]
+
+    r = obs.Registry()
+    tr = obs.Tracer(registry=r, annotate=False, clock=clock)
+    with tr.span("step"):
+        assert tr.current_path == "step"
+        with tr.span("prefill"):
+            assert tr.current_path == "step.prefill"
+    assert tr.current_path == ""
+    # inner closes first; durations from the fake clock are exact
+    assert [(s.path, s.depth, s.duration) for s in tr.events] == [
+        ("step.prefill", 1, 1.0),
+        ("step", 0, 3.0),
+    ]
+    from distributed_tensorflow_tpu.obs.trace import SPAN_HISTOGRAM
+
+    assert r.get(SPAN_HISTOGRAM, span="step.prefill").count == 1
+    assert r.get(SPAN_HISTOGRAM, span="step").count == 1
+
+
+def test_tracer_records_on_exception_and_bounds_events():
+    tr = obs.Tracer(annotate=False, max_events=2)
+    with pytest.raises(RuntimeError):
+        with tr.span("dies"):
+            raise RuntimeError("boom")
+    assert tr.events[-1].name == "dies"
+    assert tr.current_path == ""  # stack unwound
+    for i in range(5):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr.events) == 2 and tr.dropped == 4
+
+
+def test_tracer_annotate_passthrough_smoke():
+    """annotate=True must work whether or not a jax profiler trace is
+    active (TraceAnnotation is a no-op outside an active trace)."""
+    tr = obs.Tracer(annotate=True)
+    with tr.span("annotated"):
+        pass
+    assert tr.events[-1].path == "annotated"
+
+
+# ---------------------------------------------------------------------------
+# JSONL export
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_logger_events_and_snapshot(tmp_path):
+    r = obs.Registry()
+    r.counter("c_total").inc(4)
+    r.histogram("h", buckets=(1.0,)).observe(0.5)
+    path = str(tmp_path / "events.jsonl")
+    # single-process test rig: this process IS the chief, so chief_only
+    # stays enabled — the gating path itself is exercised either way
+    with obs.JsonlLogger(path, r, clock=lambda: 123.0) as jl:
+        assert jl.enabled
+        jl.event("admitted", uid=7)
+        jl.write_snapshot(step=10)
+    recs = [json.loads(line) for line in open(path)]
+    assert [rec["event"] for rec in recs] == ["admitted", "snapshot"]
+    assert recs[0] == {"t": 123.0, "event": "admitted", "uid": 7}
+    snap = recs[1]["metrics"]
+    assert snap["c_total"] == {"kind": "counter", "value": 4.0}
+    assert snap["h"]["counts"] == [1, 0] and recs[1]["step"] == 10
+
+
+def test_jsonl_logger_disabled_noop(tmp_path, monkeypatch):
+    from distributed_tensorflow_tpu.parallel import cluster
+
+    monkeypatch.setattr(cluster, "is_chief", lambda: False)
+    path = str(tmp_path / "nothing.jsonl")
+    with obs.JsonlLogger(path, obs.Registry()) as jl:
+        assert not jl.enabled
+        jl.event("dropped")
+        jl.write_snapshot()
+    import os
+
+    assert not os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# Serve-engine telemetry (the ISSUE 2 acceptance gate)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_engine_telemetry_counts_and_render():
+    """A drained ServeEngine run yields non-empty TTFT / per-token
+    histograms whose counts equal the finished-request count, and the
+    registry renders valid Prometheus exposition."""
+    from distributed_tensorflow_tpu import serve
+    from distributed_tensorflow_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, max_len=48, num_layers=1, d_model=16, num_heads=2,
+        d_ff=32, dropout=0.0, dtype="float32", causal=True, pre_ln=True,
+    )
+    reg = obs.Registry()
+    eng = serve.ServeEngine.with_random_params(cfg, num_slots=2, registry=reg)
+    assert eng.registry is reg
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]]  # forces queueing
+    for p in prompts:
+        eng.submit(p, max_new_tokens=5)
+    done = eng.run()
+    assert len(done) == len(prompts)
+
+    ttft = reg.get("serve_ttft_seconds")
+    tpot = reg.get("serve_tpot_seconds")
+    qwait = reg.get("serve_queue_wait_seconds")
+    finished = sum(
+        m.value for m in reg.collect() if m.name == "serve_finished_total"
+    )
+    assert ttft.count == len(prompts) and ttft.sum > 0
+    assert tpot.count == len(prompts)
+    assert qwait.count == len(prompts)
+    assert int(finished) == len(prompts)
+    assert reg.get("serve_finished_total",
+                   reason="max_new_tokens").value == len(prompts)
+    assert reg.get("serve_admitted_total").value == len(prompts)
+    # every generated token was counted
+    total_toks = sum(len(r.generated) for r in done.values())
+    assert reg.get("serve_tokens_total").value == total_toks
+    assert reg.get("serve_step_seconds").count > 0
+    assert 0 < reg.get("serve_occupancy").value <= 1.0
+    # TTFT >= queue wait for every request → also true of the sums
+    assert ttft.sum >= qwait.sum
+
+    text = obs.render(reg)
+    assert "# TYPE serve_ttft_seconds histogram" in text
+    assert 'serve_finished_total{reason="max_new_tokens"} 4' in text
+    for line in text.splitlines():
+        assert _SAMPLE_RE.match(line), f"bad exposition line: {line!r}"
+
+
+def test_serve_step_stats_timing_split():
+    """StepStats carries the prefill/decode wall split; registry reset
+    drops warmup observations but keeps recording (the bench contract)."""
+    from distributed_tensorflow_tpu import serve
+    from distributed_tensorflow_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, max_len=48, num_layers=1, d_model=16, num_heads=2,
+        d_ff=32, dropout=0.0, dtype="float32", causal=True, pre_ln=True,
+    )
+    eng = serve.ServeEngine.with_random_params(cfg, num_slots=2)
+    eng.submit([1, 2, 3], max_new_tokens=3)
+    first = eng.step()
+    assert first.admitted == 1
+    assert first.wall_s >= first.prefill_s + first.decode_s - 1e-6
+    assert first.prefill_s > 0 and first.decode_s > 0
+
+    eng.run()
+    eng.registry.reset()
+    assert eng.registry.get("serve_ttft_seconds").count == 0
+    eng.submit([4, 5], max_new_tokens=2)
+    eng.run()
+    assert eng.registry.get("serve_ttft_seconds").count == 1

@@ -3,9 +3,13 @@
 
 Drives a ServeEngine over a queued request stream (more requests than
 decode slots, the regime continuous batching exists for) on a tiny
-random-weight decoder and reports:
+random-weight decoder and reports from the engine's obs registry
+(reset after warmup, so compile time never pollutes a percentile):
 
 - ``tokens_per_sec``     — generated tokens / wall time (post-warmup)
+- ``ttft_p50_ms/p99``    — submit → first token percentiles
+- ``tpot_p50_ms/p99``    — mean per-output-token decode latency
+- ``queue_wait_p50_ms``  — submit → slot admission
 - ``mean_occupancy``     — mean active-slots / num_slots over decode steps
 - ``full_batch_steps``   — steps that decoded with every slot live
 - ``full_batch_frac``    — the acceptance gate: with a backlog queued,
@@ -61,6 +65,7 @@ def main(argv=None):
         eng.submit([rng.randrange(cfg.vocab_size) for _ in range(b)],
                    max_new_tokens=2)
     eng.run()
+    eng.registry.reset()  # drop warmup/compile observations
 
     for p in prompts:
         eng.submit(p, max_new_tokens=args.max_new)
@@ -71,9 +76,22 @@ def main(argv=None):
         stats.append(eng.step())
     wall = time.perf_counter() - t0
 
+    reg = eng.registry
+    ttft = reg.get("serve_ttft_seconds")
+    tpot = reg.get("serve_tpot_seconds")
+    qwait = reg.get("serve_queue_wait_seconds")
+    tokens = int(reg.get("serve_tokens_total").value)
+    finished = int(sum(
+        m.value for m in reg.collect() if m.name == "serve_finished_total"
+    ))
+    assert ttft.count == finished == args.requests, (
+        f"telemetry mismatch: ttft={ttft.count} finished={finished} "
+        f"submitted={args.requests}"
+    )
+
     decode_steps = [s for s in stats if s.decoded_slots]
-    tokens = sum(len(s.tokens) for s in stats)
     full = sum(1 for s in decode_steps if s.occupancy == 1.0)
+    ms = lambda s: round(s * 1e3, 3)  # noqa: E731
     result = {
         "requests": args.requests,
         "slots": args.slots,
@@ -81,6 +99,11 @@ def main(argv=None):
         "generated_tokens": tokens,
         "wall_s": round(wall, 3),
         "tokens_per_sec": round(tokens / wall, 1),
+        "ttft_p50_ms": ms(ttft.percentile(0.5)),
+        "ttft_p99_ms": ms(ttft.percentile(0.99)),
+        "tpot_p50_ms": ms(tpot.percentile(0.5)),
+        "tpot_p99_ms": ms(tpot.percentile(0.99)),
+        "queue_wait_p50_ms": ms(qwait.percentile(0.5)),
         "mean_occupancy": round(
             sum(s.occupancy for s in decode_steps) / len(decode_steps), 3
         ),

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Fast CI tier — the gates that run in seconds, before the full suite:
+#
+#   1. tools/smoke_collect.sh  — pytest --collect-only import gate
+#      (catches package-wide import regressions, ISSUE 1)
+#   2. tools/obs_check.py      — telemetry smoke: registry → Prometheus
+#      exposition render → format lint → JSONL round-trip (ISSUE 2)
+#
+# Usage: tools/ci_fast.sh   (extra args are passed to smoke_collect)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+bash tools/smoke_collect.sh "$@"
+env JAX_PLATFORMS=cpu python tools/obs_check.py >/dev/null
+echo "ci_fast: all gates passed"

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Telemetry smoke gate — seconds, not minutes (tools/ci_fast.sh tier).
+
+Registers one metric of every kind, exercises span tracing and the
+JSONL logger, renders Prometheus text exposition, and lints the output
+against the exposition-format grammar with a regex — so a formatting
+regression (bad label escaping, non-cumulative buckets, missing
+``_sum``/``_count``) fails loudly before anything tries to scrape a
+real run. No device, no model: the obs layer is plain host code.
+
+Usage:
+    python tools/obs_check.py
+"""
+
+import json
+import re
+import sys
+import tempfile
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+# Prometheus text-exposition grammar (version 0.0.4), line-by-line.
+_METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABELS = r"\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\}"
+_VALUE = r"(?:[-+]?(?:[0-9]*\.)?[0-9]+(?:[eE][-+]?[0-9]+)?|[-+]?Inf|NaN)"
+LINE_RE = re.compile(
+    r"^(?:"
+    r"# HELP " + _METRIC_NAME + r" .*"
+    r"|# TYPE " + _METRIC_NAME + r" (?:counter|gauge|histogram|summary|untyped)"
+    r"|" + _METRIC_NAME + r"(?:" + _LABELS + r")? " + _VALUE + r"(?: [0-9]+)?"
+    r")$"
+)
+
+
+def check(verbose: bool = True) -> list[str]:
+    """Returns a list of failures (empty == pass)."""
+    from distributed_tensorflow_tpu import obs
+
+    failures: list[str] = []
+    reg = obs.Registry()
+
+    # one of each kind, with and without labels
+    reg.counter("obs_check_events_total", "smoke events").inc(3)
+    reg.gauge("obs_check_occupancy", "smoke gauge").set(0.75)
+    h = reg.histogram("obs_check_latency_seconds", "smoke latency")
+    for v in (1e-4, 3e-3, 3e-3, 0.2, 5.0, 1e4):  # incl. overflow bucket
+        h.observe(v)
+    reg.counter("obs_check_finished_total", "by reason", reason="eos").inc()
+    reg.counter("obs_check_finished_total", "by reason",
+                reason='max"len\\path').inc()  # escaping torture
+
+    tracer = obs.Tracer(registry=reg, annotate=False)
+    with tracer.span("check"):
+        with tracer.span("inner"):
+            pass
+    if [s.path for s in tracer.events] != ["check.inner", "check"]:
+        failures.append(f"tracer span paths wrong: {list(tracer.events)}")
+
+    text = obs.render(reg)
+    for i, line in enumerate(text.splitlines(), 1):
+        if not LINE_RE.match(line):
+            failures.append(f"line {i} fails exposition lint: {line!r}")
+
+    # cumulative-bucket + count/sum invariants
+    hist_count = h.count
+    last_bucket = max(
+        int(m.group(1))
+        for m in re.finditer(
+            r'obs_check_latency_seconds_bucket\{le="\+Inf"\} (\d+)', text
+        )
+    )
+    if last_bucket != hist_count:
+        failures.append(
+            f"+Inf bucket {last_bucket} != histogram count {hist_count}"
+        )
+
+    # JSONL round-trip
+    with tempfile.NamedTemporaryFile("r", suffix=".jsonl") as tmp:
+        with obs.JsonlLogger(tmp.name, reg, chief_only=False) as jl:
+            jl.event("smoke", answer=42)
+            jl.write_snapshot(tag="check")
+        recs = [json.loads(line) for line in open(tmp.name)]
+        if len(recs) != 2 or recs[0]["answer"] != 42:
+            failures.append(f"jsonl round-trip wrong: {recs}")
+        snap = recs[1]["metrics"]
+        if snap["obs_check_events_total"]["value"] != 3:
+            failures.append(f"snapshot counter wrong: {snap}")
+
+    if verbose:
+        print(text, end="")
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        if not failures:
+            print(f"OK: {len(text.splitlines())} exposition lines, "
+                  f"{len(reg.collect())} metrics, jsonl round-trip clean",
+                  file=sys.stderr)
+    return failures
+
+
+def main() -> int:
+    return 1 if check() else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
