@@ -22,6 +22,7 @@ from . import data  # noqa: F401
 from . import models  # noqa: F401
 from . import obs  # noqa: F401
 from . import parallel  # noqa: F401
+from . import resilience  # noqa: F401
 from . import serve  # noqa: F401
 from . import train  # noqa: F401
 from . import utils  # noqa: F401

@@ -73,13 +73,14 @@ def initialize(config: ClusterConfig | None = None) -> None:
         "JAX_COMPILATION_CACHE_DIR", ""
     )
     if cache_dir:
-        if jax.config.jax_compilation_cache_dir not in ("", None, cache_dir):
-            # the persistent-cache backend binds lazily to the FIRST dir
-            # it serves; if some earlier code (a test rig, a notebook)
-            # already warmed a cache elsewhere, reset so the configured
-            # dir actually takes effect for this process. Private API —
-            # best-effort only: if a jax upgrade moves it, the stale
-            # binding costs cache hits, never correctness.
+        if jax.config.jax_compilation_cache_dir != cache_dir:
+            # the persistent-cache backend binds lazily on FIRST use —
+            # to the dir configured then, or to "disabled" if none was.
+            # If some earlier code (a test rig, a notebook, any jit
+            # before initialize()) already bound it, reset so the
+            # configured dir actually takes effect for this process.
+            # Private API — best-effort only: if a jax upgrade moves
+            # it, the stale binding costs cache hits, never correctness.
             try:
                 from jax._src import compilation_cache as _cc
 

@@ -1,0 +1,22 @@
+"""Fault injection + recovery validation (docs/resilience.md).
+
+The durability layers (train/checkpoint.py preemption saves + manifests,
+serve admission control, crash-safe Trainer exits) are only as good as
+the faults that have actually been thrown at them. This package holds
+the deterministic fault harness that drives every recovery path
+end-to-end — in tests (tests/test_resilience.py, tests/chaos_worker.py)
+and in the CI chaos smoke (tools/chaos_smoke.py).
+"""
+
+from .faults import (  # noqa: F401
+    ClockStall,
+    DataError,
+    FaultCallback,
+    FaultClock,
+    FaultPlan,
+    FaultyIterator,
+    NaNBatch,
+    Sigterm,
+    corrupt_shard,
+    truncate_shard,
+)

@@ -1,0 +1,311 @@
+"""Deterministic fault injection — the chaos half of the durability story.
+
+PR 0-2 rebuilt the reference's save/restore machinery ($TF
+failure_handling.py:337 PreemptionCheckpointHandler → train/checkpoint.py:
+coordinated preemption saves, CRC manifests, validate-before-save); this
+module *exercises* it. Every fault is injected through a seam the
+production code already has — the callback list, the wrapping data
+iterator, the injectable clock, the checkpoint directory on disk — so a
+chaos run executes the exact code paths a real failure would, with no
+test-only hooks inside the train or serve loops.
+
+The fault vocabulary (docs/resilience.md maps each to the recovery path
+it drives):
+
+- ``Sigterm(step)``       — the process SIGTERMs itself after step N:
+  PreemptionWatcher → coordinated save → ``PreemptionSaved`` clean exit.
+- ``DataError(batch)``    — the data iterator raises ``IOError`` fetching
+  batch M: unhandled step exception → Trainer emergency checkpoint →
+  re-raise (restart-and-resume covers the gap).
+- ``NaNBatch(batch)``     — one batch is poisoned with NaN, so that
+  step's loss/grads go non-finite: NaNGuard aborts and
+  ``validate_before_save`` refuses to checkpoint the poisoned params.
+- ``ClockStall(step, dt)``— the injectable ``FaultClock`` jumps forward
+  after step N: drives the Watchdog budget and serve deadlines without
+  real waiting.
+
+Checkpoint corruption is a disk-level fault, not a run-level one, so it
+is a pair of standalone helpers (``truncate_shard`` / ``corrupt_shard``)
+aimed at a saved step dir; ``verify_manifest`` must reject the result at
+restore time.
+
+Everything is deterministic: faults fire at exact step/batch indices,
+and ``FaultPlan.seeded`` derives those indices from a seed so a chaos
+sweep is reproducible run-to-run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import signal as signal_lib
+
+import numpy as np
+
+from ..train.callbacks import Callback
+
+
+# ---------------------------------------------------------------------------
+# Clock
+# ---------------------------------------------------------------------------
+
+
+class FaultClock:
+    """Manually-advanced clock, drop-in for the ``clock=`` seams
+    (Scheduler/ServeEngine/Watchdog/MetricsLogger). Starts at ``start``
+    and only moves when told to — latency and deadline logic becomes
+    exactly reproducible."""
+
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError("clocks only go forward")
+        self.t += float(dt)
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Fault vocabulary
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Sigterm:
+    """Send SIGTERM to our own process after train step ``step``
+    completes (FaultCallback seam)."""
+
+    step: int
+
+
+@dataclasses.dataclass(frozen=True)
+class DataError:
+    """Raise ``IOError`` from the data iterator on its ``batch``-th
+    ``next()`` call, 1-based — batch i feeds train step i
+    (FaultyIterator seam)."""
+
+    batch: int
+    message: str = "injected data fault"
+
+
+@dataclasses.dataclass(frozen=True)
+class NaNBatch:
+    """Poison the ``batch``-th batch (1-based): the first element of
+    ``key``'s array (or of the first float array found) becomes NaN, so
+    the step computes non-finite loss/grads — the seam for driving
+    NaNGuard and validate_before_save (FaultyIterator seam)."""
+
+    batch: int
+    key: str | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ClockStall:
+    """Advance the plan's FaultClock by ``dt`` seconds after step
+    ``step`` — a frozen host / stuck collective as seen by everything
+    reading that clock (FaultCallback seam; pass the clock to
+    ``FaultPlan.callback``)."""
+
+    step: int
+    dt: float
+
+
+Fault = Sigterm | DataError | NaNBatch | ClockStall
+
+
+# ---------------------------------------------------------------------------
+# Plan + injection seams
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """An immutable schedule of faults. One plan drives both seams:
+    ``plan.callback()`` goes into the Trainer's callback list (step
+    faults), ``plan.wrap(iterator)`` wraps the batch source (data
+    faults). Each fault fires at most once."""
+
+    faults: tuple[Fault, ...] = ()
+
+    @classmethod
+    def seeded(cls, seed: int, num_steps: int,
+               kinds: tuple[str, ...] = ("sigterm",)) -> "FaultPlan":
+        """Deterministic random plan: each requested kind fires once at
+        a seed-derived step in [2, num_steps-1] — never step 1 (nothing
+        saved yet) and never the final step (nothing left to recover).
+        Same (seed, num_steps, kinds) → identical plan."""
+        if num_steps < 3:
+            raise ValueError("need num_steps >= 3 to place a mid-run fault")
+        rng = random.Random(seed)
+        faults: list[Fault] = []
+        for kind in kinds:
+            at = rng.randint(2, num_steps - 1)
+            if kind == "sigterm":
+                faults.append(Sigterm(at))
+            elif kind == "data_error":
+                faults.append(DataError(at))
+            elif kind == "nan_batch":
+                faults.append(NaNBatch(at))
+            elif kind == "clock_stall":
+                faults.append(ClockStall(at, dt=rng.uniform(1.0, 600.0)))
+            else:
+                raise ValueError(f"unknown fault kind {kind!r}")
+        return cls(tuple(faults))
+
+    def callback(self, clock: FaultClock | None = None) -> "FaultCallback":
+        return FaultCallback(self, clock=clock)
+
+    def wrap(self, iterator) -> "FaultyIterator":
+        return FaultyIterator(iterator, self)
+
+
+class FaultCallback(Callback):
+    """Fires the plan's step-indexed faults from ``on_step_end`` — the
+    same seam every production hook uses, so a SIGTERM lands exactly
+    where a GCE maintenance event would: between steps, with the
+    PreemptionWatcher already installed."""
+
+    def __init__(self, plan: FaultPlan, clock: FaultClock | None = None):
+        self.plan = plan
+        self.clock = clock
+        self._fired: set[int] = set()
+
+    def on_step_end(self, trainer, step, metrics):
+        for i, fault in enumerate(self.plan.faults):
+            if i in self._fired:
+                continue
+            if isinstance(fault, Sigterm) and step >= fault.step:
+                self._fired.add(i)
+                os.kill(os.getpid(), signal_lib.SIGTERM)
+            elif isinstance(fault, ClockStall) and step >= fault.step:
+                self._fired.add(i)
+                if self.clock is None:
+                    raise ValueError(
+                        "ClockStall fault needs FaultPlan.callback(clock=...)"
+                    )
+                self.clock.advance(fault.dt)
+
+
+class FaultyIterator:
+    """Wraps a batch iterator and injects the plan's batch-indexed
+    faults. Batch numbering is 1-based and counts ``next()`` calls, so
+    with the standard loop batch i feeds train step i."""
+
+    def __init__(self, iterator, plan: FaultPlan):
+        self._it = iter(iterator)
+        self.plan = plan
+        self.count = 0
+        self._fired: set[int] = set()
+
+    def __iter__(self) -> "FaultyIterator":
+        return self
+
+    def __next__(self):
+        self.count += 1
+        for i, fault in enumerate(self.plan.faults):
+            if i in self._fired or not isinstance(fault, DataError):
+                continue
+            if self.count >= fault.batch:
+                self._fired.add(i)
+                raise IOError(f"{fault.message} (batch {self.count})")
+        batch = next(self._it)
+        for i, fault in enumerate(self.plan.faults):
+            if i in self._fired or not isinstance(fault, NaNBatch):
+                continue
+            if self.count >= fault.batch:
+                self._fired.add(i)
+                batch = _poison_batch(batch, fault.key)
+        return batch
+
+
+def _poison_batch(batch, key: str | None):
+    """Copy ``batch`` with one NaN planted in the chosen (or first)
+    float array — enough to make the whole step's grads non-finite
+    through the loss reduction."""
+    if not isinstance(batch, dict):
+        raise TypeError(f"NaNBatch expects a dict batch, got {type(batch)}")
+    out = dict(batch)
+    keys = [key] if key is not None else [
+        k for k, v in batch.items()
+        if np.issubdtype(np.asarray(v).dtype, np.floating)
+    ]
+    if not keys:
+        raise ValueError("NaNBatch: no float array in batch to poison")
+    k = keys[0]
+    arr = np.array(batch[k], dtype=np.asarray(batch[k]).dtype, copy=True)
+    arr.reshape(-1)[0] = np.nan
+    out[k] = arr
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Disk faults: checkpoint shard corruption
+# ---------------------------------------------------------------------------
+
+
+def _manifest_files(d: str) -> list[dict]:
+    """Files listed in the step dir's MANIFEST.dtf (largest first), or a
+    raw directory walk when no manifest exists."""
+    path = os.path.join(d, "MANIFEST.dtf")
+    if os.path.exists(path):
+        from ..runtime import io as io_lib
+
+        files = json.loads(io_lib.read_payload(path))["files"]
+    else:
+        files = []
+        for root, _, names in os.walk(d):
+            for n in sorted(names):
+                if n == "MANIFEST.dtf":
+                    continue
+                p = os.path.join(root, n)
+                files.append({
+                    "path": os.path.relpath(p, d),
+                    "bytes": os.path.getsize(p),
+                })
+    files = [f for f in files if f["bytes"] > 0]
+    if not files:
+        raise FileNotFoundError(f"no corruptible files under {d}")
+    return sorted(files, key=lambda f: -f["bytes"])
+
+
+def truncate_shard(directory: str, step: int, nbytes: int = 1,
+                   index: int = 0) -> str:
+    """Truncate ``nbytes`` from the ``index``-th largest file of the
+    step's checkpoint dir (the partial-write / torn-copy fault).
+    Returns the mutilated path; ``verify_manifest`` must now raise."""
+    from ..train.checkpoint import step_dir
+
+    d = step_dir(directory, step)
+    entry = _manifest_files(d)[index]
+    path = os.path.join(d, entry["path"])
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(size - nbytes, 0))
+    return path
+
+
+def corrupt_shard(directory: str, step: int, offset: int = 0,
+                  index: int = 0) -> str:
+    """Flip one byte of the ``index``-th largest file at ``offset`` (the
+    bit-rot fault — size-preserving, so only content checks like the
+    manifest CRC on MANIFEST.dtf itself, or orbax's own digests, can
+    catch it). Returns the mutilated path."""
+    from ..train.checkpoint import step_dir
+
+    d = step_dir(directory, step)
+    entry = _manifest_files(d)[index]
+    path = os.path.join(d, entry["path"])
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        if not b:
+            raise ValueError(f"offset {offset} past end of {path}")
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return path

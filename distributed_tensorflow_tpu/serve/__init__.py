@@ -27,9 +27,14 @@ from .kv_cache import (  # noqa: F401
 )
 from .sampling import sample  # noqa: F401
 from .scheduler import (  # noqa: F401
+    FINISH_CANCELLED,
     FINISH_EOS,
     FINISH_MAX_LEN,
     FINISH_MAX_NEW,
+    FINISH_REASONS,
+    FINISH_TIMEOUT,
+    QueueFull,
     Request,
     Scheduler,
+    SchedulerClosed,
 )

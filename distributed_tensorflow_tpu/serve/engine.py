@@ -37,9 +37,7 @@ from . import decode as decode_lib
 from . import sampling
 from .kv_cache import KVCache, init_cache
 from .scheduler import (
-    FINISH_EOS,
-    FINISH_MAX_LEN,
-    FINISH_MAX_NEW,
+    FINISH_REASONS,
     Request,
     Scheduler,
 )
@@ -81,6 +79,7 @@ class ServeEngine:
         *,
         num_slots: int = 4,
         max_len: int | None = None,
+        max_queue: int | None = None,
         cache_dtype=None,
         temperature: float = 0.0,
         top_k: int = 0,
@@ -97,7 +96,8 @@ class ServeEngine:
             cfg, num_slots, max_len=max_len, dtype=cache_dtype
         )
         self.clock = clock
-        self.sched = Scheduler(num_slots, self.cache.max_len, clock=clock)
+        self.sched = Scheduler(num_slots, self.cache.max_len, clock=clock,
+                               max_queue=max_queue)
         self.temperature = temperature
         self.top_k = top_k
         self._rng = jax.random.PRNGKey(seed)
@@ -135,7 +135,7 @@ class ServeEngine:
             reason: r.counter(
                 "serve_finished_total", "finished requests by eviction reason",
                 reason=reason)
-            for reason in (FINISH_EOS, FINISH_MAX_NEW, FINISH_MAX_LEN)
+            for reason in FINISH_REASONS
         }
 
     @classmethod
@@ -155,15 +155,34 @@ class ServeEngine:
         prompt: Iterable[int],
         max_new_tokens: int = 32,
         eos_id: int | None = None,
+        deadline_s: float | None = None,
     ) -> int:
-        return self.sched.submit(prompt, max_new_tokens, eos_id)
+        """Enqueue a request (raises ``scheduler.QueueFull`` under
+        backpressure, ``scheduler.SchedulerClosed`` after drain)."""
+        return self.sched.submit(prompt, max_new_tokens, eos_id,
+                                 deadline_s=deadline_s)
+
+    def cancel(self, uid: int) -> bool:
+        """Cancel a queued or in-flight request (``FINISH_CANCELLED``);
+        returns False if the uid is unknown or already finished."""
+        req = self.sched.cancel(uid)
+        if req is None:
+            return False
+        self._observe_finish(req, None)
+        self._park_idle_written()
+        return True
 
     def step(self) -> StepStats:
-        """Admit + prefill newly placed requests, then advance every
-        active slot by one decode token. Returns per-step stats and
-        records them into ``self.registry``."""
+        """Enforce deadlines, admit + prefill newly placed requests,
+        then advance every active slot by one decode token. Returns
+        per-step stats and records them into ``self.registry``."""
         stats = StepStats()
         t0 = self.clock()
+        expired = self.sched.expire()
+        for req in expired:
+            self._observe_finish(req, stats)
+        if expired:
+            self._park_idle_written()
         for slot, req in self.sched.admit():
             stats.admitted += 1
             self._m_admitted.inc()
@@ -190,14 +209,22 @@ class ServeEngine:
         prompt: Iterable[int],
         max_new_tokens: int = 32,
         eos_id: int | None = None,
+        deadline_s: float | None = None,
     ) -> Iterator[int]:
         """Submit one request and yield its tokens as they are decoded
-        (other queued requests keep making progress in the same steps)."""
-        uid = self.submit(prompt, max_new_tokens, eos_id)
+        (other queued requests keep making progress in the same steps).
+        A ``deadline_s`` expiry simply ends the stream after whatever
+        tokens made it out (``finish_reason`` on the Request says why)."""
+        uid = self.submit(prompt, max_new_tokens, eos_id,
+                          deadline_s=deadline_s)
+        # hold the Request object itself: its identity is stable across
+        # queue → slot → finished, and stays valid even if a concurrent
+        # drain() hands the finished map to its caller — the stream can
+        # still deliver the tokens drain() decoded, instead of KeyError
+        req = self._find(uid)
         delivered = 0
         while True:
             self.step()
-            req = self._find(uid)
             while delivered < len(req.generated):
                 yield req.generated[delivered]
                 delivered += 1
@@ -212,7 +239,53 @@ class ServeEngine:
             self.step()
         return self.sched.drain_finished()
 
+    def drain(self) -> dict[int, Request]:
+        """Graceful shutdown: stop admission (further ``submit`` raises
+        ``SchedulerClosed``), cancel everything still queued, decode the
+        resident requests to completion, and leave telemetry flushed
+        (final occupancy 0, every request's terminal counter bumped).
+        Returns (and forgets) uid → Request for everything finished."""
+        for req in self.sched.close():
+            self._observe_finish(req, None)
+        while any(r is not None for r in self.sched.slots):
+            self.step()
+        self._park_idle_written()
+        self._m_occupancy.set(0.0)
+        return self.sched.drain_finished()
+
     # -- internals ---------------------------------------------------------
+
+    def _park_idle_written(self) -> None:
+        """Idle slots park their write index at 0 (the convention
+        ``_deliver`` keeps for token-driven evictions); timeout/cancel
+        evictions free slots outside ``append_token``, so re-park here."""
+        for i, req in enumerate(self.sched.slots):
+            if req is None:
+                self._written[i] = 0
+
+    def _observe_finish(self, req: Request, stats: StepStats | None) -> None:
+        """The ONE terminal observation per finished request, whatever
+        ended it (token-driven eviction, timeout, cancel) — the PR-2
+        invariant lives here and only here: every finished request
+        contributes exactly one TTFT and one TPOT observation, so their
+        counts equal Σ serve_finished_total. TPOT is the mean decode
+        latency per output token (a single-token request has no decode
+        interval → observes 0). A request aborted before its first token
+        observes time-to-abort as TTFT — the latency the client actually
+        experienced — and 0 TPOT; one aborted mid-decode already
+        observed TTFT at first token and records its realized decode
+        latency here."""
+        if stats is not None:
+            stats.finished.append(req.uid)
+        self._m_finished[req.finish_reason].inc()
+        if req.t_first_token is None:
+            self._m_ttft.observe(req.t_finish - req.t_submit)
+            self._m_tpot.observe(0.0)
+        else:
+            g = len(req.generated)
+            self._m_tpot.observe(
+                (req.t_finish - req.t_first_token) / max(g - 1, 1)
+            )
 
     def _find(self, uid: int) -> Request:
         req = self.sched.finished.get(uid)
@@ -240,16 +313,8 @@ class ServeEngine:
         if len(req.generated) == 1:
             self._m_ttft.observe(req.t_first_token - req.t_submit)
         if finished is not None:
-            stats.finished.append(finished.uid)
             self._written[slot] = 0  # idle slots park their write index at 0
-            self._m_finished[finished.finish_reason].inc()
-            # Mean decode latency per output token, one observation per
-            # finished request (so hist count == finished requests). A
-            # single-token request has no decode interval → observes 0.
-            g = len(finished.generated)
-            self._m_tpot.observe(
-                (finished.t_finish - finished.t_first_token) / max(g - 1, 1)
-            )
+            self._observe_finish(finished, stats)
 
     def _do_prefill(self, slot: int, req: Request, stats: StepStats) -> None:
         P = len(req.prompt)

@@ -15,6 +15,24 @@ FLOPs, decides utilization — PAPERS.md):
   freed slot is re-admissible in the SAME engine step — prefill/decode
   interleaving with no idle step.
 
+Admission control (docs/resilience.md — the failure modes an unbounded
+FIFO hides until overload):
+
+- **Bounded queue.** ``max_queue`` caps waiting requests; ``submit``
+  raises ``QueueFull`` instead of growing without bound. Rejection is
+  explicit backpressure the client can act on (retry, shed, reroute);
+  silent queue growth just converts overload into timeout for everyone.
+- **Deadlines.** A request may carry ``deadline_s``; once its absolute
+  deadline passes it is evicted with ``FINISH_TIMEOUT`` — from the
+  queue (never admitted, no wasted prefill) or from its slot (checked
+  every engine step via ``expire()``).
+- **Cancellation.** ``cancel(uid)`` evicts a queued or resident request
+  with ``FINISH_CANCELLED``; idempotent, no-op on finished/unknown uids.
+- **Drain.** ``close()`` stops admission (submit raises
+  ``SchedulerClosed``) and cancels everything still queued; resident
+  requests keep decoding until done — the graceful-shutdown half the
+  engine exposes as ``ServeEngine.drain()``.
+
 All state is plain Python (deque + list), so every invariant — no slot
 leaks, FIFO order, eviction conditions — is testable with no model and
 no device (tests/test_serve.py::test_scheduler_invariants).
@@ -31,6 +49,24 @@ from typing import Callable, Iterable
 FINISH_EOS = "eos"
 FINISH_MAX_NEW = "max_new_tokens"
 FINISH_MAX_LEN = "max_len"
+FINISH_TIMEOUT = "timeout"
+FINISH_CANCELLED = "cancelled"
+
+#: every reason a Request.finish_reason can hold — the serve_finished
+#: counter label set (obs wiring in engine.py keys off this tuple)
+FINISH_REASONS = (
+    FINISH_EOS, FINISH_MAX_NEW, FINISH_MAX_LEN,
+    FINISH_TIMEOUT, FINISH_CANCELLED,
+)
+
+
+class QueueFull(RuntimeError):
+    """Bounded-queue backpressure: the waiting line is at ``max_queue``.
+    The client should retry later or shed the request."""
+
+
+class SchedulerClosed(RuntimeError):
+    """submit() after close()/drain(): the scheduler no longer admits."""
 
 
 @dataclasses.dataclass
@@ -39,6 +75,10 @@ class Request:
     prompt: tuple[int, ...]
     max_new_tokens: int
     eos_id: int | None = None
+    #: relative latency budget; ``t_deadline`` (absolute, scheduler
+    #: clock) is stamped at submit and enforced by ``expire()``
+    deadline_s: float | None = None
+    t_deadline: float | None = None
     generated: list[int] = dataclasses.field(default_factory=list)
     finish_reason: str | None = None
     # lifecycle timestamps (scheduler clock), the raw material for the
@@ -60,15 +100,20 @@ class Scheduler:
     with a ``max_len``-token KV budget (prompt + generated)."""
 
     def __init__(self, num_slots: int, max_len: int,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 max_queue: int | None = None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None for unbounded)")
         self.num_slots = num_slots
         self.max_len = max_len
+        self.max_queue = max_queue
         self.clock = clock  # injectable for deterministic latency tests
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * num_slots
         self._next_uid = 0
+        self._closed = False
         #: uid → Request, completion order. Retained until the caller
         #: collects results (ServeEngine.run / stream); long-lived
         #: servers must drain_finished() or history accumulates forever.
@@ -76,13 +121,22 @@ class Scheduler:
 
     # -- admission ---------------------------------------------------------
 
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
     def submit(
         self,
         prompt: Iterable[int],
         max_new_tokens: int = 32,
         eos_id: int | None = None,
+        deadline_s: float | None = None,
     ) -> int:
-        """Enqueue a request; returns its uid."""
+        """Enqueue a request; returns its uid. Raises ``QueueFull`` when
+        ``max_queue`` requests are already waiting (backpressure) and
+        ``SchedulerClosed`` after ``close()``."""
+        if self._closed:
+            raise SchedulerClosed("scheduler is draining; admission stopped")
         prompt = tuple(int(t) for t in prompt)
         if not prompt:
             raise ValueError("empty prompt")
@@ -93,8 +147,20 @@ class Scheduler:
             )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be positive (or None)")
+        # capacity LAST: a malformed request must get its permanent
+        # ValueError, not a retryable QueueFull the client would loop on
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            raise QueueFull(
+                f"{len(self.queue)} requests waiting (max_queue="
+                f"{self.max_queue}); retry later"
+            )
+        now = self.clock()
         req = Request(self._next_uid, prompt, max_new_tokens, eos_id,
-                      t_submit=self.clock())
+                      deadline_s=deadline_s, t_submit=now)
+        if deadline_s is not None:
+            req.t_deadline = now + deadline_s
         self._next_uid += 1
         self.queue.append(req)
         return req.uid
@@ -113,6 +179,69 @@ class Scheduler:
                 self.slots[slot] = req
                 placed.append((slot, req))
         return placed
+
+    # -- eviction beyond token-driven finish -------------------------------
+
+    def _finish(self, req: Request, reason: str, now: float | None = None) -> None:
+        req.finish_reason = reason
+        req.t_finish = self.clock() if now is None else now
+        self.finished[req.uid] = req
+
+    def cancel(self, uid: int) -> Request | None:
+        """Evict ``uid`` with ``FINISH_CANCELLED`` wherever it lives —
+        still queued (removed without ever taking a slot) or resident
+        (slot freed immediately; its next decode token is never
+        delivered). Returns the evicted Request, or None if the uid is
+        unknown or already finished (idempotent)."""
+        for i, req in enumerate(self.queue):
+            if req.uid == uid:
+                del self.queue[i]
+                self._finish(req, FINISH_CANCELLED)
+                return req
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.uid == uid:
+                self.slots[slot] = None
+                self._finish(req, FINISH_CANCELLED)
+                return req
+        return None
+
+    def expire(self) -> list[Request]:
+        """Evict every request whose absolute deadline has passed, with
+        ``FINISH_TIMEOUT``: queued requests are never admitted (no
+        wasted prefill), resident requests free their slot. The engine
+        calls this once per step, so a resident deadline is enforced to
+        one decode-step granularity."""
+        now = self.clock()
+        evicted: list[Request] = []
+        if any(r.t_deadline is not None and now >= r.t_deadline
+               for r in self.queue):
+            kept: deque[Request] = deque()
+            for req in self.queue:  # one partition pass, not O(n) removes
+                if req.t_deadline is not None and now >= req.t_deadline:
+                    self._finish(req, FINISH_TIMEOUT, now)
+                    evicted.append(req)
+                else:
+                    kept.append(req)
+            self.queue = kept
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.t_deadline is not None \
+                    and now >= req.t_deadline:
+                self.slots[slot] = None
+                self._finish(req, FINISH_TIMEOUT, now)
+                evicted.append(req)
+        return evicted
+
+    def close(self) -> list[Request]:
+        """Stop admission and cancel everything still queued (they would
+        never run); resident requests are left to finish decoding.
+        Returns the cancelled requests; idempotent."""
+        self._closed = True
+        evicted: list[Request] = []
+        while self.queue:
+            req = self.queue.popleft()
+            self._finish(req, FINISH_CANCELLED)
+            evicted.append(req)
+        return evicted
 
     # -- decode-loop bookkeeping -------------------------------------------
 

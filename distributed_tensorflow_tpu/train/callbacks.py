@@ -11,6 +11,7 @@ async (host dispatches step N+1 while N executes).
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Any
 
@@ -230,6 +231,87 @@ class NaNGuard(Callback):
             if self.fail_fast:
                 raise FloatingPointError(msg)
             trainer.request_stop(msg)
+
+
+class Watchdog(Callback):
+    """Host-side hung-step detector (docs/resilience.md): if no
+    ``on_step_end`` arrives within ``budget_s`` wall seconds, flag the
+    stall to the obs registry — ``train_watchdog_stalled`` gauge goes to
+    1 and ``train_watchdog_stalls_total`` counts the event — and log an
+    error. The next completed step clears the gauge (recovery), so a
+    scrape sees `stalled==1` exactly while a step is overdue.
+
+    Detection only: a stuck collective (one host dead in a psum) cannot
+    be un-stuck host-side — the signal exists so the scrape surface /
+    job scheduler can decide to kill-and-restart, which the checkpoint
+    layer turns into resume-from-last-save. The monitor runs on a
+    daemon poll thread; ``clock`` is injectable so tests (and the fault
+    harness's ClockStall) can drive time deterministically.
+    """
+
+    def __init__(self, budget_s: float = 300.0, registry: Registry | None = None,
+                 poll_s: float | None = None, clock=time.monotonic):
+        if budget_s <= 0:
+            raise ValueError("budget_s must be positive")
+        self.budget_s = budget_s
+        self.registry = registry if registry is not None else default_registry()
+        self.poll_s = poll_s if poll_s is not None else max(
+            min(budget_s / 4, 1.0), 0.005)
+        self.clock = clock
+        self._beat: float | None = None
+        self._lock = threading.Lock()  # orders beat writes vs stall flags
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._m_stalled = self.registry.gauge(
+            "train_watchdog_stalled",
+            "1 while no train step has completed within the watchdog budget")
+        self._m_stalls = self.registry.counter(
+            "train_watchdog_stalls_total",
+            "times a train step exceeded the watchdog wall budget")
+
+    def on_train_start(self, trainer):
+        self._beat = self.clock()
+        self._m_stalled.set(0.0)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._watch, daemon=True, name="train-watchdog")
+        self._thread.start()
+
+    def on_step_end(self, trainer, step, metrics):
+        with self._lock:
+            if self._m_stalled.value:
+                logger.warning("watchdog: step %d completed, stall cleared",
+                               step)
+                self._m_stalled.set(0.0)
+            self._beat = self.clock()
+
+    def on_train_end(self, trainer):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            # beat read, staleness check, and flag set are one critical
+            # section with on_step_end — otherwise a step landing
+            # between check and set leaves a spurious stall flagged and
+            # the edge-triggered counter inflated forever
+            with self._lock:
+                if self._beat is None:
+                    continue
+                overdue = self.clock() - self._beat
+                if overdue <= self.budget_s or self._m_stalled.value:
+                    continue
+                # edge-triggered: one count per stall, gauge stays up
+                # until a step completes
+                self._m_stalled.set(1.0)
+                self._m_stalls.inc()
+            logger.error(
+                "watchdog: no step completed for %.1fs "
+                "(budget %.1fs) — host loop or a collective is hung",
+                overdue, self.budget_s,
+            )
 
 
 class Profiler(Callback):

@@ -37,6 +37,16 @@ from ..utils import config as config_lib
 logger = logging.getLogger(__name__)
 
 
+def step_dir(directory: str, step: int) -> str:
+    """The on-disk directory of one checkpoint step — the single
+    definition of the layout, shared with the fault harness
+    (resilience/faults.py) so disk faults always target the same paths
+    the restore-time integrity checks read."""
+    return os.path.join(
+        os.path.abspath(os.path.expanduser(directory)), str(step)
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class CheckpointConfig:
     directory: str = ""
@@ -80,6 +90,28 @@ class PreemptionWatcher:
     def preempted(self) -> bool:
         return self._event.is_set()
 
+    def close(self) -> None:
+        """Reinstall the handlers captured at construction — without
+        this, a second Checkpointer built later in the same process
+        (tests, eval-side restore) would capture THIS watcher's handler
+        as its ``_prev`` and chain stale flags. Only restores signals
+        still pointing at this watcher (a newer watcher's handler is
+        left in place); idempotent."""
+        if threading.current_thread() is not threading.main_thread():
+            # signal.signal is main-thread-only; keep _prev so a later
+            # main-thread close() can still restore
+            return
+        for sig, prev in list(self._prev.items()):
+            # bound-method identity is not stable across accesses;
+            # == compares (__self__, __func__), which is what we need
+            if signal.getsignal(sig) == self._handler:
+                signal.signal(sig, prev)
+                del self._prev[sig]
+            # else: a newer watcher's handler is installed — keep our
+            # captured prev so a LATER close() (after that watcher
+            # restores ours) can still put the original back; dropping
+            # it here would lose the original handler forever
+
 
 class Checkpointer:
     """Save/restore + retention + preemption, over an orbax
@@ -102,7 +134,8 @@ class Checkpointer:
             os.path.abspath(os.path.expanduser(cfg.directory)), options=options
         )
         self._finite_check = None
-        self._manifest_threads: list[threading.Thread] = []
+        #: (step, thread) for in-flight async manifest stampers
+        self._manifest_threads: list[tuple[int, threading.Thread]] = []
 
     # -- save -------------------------------------------------------------
     def maybe_save(self, step: int, state: Any) -> bool:
@@ -177,7 +210,7 @@ class Checkpointer:
             logger.info("checkpoint saved at step %d", step)
         if saved and self.cfg.write_manifest and cluster.is_chief():
             self._manifest_threads = [
-                t for t in self._manifest_threads if t.is_alive()
+                (s, t) for s, t in self._manifest_threads if t.is_alive()
             ]
             if self.cfg.async_save:
                 # manifest can only cover files that exist: wait for the
@@ -187,16 +220,14 @@ class Checkpointer:
                     daemon=True, name=f"ckpt-manifest-{step}",
                 )
                 t.start()
-                self._manifest_threads.append(t)
+                self._manifest_threads.append((step, t))
             else:
                 self._write_manifest(step)
         return saved
 
     # -- native CRC manifest (runtime/io.py integration) -------------------
     def _step_dir(self, step: int) -> str:
-        return os.path.join(
-            os.path.abspath(os.path.expanduser(self.cfg.directory)), str(step)
-        )
+        return step_dir(self.cfg.directory, step)
 
     def _manifest_after_commit(self, step: int) -> None:
         try:
@@ -269,11 +300,19 @@ class Checkpointer:
 
     def wait(self) -> None:
         self.manager.wait_until_finished()
-        for t in self._manifest_threads:
+        still_alive: list[tuple[int, threading.Thread]] = []
+        for step, t in self._manifest_threads:
             t.join(timeout=60)
-        self._manifest_threads = [
-            t for t in self._manifest_threads if t.is_alive()
-        ]
+            if t.is_alive():
+                # never silently drop a stamper: the step's restore-time
+                # integrity check depends on MANIFEST.dtf existing
+                logger.error(
+                    "manifest thread for step %d still running after 60s "
+                    "join; MANIFEST.dtf for that checkpoint may be missing",
+                    step,
+                )
+                still_alive.append((step, t))
+        self._manifest_threads = still_alive
 
     # -- restore ----------------------------------------------------------
     def latest_step(self) -> int | None:
@@ -315,6 +354,8 @@ class Checkpointer:
         # otherwise the daemon manifest thread dies with the process and the
         # final checkpoint silently lacks its integrity manifest.
         self.wait()
+        if self.watcher is not None:
+            self.watcher.close()  # reinstall pre-watcher signal handlers
         self.manager.close()
 
 

@@ -22,7 +22,7 @@ from jax.sharding import Mesh, NamedSharding
 
 from ..parallel import sharding as sh
 from . import step as step_lib
-from .callbacks import Callback
+from .callbacks import Callback, CheckpointCallback
 from .checkpoint import PreemptionSaved
 
 logger = logging.getLogger(__name__)
@@ -43,6 +43,7 @@ class Trainer:
         spec_tree: step_lib.TrainState,
         callbacks: Sequence[Callback] = (),
         donate: bool = True,
+        emergency_checkpoint=None,
     ):
         self.mesh = mesh
         self.spec_tree = spec_tree
@@ -50,6 +51,16 @@ class Trainer:
         self.callbacks = list(callbacks)
         self._stop_reason: str | None = None
         self.failed = False  # set when fit() aborts on an exception
+        #: Checkpointer used for the best-effort save on an unhandled
+        #: step exception (docs/resilience.md). Defaults to the manager
+        #: of the first CheckpointCallback in ``callbacks``, so wiring a
+        #: CheckpointCallback is enough to get crash-safe exits.
+        self.emergency_checkpoint = emergency_checkpoint
+        if self.emergency_checkpoint is None:
+            for cb in self.callbacks:
+                if isinstance(cb, CheckpointCallback):
+                    self.emergency_checkpoint = cb.manager
+                    break
         if donate:
             self.step_fn = step_lib.jit_train_step(train_step, mesh, spec_tree)
         else:
@@ -77,13 +88,17 @@ class Trainer:
         data: Iterable[Any],
         num_steps: int | None = None,
     ) -> step_lib.TrainState:
-        for cb in self.callbacks:
-            cb.on_train_start(self)
-        data_iter = iter(data)
         # Host-side step mirror: reading state.step would sync the device
         # every iteration and serialize dispatch with execution.
         step_now = int(self.state.step)
         try:
+            # inside the try: a raising on_train_start (or iter()) must
+            # still reach the finally's on_train_end, or started
+            # resources leak — e.g. Watchdog's poll thread would flag a
+            # phantom stall in the registry forever
+            for cb in self.callbacks:
+                cb.on_train_start(self)
+            data_iter = iter(data)
             while not self.should_stop:
                 if num_steps is not None and step_now >= num_steps:
                     self.request_stop(f"num_steps={num_steps}")
@@ -104,6 +119,13 @@ class Trainer:
             self.request_stop(str(e))
         except BaseException:
             self.failed = True
+            # Crash-safe exit: one best-effort emergency checkpoint of
+            # the last completed step before re-raising. save() itself
+            # applies validate_before_save, so a poisoned state (the
+            # NaNGuard abort path) is refused and never becomes the
+            # latest checkpoint; any error here must not mask the
+            # original exception.
+            self._emergency_save(step_now)
             raise
         finally:
             for cb in self.callbacks:
@@ -111,3 +133,28 @@ class Trainer:
         if self._stop_reason:
             logger.info("training stopped: %s", self._stop_reason)
         return self.state
+
+    def _emergency_save(self, step: int) -> None:
+        """Best-effort checkpoint on an unhandled exception: whatever
+        survives validation is worth keeping so the restart resumes from
+        step N instead of the last cadence save. Covers host-side
+        failures — a dead data iterator, a raising callback — where the
+        state really is the last completed step's. For a DEVICE-side
+        step failure (deferred async XlaRuntimeError, donation already
+        consumed) the state may be unreadable; fetching it then raises
+        inside save(), is caught below, and the restart falls back to
+        the last cadence save — best-effort means exactly that."""
+        ckpt = self.emergency_checkpoint
+        if ckpt is None or step <= 0:
+            return
+        try:
+            if ckpt.save(step, self.state, force=True):
+                ckpt.wait()
+                logger.warning("emergency checkpoint saved at step %d", step)
+            else:
+                logger.warning(
+                    "emergency checkpoint at step %d not written "
+                    "(refused by validation or already on disk)", step
+                )
+        except Exception:
+            logger.exception("emergency checkpoint at step %d failed", step)

@@ -1,0 +1,146 @@
+"""Single-process chaos worker (tests/test_resilience.py, tools/chaos_smoke.py).
+
+Trains a tiny MLP on batches derived deterministically from the GLOBAL
+step index, with an optional FaultPlan. The kill→restart→resume oracle:
+
+    run A (straight):    --steps N                → params_a.npz
+    run B (interrupted): --steps N --sigterm-at K → PreemptionSaved exit
+    run C (resume, same workdir as B): --steps N  → params_b.npz
+
+A and C must produce BIT-IDENTICAL params: the preemption save captured
+the full state exactly, and resume replays exactly the batches the
+straight run would have seen (batch i feeds global step i, i seeded).
+
+Markers on stdout (the drivers assert on these):
+    CHAOS-DONE step=N        run reached the target step
+    CHAOS-PREEMPTED step=K   clean PreemptionSaved exit, checkpoint at K
+    CHAOS-DATAFAULT saved=K  injected IOError; emergency checkpoint at K
+"""
+
+import argparse
+import os
+import sys
+
+# must precede any jax import in this process
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# persistent compile cache: OPT-IN only, mirroring tests/conftest.py —
+# cache-deserialized executables corrupt donated buffers on this jaxlib
+# (silent NaN params on resume), which this worker exists to catch
+_cache_dir = os.environ.get("DTF_TEST_CACHE", "0")
+if _cache_dir != "0":
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.05)
+
+import numpy as np  # noqa: E402
+
+
+def global_step_batch(i: int) -> dict:
+    """The batch that feeds global step ``i`` — a pure function of i, so
+    straight and resumed runs see identical data."""
+    rng = np.random.RandomState(1000 + i)
+    return {
+        "image": rng.randn(8, 8).astype(np.float32),
+        "label": rng.randint(0, 4, 8).astype(np.int32),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("workdir", help="checkpoint directory")
+    ap.add_argument("--steps", type=int, default=8,
+                    help="absolute target step (StopAtStepHook semantics)")
+    ap.add_argument("--sigterm-at", type=int, default=None,
+                    help="SIGTERM ourselves after this GLOBAL step")
+    ap.add_argument("--data-error-at", type=int, default=None,
+                    help="data iterator raises IOError feeding this GLOBAL step")
+    ap.add_argument("--out", default=None,
+                    help="write final params to this .npz on completion")
+    args = ap.parse_args(argv)
+
+    import optax
+
+    from distributed_tensorflow_tpu.models import MLP, MLPConfig, common
+    from distributed_tensorflow_tpu.parallel import MeshSpec, build_mesh
+    from distributed_tensorflow_tpu.resilience import (
+        DataError, FaultPlan, Sigterm,
+    )
+    from distributed_tensorflow_tpu.train import (
+        CheckpointConfig, Checkpointer, StepOptions, Trainer,
+        callbacks as cb, init_or_restore, make_train_step,
+    )
+
+    mesh = build_mesh(MeshSpec(data=-1))
+    model = MLP(MLPConfig(hidden_sizes=(16,), num_classes=4))
+    tx = optax.adam(1e-2)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=args.workdir, save_interval_steps=10**6,
+                         async_save=False, preemption_check_every=1),
+        mesh,
+    )
+    state, specs, restored = init_or_restore(
+        ckpt, common.make_init_fn(model, (8,)), tx, mesh, jax.random.PRNGKey(0)
+    )
+    start = int(state.step)
+
+    faults = []
+    if args.sigterm_at is not None:
+        # FaultCallback sees the trainer's GLOBAL step — no offset
+        if args.sigterm_at <= start:
+            raise SystemExit(f"--sigterm-at {args.sigterm_at} is already "
+                             f"behind the restored step {start}")
+        faults.append(Sigterm(args.sigterm_at))
+    if args.data_error_at is not None:
+        # iterator batches are 1-based PER PROCESS: batch i = step start+i
+        if args.data_error_at <= start:
+            raise SystemExit(f"--data-error-at {args.data_error_at} is "
+                             f"already behind the restored step {start}")
+        faults.append(DataError(args.data_error_at - start))
+    plan = FaultPlan(tuple(faults))
+
+    trainer = Trainer(
+        make_train_step(common.classification_loss_fn(model), tx,
+                        StepOptions()),
+        state, mesh, specs,
+        callbacks=[cb.CheckpointCallback(ckpt), plan.callback()],
+    )
+
+    def batches():
+        i = start
+        while True:
+            i += 1
+            yield global_step_batch(i)
+
+    try:
+        state = trainer.fit(plan.wrap(batches()), num_steps=args.steps)
+    except IOError:
+        saved = ckpt.latest_step()
+        ckpt.close()
+        print(f"CHAOS-DATAFAULT saved={saved}", flush=True)
+        return 0
+    saved = ckpt.latest_step()
+    ckpt.close()
+    if "preempted" in (trainer._stop_reason or ""):
+        print(f"CHAOS-PREEMPTED step={saved}", flush=True)
+        return 0
+    if int(state.step) != args.steps:
+        print(f"CHAOS-SHORT step={int(state.step)} want={args.steps}",
+              flush=True)
+        return 1
+    if args.out:
+        leaves = jax.tree.leaves(jax.device_get(state.params))
+        np.savez(args.out, **{f"p{i}": np.asarray(x)
+                              for i, x in enumerate(leaves)})
+    print(f"CHAOS-DONE step={int(state.step)}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

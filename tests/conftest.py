@@ -48,11 +48,20 @@ def mesh_dp4_tp2(devices):
     return build_mesh(MeshSpec(data=4, model=2), devices[:8])
 
 
-# Persistent XLA compilation cache for the test rig: the fast tier is
-# dominated by CPU compile time (most tests compile in 2-8s and run in
-# ms), so warm reruns skip straight to execution. Keyed automatically by
-# jaxlib version + flags; delete the dir to force cold compiles.
-_cache_dir = os.environ.get("DTF_TEST_CACHE", "/tmp/dtf_test_xla_cache")
+# Persistent XLA compilation cache — OPT-IN via DTF_TEST_CACHE=<dir>,
+# default OFF. On this jaxlib/CPU combination, executables DESERIALIZED
+# from the persistent cache mishandle buffer donation: donated inputs
+# (the train step's state, the serve engine's KV cache) go through stale
+# aliasing info, which manifests as glibc heap-corruption aborts
+# ("corrupted double-linked list") or — worse — silently NaN'd params on
+# restore-and-resume. Found by the resilience chaos suite: with a warm
+# cache even the SEED test_loop_checkpoint.py crashed when run in
+# isolation, and tests/chaos_worker.py resumes produced NaN params while
+# exiting 0. Cold compiles cost seconds per program but are correct; do
+# not re-enable by default without re-running
+# tests/test_resilience.py::test_kill_resume_bit_identical twice
+# back-to-back (cold then warm) under the cache dir.
+_cache_dir = os.environ.get("DTF_TEST_CACHE", "0")
 if _cache_dir != "0":
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.05)

@@ -252,6 +252,106 @@ def test_preemption_with_poisoned_state_fails_not_saves(mesh8, tmp_path):
     ckpt.close()
 
 
+def test_preemption_poisoned_with_earlier_checkpoint_still_fails(mesh8, tmp_path):
+    """The maybe_save refusal branch with an EARLIER checkpoint on disk:
+    latest < step still means the preemption save wrote nothing for this
+    step, so the run must exit FAILED (FloatingPointError naming the
+    stale latest) — and the earlier healthy checkpoint must survive."""
+    tx = optax.sgd(0.1)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "pe"), async_save=False,
+                         save_on_preemption=True),
+        mesh8,
+    )
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    assert ckpt.save(2, state, force=True)  # healthy save at step 2
+    poisoned = state.replace(
+        params=jax.tree.map(lambda p: p * jnp.nan, state.params)
+    )
+    ckpt.watcher._event.set()
+    with pytest.raises(FloatingPointError, match="latest on disk: 2"):
+        ckpt.maybe_save(5, poisoned)
+    assert ckpt.latest_step() == 2  # the stale-but-healthy save is intact
+    ckpt.close()
+
+
+def test_preemption_poisoned_but_step_already_saved_is_clean(mesh8, tmp_path):
+    """If the preempted step is ALREADY covered on disk (save() dedups,
+    latest == step), the refusal of the poisoned in-memory state doesn't
+    matter — the PreemptionSaved contract holds and the run exits
+    cleanly, resuming from the healthy copy of the same step."""
+    from distributed_tensorflow_tpu.train.checkpoint import PreemptionSaved
+
+    tx = optax.sgd(0.1)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "pc"), async_save=False,
+                         save_on_preemption=True),
+        mesh8,
+    )
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    assert ckpt.save(5, state, force=True)  # step 5 is on disk, healthy
+    poisoned = state.replace(
+        params=jax.tree.map(lambda p: p * jnp.nan, state.params)
+    )
+    ckpt.watcher._event.set()
+    with pytest.raises(PreemptionSaved):
+        ckpt.maybe_save(5, poisoned)
+    assert ckpt.latest_step() == 5
+    ckpt.close()
+
+
+def test_emergency_checkpoint_on_callback_exception(mesh8, tmp_path):
+    """An exception out of ANY callback aborts fit() — but the Trainer's
+    emergency save keeps the last completed step (crash-safe exits,
+    docs/resilience.md). Discovery is implicit: wiring a
+    CheckpointCallback is enough, no extra argument."""
+    class Boom(cb.Callback):
+        def on_step_end(self, trainer, step, metrics):
+            if step == 3:
+                raise RuntimeError("callback exploded")
+
+    tx = optax.sgd(0.1)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "em"),
+                         save_interval_steps=10**6, async_save=False,
+                         save_on_preemption=False),
+        mesh8,
+    )
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    trainer = Trainer(
+        make_train_step(linear_loss, tx), state, mesh8, specs,
+        callbacks=[cb.CheckpointCallback(ckpt), Boom()],
+    )
+    assert trainer.emergency_checkpoint is ckpt
+    with pytest.raises(RuntimeError, match="callback exploded"):
+        trainer.fit(batches(10), num_steps=10)
+    assert trainer.failed
+    assert ckpt.latest_step() == 3  # the emergency save
+    ckpt.close()
+
+
+def test_no_emergency_checkpoint_without_checkpointer(mesh8):
+    """No CheckpointCallback and no explicit emergency_checkpoint: the
+    failure path must still re-raise cleanly (no AttributeError from the
+    best-effort save)."""
+    tx = optax.sgd(0.1)
+    state, specs = init_train_state(linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    trainer = Trainer(make_train_step(linear_loss, tx), state, mesh8, specs)
+    assert trainer.emergency_checkpoint is None
+    with pytest.raises(IOError):
+        def dies():
+            yield make_batch(16, seed=0)
+            raise IOError("dead feed")
+        trainer.fit(dies(), num_steps=10)
+    assert trainer.failed
+
+
 def test_optimizer_clip_grad_norm_wired(mesh8):
     """clip_grad_norm on OptimizerConfig must actually clip."""
     big = make_batch(16)

@@ -1,0 +1,359 @@
+"""Fault-injection tests: every recovery path in the durability story
+driven end-to-end by the deterministic harness (resilience/faults.py) —
+preemption agreement, emergency checkpoints, validate-before-save
+refusal, manifest rejection of corrupt shards, watchdog stall flagging,
+and the subprocess kill→restart→resume bit-identity oracle."""
+
+import os
+import signal
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu import resilience as rz
+from distributed_tensorflow_tpu.obs.registry import Registry
+from distributed_tensorflow_tpu.train import (
+    CheckpointConfig,
+    Checkpointer,
+    Trainer,
+    callbacks as cb,
+    init_or_restore,
+    make_train_step,
+)
+from distributed_tensorflow_tpu.train.checkpoint import (
+    PreemptionWatcher,
+)
+
+from test_step import linear_init, linear_loss, make_batch
+
+WORKER = os.path.join(os.path.dirname(__file__), "chaos_worker.py")
+
+
+def batches(n, size=16):
+    for i in range(n):
+        yield make_batch(size, seed=i)
+
+
+# ---------------------------------------------------------------------------
+# Harness unit behavior (no device)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_seeded_deterministic():
+    kinds = ("sigterm", "data_error", "nan_batch", "clock_stall")
+    a = rz.FaultPlan.seeded(7, 100, kinds=kinds)
+    b = rz.FaultPlan.seeded(7, 100, kinds=kinds)
+    assert a == b  # same seed → identical plan
+    assert a != rz.FaultPlan.seeded(8, 100, kinds=kinds)
+    for f in a.faults:
+        at = f.step if hasattr(f, "step") else f.batch
+        assert 2 <= at <= 99  # never the first or final step
+    with pytest.raises(ValueError):
+        rz.FaultPlan.seeded(0, 2)
+    with pytest.raises(ValueError):
+        rz.FaultPlan.seeded(0, 10, kinds=("meteor_strike",))
+
+
+def test_fault_clock():
+    clk = rz.FaultClock(start=5.0)
+    assert clk() == 5.0
+    assert clk.advance(2.5) == 7.5 == clk()
+    with pytest.raises(ValueError):
+        clk.advance(-1.0)
+
+
+def test_faulty_iterator_data_error_and_nan_poison():
+    def src():
+        i = 0
+        while True:
+            i += 1
+            yield {"x": np.ones(4, np.float32), "label": np.zeros(4, np.int32),
+                   "i": i}
+
+    it = rz.FaultPlan((rz.NaNBatch(2), rz.DataError(4),)).wrap(src())
+    b1 = next(it)
+    assert np.isfinite(b1["x"]).all()
+    b2 = next(it)  # poisoned: one NaN in the first float array
+    assert np.isnan(b2["x"]).any() and not np.isnan(b2["x"]).all()
+    assert np.isfinite(b1["x"]).all()  # original batch dict untouched
+    assert b2["label"].dtype == np.int32  # ints never poisoned
+    b3 = next(it)
+    assert np.isfinite(b3["x"]).all()  # NaN fault fires exactly once
+    with pytest.raises(IOError, match="injected data fault"):
+        next(it)
+    # fires exactly once, and the faulted fetch consumed NO source batch
+    # (a real IO error loses the read, not the data)
+    assert next(it)["i"] == 4
+
+
+def test_clock_stall_fault_via_callback():
+    clk = rz.FaultClock()
+    fcb = rz.FaultPlan((rz.ClockStall(step=3, dt=120.0),)).callback(clock=clk)
+    for step in range(1, 6):
+        fcb.on_step_end(None, step, {})
+    assert clk() == 120.0  # fired once at step 3, never again
+    with pytest.raises(ValueError, match="clock"):
+        rz.FaultPlan((rz.ClockStall(1, 1.0),)).callback().on_step_end(
+            None, 1, {})
+
+
+# ---------------------------------------------------------------------------
+# Signal-handler hygiene (satellite: PreemptionWatcher.close)
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_watcher_close_restores_handlers():
+    orig = signal.getsignal(signal.SIGTERM)
+    try:
+        w1 = PreemptionWatcher()
+        assert signal.getsignal(signal.SIGTERM) == w1._handler
+        w2 = PreemptionWatcher()  # captures w1's handler as its _prev
+        w2.close()  # LIFO close: w1 handler back in place
+        assert signal.getsignal(signal.SIGTERM) == w1._handler
+        w1.close()
+        assert signal.getsignal(signal.SIGTERM) == orig
+        # out-of-order close must not clobber a newer watcher's handler
+        w3 = PreemptionWatcher()
+        w4 = PreemptionWatcher()
+        w3.close()
+        assert signal.getsignal(signal.SIGTERM) == w4._handler
+        w4.close()
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+def test_checkpointer_close_restores_signal_handler(mesh8, tmp_path):
+    orig = signal.getsignal(signal.SIGTERM)
+    try:
+        ckpt = Checkpointer(
+            CheckpointConfig(directory=str(tmp_path / "w"), async_save=False,
+                             save_on_preemption=True),
+            mesh8,
+        )
+        assert signal.getsignal(signal.SIGTERM) != orig
+        ckpt.close()
+        assert signal.getsignal(signal.SIGTERM) == orig
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+# ---------------------------------------------------------------------------
+# In-process fault → recovery paths
+# ---------------------------------------------------------------------------
+
+
+def _checkpointer(mesh, d, **kw):
+    base = dict(directory=str(d), save_interval_steps=10**6,
+                async_save=False, save_on_preemption=False,
+                preemption_check_every=1)
+    base.update(kw)
+    return Checkpointer(CheckpointConfig(**base), mesh)
+
+
+def test_sigterm_fault_coordinated_save_clean_exit(mesh8, tmp_path):
+    """Sigterm fault → PreemptionWatcher flag → coordinated final save →
+    PreemptionSaved → clean Trainer stop, all through production seams."""
+    orig = signal.getsignal(signal.SIGTERM)
+    tx = optax.sgd(0.1)
+    ckpt = _checkpointer(mesh8, tmp_path / "pre", save_on_preemption=True)
+    try:
+        state, specs, _ = init_or_restore(
+            ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+        plan = rz.FaultPlan((rz.Sigterm(3),))
+        trainer = Trainer(
+            make_train_step(linear_loss, tx), state, mesh8, specs,
+            callbacks=[cb.CheckpointCallback(ckpt), plan.callback()],
+        )
+        trainer.fit(batches(50), num_steps=50)
+        assert not trainer.failed
+        assert "preempted" in trainer._stop_reason
+        # SIGTERM fires after step 3; the next step's maybe_save coordinates
+        assert ckpt.latest_step() == 4
+        ckpt.close()
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+def test_data_fault_emergency_checkpoint_then_resume_matches(mesh8, tmp_path):
+    """An IOError out of the data iterator aborts the run — but the
+    Trainer's emergency save means restart-and-resume loses nothing and
+    reproduces the uninterrupted run's params exactly."""
+    tx = optax.adam(1e-2)
+
+    # uninterrupted reference: 6 steps
+    from distributed_tensorflow_tpu.train import init_train_state
+    state, specs = init_train_state(linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    trainer = Trainer(make_train_step(linear_loss, tx), state, mesh8, specs)
+    straight = trainer.fit(batches(6), num_steps=6)
+
+    # faulted run: the iterator dies feeding step 4 (3 steps complete)
+    ckpt = _checkpointer(mesh8, tmp_path / "em")
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    plan = rz.FaultPlan((rz.DataError(4),))
+    trainer = Trainer(
+        make_train_step(linear_loss, tx), state, mesh8, specs,
+        callbacks=[cb.CheckpointCallback(ckpt)],
+    )
+    with pytest.raises(IOError, match="injected data fault"):
+        trainer.fit(plan.wrap(batches(50)), num_steps=50)
+    assert trainer.failed
+    assert ckpt.latest_step() == 3  # the emergency save, not a cadence one
+    ckpt.close()
+
+    # fresh "process": restore and run the remaining steps on the same data
+    ckpt2 = _checkpointer(mesh8, tmp_path / "em")
+    state2, specs2, restored = init_or_restore(
+        ckpt2, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    assert restored and int(state2.step) == 3
+    trainer2 = Trainer(make_train_step(linear_loss, tx), state2, mesh8, specs2)
+    resumed = trainer2.fit(
+        (make_batch(16, seed=i) for i in range(3, 6)), num_steps=6)
+    assert int(resumed.step) == 6
+    for a, b in zip(jax.tree.leaves(straight.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ckpt2.close()
+
+
+def test_nan_fault_refused_by_validate_before_save(mesh8, tmp_path):
+    """NaNBatch fault → non-finite grads poison the params → NaNGuard
+    aborts AND both the cadence save and the emergency save refuse the
+    poisoned state: the latest checkpoint stays the last healthy step."""
+    tx = optax.sgd(0.1)
+    ckpt = _checkpointer(mesh8, tmp_path / "nan", save_interval_steps=1)
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    plan = rz.FaultPlan((rz.NaNBatch(3),))
+    trainer = Trainer(
+        make_train_step(linear_loss, tx), state, mesh8, specs,
+        callbacks=[cb.NaNGuard(every_n=1), cb.CheckpointCallback(ckpt)],
+    )
+    with pytest.raises(FloatingPointError):
+        trainer.fit(plan.wrap(batches(50)), num_steps=50)
+    assert trainer.failed
+    assert ckpt.latest_step() == 2  # healthy cadence saves survive, NaN never lands
+    ckpt.close()
+
+
+def test_truncated_shard_rejected_at_restore(mesh8, tmp_path):
+    """Acceptance gate: a shard truncated by the fault harness must be
+    rejected by verify_manifest (OSError), never silently loaded."""
+    tx = optax.sgd(0.1)
+    ckpt = _checkpointer(mesh8, tmp_path / "tr")
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    assert ckpt.save(0, state, force=True)
+    assert ckpt.verify_manifest(0) is True
+    victim = rz.truncate_shard(str(tmp_path / "tr"), 0)
+    assert os.path.exists(victim)
+    with pytest.raises(OSError, match="manifest says|missing shard"):
+        ckpt.verify_manifest(0)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+    with pytest.raises(OSError):
+        ckpt.restore(abstract, step=0)
+    ckpt.close()
+
+
+def test_corrupt_shard_is_size_preserving(mesh8, tmp_path):
+    """corrupt_shard flips content without changing sizes — the fault
+    the size-checking manifest intentionally does NOT catch (that tier
+    is orbax's own digests / the manifest's CRC on itself); the harness
+    keeps the two fault classes distinct."""
+    tx = optax.sgd(0.1)
+    ckpt = _checkpointer(mesh8, tmp_path / "co")
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    assert ckpt.save(0, state, force=True)
+    victim = rz.corrupt_shard(str(tmp_path / "co"), 0)
+    assert ckpt.verify_manifest(0) is True  # sizes intact by design
+    assert os.path.getsize(victim) > 0
+    ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_flags_hung_step_and_recovers():
+    import time
+
+    reg = Registry()
+    wd = cb.Watchdog(budget_s=0.05, poll_s=0.01, registry=reg)
+    wd.on_train_start(None)
+    try:
+        deadline = time.monotonic() + 2.0
+        while (reg.get("train_watchdog_stalled").value == 0.0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert reg.get("train_watchdog_stalled").value == 1.0
+        assert reg.get("train_watchdog_stalls_total").value == 1.0
+        wd.on_step_end(None, 1, {})  # a step lands: stall clears
+        assert reg.get("train_watchdog_stalled").value == 0.0
+    finally:
+        wd.on_train_end(None)
+    assert wd._thread is None
+
+
+def test_watchdog_with_injected_clock_stall():
+    import time
+
+    reg = Registry()
+    clk = rz.FaultClock()
+    wd = cb.Watchdog(budget_s=60.0, poll_s=0.01, registry=reg, clock=clk)
+    wd.on_train_start(None)
+    try:
+        fcb = rz.FaultPlan((rz.ClockStall(step=1, dt=61.0),)).callback(clk)
+        fcb.on_step_end(None, 1, {})  # the "hang": one minute vanishes
+        deadline = time.monotonic() + 2.0
+        while (reg.get("train_watchdog_stalled").value == 0.0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert reg.get("train_watchdog_stalled").value == 1.0
+    finally:
+        wd.on_train_end(None)
+
+
+# ---------------------------------------------------------------------------
+# Subprocess end-to-end: kill → restart → resume, bit-identical
+# ---------------------------------------------------------------------------
+
+
+def _run_worker(workdir, *extra, timeout=240):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the worker sets its own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, WORKER, str(workdir), *extra],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    assert p.returncode == 0, f"worker rc={p.returncode}:\n{p.stdout}\n{p.stderr}"
+    return p.stdout
+
+
+def test_kill_resume_bit_identical(tmp_path):
+    """THE acceptance criterion: SIGTERM mid-run → PreemptionSaved →
+    fresh process restores and finishes → params bit-identical to an
+    uninterrupted run of the same seed."""
+    a_dir, b_dir = tmp_path / "straight", tmp_path / "killed"
+    a_out, b_out = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+
+    out = _run_worker(a_dir, "--steps", "8", "--out", a_out)
+    assert "CHAOS-DONE step=8" in out, out
+
+    out = _run_worker(b_dir, "--steps", "8", "--sigterm-at", "3")
+    assert "CHAOS-PREEMPTED step=4" in out, out  # saved the step after the signal
+
+    out = _run_worker(b_dir, "--steps", "8", "--out", b_out)
+    assert "CHAOS-DONE step=8" in out, out
+
+    a, b = np.load(a_out), np.load(b_out)
+    assert sorted(a.files) == sorted(b.files) and a.files
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k])  # BIT-identical

@@ -206,7 +206,268 @@ def test_scheduler_rejects_invalid():
     with pytest.raises(ValueError):
         s.submit([1], max_new_tokens=0)
     with pytest.raises(ValueError):
+        s.submit([1], deadline_s=0.0)
+    with pytest.raises(ValueError):
         s.append_token(0, 1)  # empty slot
+    with pytest.raises(ValueError):
+        sched_lib.Scheduler(2, 16, max_queue=0)
+
+
+# ---------------------------------------------------------------------------
+# Admission control: backpressure, deadlines, cancellation, drain
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_backpressure_and_fifo_across_rejections():
+    """QueueFull rejection must not perturb the FIFO order of accepted
+    requests, and capacity freed by admission is immediately usable."""
+    s = sched_lib.Scheduler(1, 16, max_queue=2)
+    a = s.submit([1])
+    b = s.submit([2])
+    with pytest.raises(sched_lib.QueueFull):
+        s.submit([3])  # rejected — never enters the line
+    placed = s.admit()  # a takes the slot, queue has room again
+    assert [r.uid for _, r in placed] == [a]
+    c = s.submit([4])
+    s.append_token(0, 9)  # a decodes one token, stays resident
+    assert s.cancel(a) is not None  # free the slot
+
+    placed = s.admit()
+    assert [r.uid for _, r in placed] == [b]
+    assert list(r.uid for r in s.queue) == [c]  # FIFO preserved: b before c
+
+
+def test_scheduler_deadline_timeout_queued_and_resident():
+    from distributed_tensorflow_tpu.resilience import FaultClock
+
+    clk = FaultClock()
+    s = sched_lib.Scheduler(1, 16, clock=clk)
+    res = s.submit([1], max_new_tokens=8, deadline_s=5.0)
+    qd = s.submit([2], deadline_s=1.0)
+    nodeadline = s.submit([3])
+    assert s.admit()[0][1].uid == res
+    assert s.expire() == []  # nothing due yet
+    clk.advance(2.0)  # past qd's deadline, not res's
+    evicted = s.expire()
+    assert [r.uid for r in evicted] == [qd]
+    assert s.finished[qd].finish_reason == sched_lib.FINISH_TIMEOUT
+    assert s.finished[qd].t_finish == 2.0 and s.finished[qd].t_admit is None
+    clk.advance(4.0)  # now res (resident) is past its deadline
+    evicted = s.expire()
+    assert [r.uid for r in evicted] == [res]
+    assert s.finished[res].finish_reason == sched_lib.FINISH_TIMEOUT
+    assert s.slots == [None]  # slot freed for the no-deadline request
+    assert s.admit()[0][1].uid == nodeadline
+
+
+def test_scheduler_cancel_everywhere_idempotent():
+    s = sched_lib.Scheduler(1, 16)
+    a = s.submit([1], max_new_tokens=4)
+    b = s.submit([2])
+    s.admit()
+    s.append_token(0, 7)  # a has one token in flight
+    got = s.cancel(a)  # resident cancel frees the slot, keeps the token
+    assert got is not None and got.finish_reason == sched_lib.FINISH_CANCELLED
+    assert got.generated == [7] and s.slots == [None]
+    got = s.cancel(b)  # queued cancel: never admitted
+    assert got is not None and got.t_admit is None
+    assert s.cancel(a) is None and s.cancel(b) is None  # idempotent
+    assert s.cancel(12345) is None  # unknown uid
+    assert not s.has_work and sorted(s.finished) == [a, b]
+
+
+def test_scheduler_close_stops_admission_cancels_queue():
+    s = sched_lib.Scheduler(1, 16)
+    a = s.submit([1], max_new_tokens=2)
+    b = s.submit([2])
+    c = s.submit([3])
+    s.admit()
+    cancelled = s.close()
+    assert [r.uid for r in cancelled] == [b, c]
+    assert all(r.finish_reason == sched_lib.FINISH_CANCELLED for r in cancelled)
+    with pytest.raises(sched_lib.SchedulerClosed):
+        s.submit([4])
+    assert s.close() == []  # idempotent
+    # the resident request still decodes to completion
+    s.append_token(0, 1)
+    done = s.append_token(0, 2)
+    assert done is not None and done.uid == a
+    assert done.finish_reason == sched_lib.FINISH_MAX_NEW
+    assert not s.has_work
+
+
+def test_scheduler_invariants_chaos_stream():
+    """Randomized stream with deadlines, cancels, and backpressure
+    interleaved with token-driven evictions: no slot leaks, admissions
+    stay FIFO, every accepted request lands in finished exactly once
+    with a coherent reason."""
+    from distributed_tensorflow_tpu.resilience import FaultClock
+
+    rng = random.Random(20260803)
+    clk = FaultClock()
+    num_slots, max_len = 3, 24
+    s = sched_lib.Scheduler(num_slots, max_len, clock=clk, max_queue=6)
+    accepted, rejected, cancelled_by_us = [], 0, set()
+    admitted_order = []
+
+    for step in range(4000):
+        # bursty arrivals so the bounded queue actually overflows
+        for _ in range(rng.randint(0, 5) if len(accepted) < 120 else 0):
+            try:
+                accepted.append(s.submit(
+                    [rng.randrange(50) for _ in range(rng.randint(1, max_len))],
+                    max_new_tokens=rng.randint(1, 6),
+                    eos_id=7 if rng.random() < 0.3 else None,
+                    deadline_s=rng.uniform(0.5, 5.0)
+                    if rng.random() < 0.4 else None,
+                ))
+            except sched_lib.QueueFull:
+                rejected += 1
+        if rng.random() < 0.1 and accepted:
+            victim = rng.choice(accepted)
+            if s.cancel(victim) is not None:
+                cancelled_by_us.add(victim)
+        clk.advance(rng.uniform(0.0, 0.5))
+        s.expire()
+        admitted_order.extend(r.uid for _, r in s.admit())
+        live = [r.uid for r in s.slots if r is not None]
+        assert len(live) == len(set(live))  # no double-booking
+        for slot in s.active_slots():
+            s.append_token(slot, rng.randrange(50))
+        if len(accepted) >= 120 and not s.has_work:
+            break
+    assert not s.has_work, "chaos stream did not drain"
+
+    assert rejected > 0, "stream never hit backpressure — weak test"
+    assert cancelled_by_us and admitted_order
+    assert admitted_order == sorted(admitted_order)  # FIFO survives chaos
+    assert sorted(s.finished) == sorted(accepted)  # all land exactly once
+    reasons = {r.finish_reason for r in s.finished.values()}
+    assert reasons <= set(sched_lib.FINISH_REASONS)
+    assert sched_lib.FINISH_TIMEOUT in reasons
+    assert sched_lib.FINISH_CANCELLED in reasons
+    for r in s.finished.values():
+        if r.finish_reason == sched_lib.FINISH_TIMEOUT:
+            assert r.t_deadline is not None and r.t_finish >= r.t_deadline
+        elif r.finish_reason == sched_lib.FINISH_CANCELLED:
+            assert r.uid in cancelled_by_us
+        else:
+            assert r.t_admit is not None  # token-driven finishes were resident
+
+
+# ---------------------------------------------------------------------------
+# Engine-level admission control + telemetry invariant
+# ---------------------------------------------------------------------------
+
+
+def _finished_totals(reg):
+    return {
+        dict(m.labels)["reason"]: int(m.value)
+        for m in reg.collect() if m.name == "serve_finished_total"
+    }
+
+
+def _assert_telemetry_invariant(eng, expect_finished):
+    """The PR-2 acceptance gate, extended over the new eviction paths:
+    every finished request — including timeout/cancelled — contributes
+    exactly one TTFT and one TPOT observation."""
+    reg = eng.registry
+    total = sum(_finished_totals(reg).values())
+    assert total == expect_finished
+    assert reg.get("serve_ttft_seconds").count == total
+    assert reg.get("serve_tpot_seconds").count == total
+
+
+def test_engine_timeout_and_cancel_telemetry(decoder):
+    from distributed_tensorflow_tpu.resilience import FaultClock
+
+    cfg, _, params = decoder
+    clk = FaultClock()
+    eng = serve.ServeEngine(cfg, params, num_slots=1, clock=clk)
+    a = eng.submit([5, 17, 3], max_new_tokens=4)
+    b = eng.submit([9, 9], max_new_tokens=4, deadline_s=1.0)  # starves in queue
+    c = eng.submit([4, 4], max_new_tokens=4)
+    eng.step()  # a prefills + decodes; b, c wait
+    clk.advance(2.0)
+    stats = eng.step()  # b times out before ever taking the slot
+    assert b in stats.finished
+    assert eng.cancel(c) is True and eng.cancel(c) is False
+    done = eng.run()
+    assert done[a].finish_reason == sched_lib.FINISH_MAX_NEW
+    assert done[b].finish_reason == sched_lib.FINISH_TIMEOUT
+    assert done[b].generated == [] and done[b].t_admit is None
+    assert done[c].finish_reason == sched_lib.FINISH_CANCELLED
+    totals = _finished_totals(eng.registry)
+    assert totals[sched_lib.FINISH_TIMEOUT] == 1
+    assert totals[sched_lib.FINISH_CANCELLED] == 1
+    _assert_telemetry_invariant(eng, 3)
+
+
+def test_engine_cancel_resident_frees_slot(decoder):
+    cfg, _, params = decoder
+    eng = serve.ServeEngine(cfg, params, num_slots=1)
+    a = eng.submit([5, 17, 3], max_new_tokens=50)
+    bquiet = eng.submit([8, 1], max_new_tokens=3)
+    eng.step()
+    eng.step()  # a is mid-decode with a couple of tokens out
+    assert eng.cancel(a) is True
+    assert eng.sched.active_slots() == []  # slot freed immediately
+    done = eng.run()  # bquiet takes the slot and completes
+    assert done[a].finish_reason == sched_lib.FINISH_CANCELLED
+    assert len(done[a].generated) >= 1  # delivered tokens are kept
+    assert done[bquiet].finish_reason == sched_lib.FINISH_MAX_NEW
+    _assert_telemetry_invariant(eng, 2)
+
+
+def test_engine_drain_graceful_shutdown(decoder):
+    cfg, _, params = decoder
+    eng = serve.ServeEngine(cfg, params, num_slots=1, max_queue=4)
+    a = eng.submit([5, 17, 3], max_new_tokens=3)
+    b = eng.submit([2, 2], max_new_tokens=3)
+    eng.step()  # a resident, b queued
+    done = eng.drain()
+    assert done[a].finish_reason == sched_lib.FINISH_MAX_NEW  # finished, not killed
+    assert done[b].finish_reason == sched_lib.FINISH_CANCELLED  # never ran
+    with pytest.raises(sched_lib.SchedulerClosed):
+        eng.submit([1])
+    assert eng.registry.get("serve_occupancy").value == 0.0
+    assert not eng.sched.has_work and eng.sched.finished == {}  # flushed
+    _assert_telemetry_invariant(eng, 2)
+
+
+def test_stream_survives_concurrent_drain(decoder):
+    """A stream() consumer mid-iteration when drain() shuts the engine
+    down must still receive every token drain() decoded for its request
+    — not KeyError after the finished map is handed over."""
+    cfg, _, params = decoder
+    eng = serve.ServeEngine(cfg, params, num_slots=1)
+    it = eng.stream([5, 17, 3], max_new_tokens=5)
+    first = next(it)
+    done = eng.drain()  # finishes the resident streamed request
+    assert len(done) == 1
+    req = next(iter(done.values()))
+    assert req.finish_reason == sched_lib.FINISH_MAX_NEW
+    assert [first] + list(it) == req.generated  # full delivery, no KeyError
+
+
+def test_engine_deadline_mid_decode_eviction(decoder):
+    """FINISH_TIMEOUT for a RESIDENT request: the deadline passes while
+    it is decoding; the next step evicts it before more tokens land."""
+    from distributed_tensorflow_tpu.resilience import FaultClock
+
+    cfg, _, params = decoder
+    clk = FaultClock()
+    eng = serve.ServeEngine(cfg, params, num_slots=1, clock=clk)
+    a = eng.submit([5, 17, 3], max_new_tokens=50, deadline_s=3.0)
+    eng.step()
+    g_before = len(eng.sched.slots[0].generated)
+    clk.advance(5.0)
+    stats = eng.step()
+    assert a in stats.finished and stats.decoded_slots == 0
+    done = eng.run()
+    assert done[a].finish_reason == sched_lib.FINISH_TIMEOUT
+    assert len(done[a].generated) == g_before  # nothing delivered post-deadline
+    _assert_telemetry_invariant(eng, 1)
 
 
 # ---------------------------------------------------------------------------

@@ -110,6 +110,33 @@ def main(argv=None):
         "full_batch_steps": full,
         "full_batch_frac": round(full / len(decode_steps), 3),
     }
+    # Chaos epilogue (ISSUE 3 acceptance): exercise the timeout and
+    # cancel eviction paths on the SAME engine and re-check the
+    # histogram-counts == Σ serve_finished_total invariant with the new
+    # reasons in play. Runs after percentiles were read, so the two
+    # aborted requests never pollute the steady-state numbers above.
+    doomed = eng.submit([1, 2, 3], max_new_tokens=4, deadline_s=1e-9)
+    while doomed not in eng.sched.finished:
+        eng.step()
+    killed = eng.submit([4, 5], max_new_tokens=4)
+    assert eng.cancel(killed)
+    eng.run()
+    from distributed_tensorflow_tpu.serve import scheduler as sl
+
+    reasons = {
+        dict(m.labels)["reason"]: int(m.value)
+        for m in reg.collect() if m.name == "serve_finished_total"
+    }
+    total = sum(reasons.values())
+    assert reasons[sl.FINISH_TIMEOUT] >= 1 and reasons[sl.FINISH_CANCELLED] >= 1
+    assert reg.get("serve_ttft_seconds").count == total, (
+        f"ttft count {reg.get('serve_ttft_seconds').count} != finished {total} "
+        f"after timeout/cancel evictions ({reasons})"
+    )
+    assert reg.get("serve_tpot_seconds").count == total, (
+        f"tpot count != finished after timeout/cancel evictions ({reasons})"
+    )
+
     print(json.dumps(result, indent=2))
     if args.json:
         with open(args.json, "w") as f:

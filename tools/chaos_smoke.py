@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Fast chaos smoke — the resilience gates quick enough for tools/ci_fast.sh.
+
+Two stages (full coverage lives in tests/test_resilience.py and
+tests/test_serve.py; this is the canary that the recovery machinery is
+wired at all):
+
+1. **Scheduler admission invariants** (pure host, no device work):
+   bounded-queue backpressure raises QueueFull, deadlines evict with
+   FINISH_TIMEOUT from queue AND slot, cancel is idempotent, close()
+   stops admission — driven on a FaultClock so it runs in microseconds.
+2. **One SIGTERM→resume round** (two tests/chaos_worker.py
+   subprocesses): a tiny train run SIGTERMs itself mid-run, exits via
+   the coordinated preemption save, and a fresh process restores and
+   finishes at the target step.
+
+Usage: JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+WORKER = os.path.join(_REPO, "tests", "chaos_worker.py")
+
+
+def scheduler_invariants() -> None:
+    from distributed_tensorflow_tpu.resilience import FaultClock
+    from distributed_tensorflow_tpu.serve import scheduler as sl
+
+    clk = FaultClock()
+    s = sl.Scheduler(2, 16, clock=clk, max_queue=2)
+    a = s.submit([1], deadline_s=1.0)
+    b = s.submit([2], max_new_tokens=2)
+    try:
+        s.submit([3])
+        raise AssertionError("QueueFull not raised at max_queue")
+    except sl.QueueFull:
+        pass
+    clk.advance(2.0)
+    expired = s.expire()  # a times out while still queued
+    assert [r.uid for r in expired] == [a], expired
+    assert s.finished[a].finish_reason == sl.FINISH_TIMEOUT
+    placed = s.admit()
+    assert [r.uid for _, r in placed] == [b], placed
+    c = s.submit([4], deadline_s=0.5)
+    assert s.admit()[0][1].uid == c  # c resident
+    clk.advance(1.0)
+    assert [r.uid for r in s.expire()] == [c]  # resident timeout frees slot
+    assert s.cancel(b) is not None and s.cancel(b) is None  # idempotent
+    assert s.close() == [] and s.closed
+    try:
+        s.submit([5])
+        raise AssertionError("SchedulerClosed not raised after close()")
+    except sl.SchedulerClosed:
+        pass
+    assert not s.has_work and sorted(s.finished) == [a, b, c]
+    print("chaos_smoke: scheduler admission invariants OK")
+
+
+def sigterm_resume_round() -> None:
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def run(*args):
+        p = subprocess.run(
+            [sys.executable, WORKER, *args],
+            capture_output=True, text=True, timeout=240, env=env,
+        )
+        if p.returncode != 0:
+            raise AssertionError(
+                f"chaos worker rc={p.returncode}:\n{p.stdout}\n{p.stderr}"
+            )
+        return p.stdout
+
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke_") as d:
+        out = run(os.path.join(d, "ckpt"), "--steps", "6", "--sigterm-at", "2")
+        assert "CHAOS-PREEMPTED step=3" in out, out
+        out = run(os.path.join(d, "ckpt"), "--steps", "6")
+        assert "CHAOS-DONE step=6" in out, out
+    print("chaos_smoke: SIGTERM -> coordinated save -> resume OK")
+
+
+def main() -> int:
+    scheduler_invariants()
+    sigterm_resume_round()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -5,10 +5,14 @@
 #      (catches package-wide import regressions, ISSUE 1)
 #   2. tools/obs_check.py      — telemetry smoke: registry → Prometheus
 #      exposition render → format lint → JSONL round-trip (ISSUE 2)
+#   3. tools/chaos_smoke.py    — resilience smoke: scheduler
+#      timeout/cancel/backpressure invariants + one SIGTERM →
+#      coordinated-save → resume subprocess round (ISSUE 3)
 #
 # Usage: tools/ci_fast.sh   (extra args are passed to smoke_collect)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 bash tools/smoke_collect.sh "$@"
 env JAX_PLATFORMS=cpu python tools/obs_check.py >/dev/null
+env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 echo "ci_fast: all gates passed"
