@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator
 
 import jax
@@ -174,6 +175,63 @@ def make_dataset(cfg: DataConfig, num_batches: int | None = None,
             num_batches=num_batches, index_offset=index_offset,
         )
     raise ValueError(f"Unknown dataset '{cfg.dataset}'")
+
+
+class RetryingIterator:
+    """Self-healing batch stream: absorbs transient IOError-class faults
+    by RE-SEEKING the stream at the failed index instead of dying.
+
+    Sound because every dataset here is a pure function of
+    ``(seed, index)`` (the ``batch_rng`` scheme / ``index_offset``
+    contract): rebuilding the source at the index of the failed fetch
+    reproduces exactly the batch the consumer was owed, so a recovered
+    run is bit-identical to an unfaulted one.
+
+    ``make_source(start_index)`` must return an iterable whose first
+    batch is the stream's ``start_index``-th (0-based) — e.g.
+    ``lambda i: make_dataset(cfg, index_offset=i)``. Retries per fetch
+    are bounded by ``policy`` (resilience/retry.py: exponential backoff,
+    seeded jitter, obs counters ``retry_attempts_total{site}`` /
+    ``retry_exhausted_total{site}``); a permanent failure surfaces as
+    ``RetryExhausted`` with the underlying IOError chained, which the
+    train loop's emergency-checkpoint path and the Supervisor's
+    transient classification both understand.
+    """
+
+    def __init__(self, make_source: Callable[[int], Iterable], policy=None,
+                 *, start_index: int = 0, site: str = "data", registry=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        # lazy import: keeps data/ importable without the resilience
+        # package being fully initialized (it imports train/, which some
+        # tools load after data/)
+        from ..resilience import retry as retry_lib
+
+        self._retry = retry_lib
+        self.policy = policy if policy is not None else retry_lib.RetryPolicy()
+        self.make_source = make_source
+        self.site = site
+        self.registry = registry
+        self.clock = clock
+        self.sleep = sleep
+        #: batches successfully delivered so far (== next index to fetch)
+        self.index = start_index
+        self._it = iter(make_source(start_index))
+
+    def __iter__(self) -> "RetryingIterator":
+        return self
+
+    def _reseek(self, failures: int, exc: BaseException) -> None:
+        self._it = iter(self.make_source(self.index))
+
+    def __next__(self):
+        batch = self._retry.retry_call(
+            lambda: next(self._it),
+            policy=self.policy, site=self.site, registry=self.registry,
+            clock=self.clock, sleep=self.sleep, on_retry=self._reseek,
+        )
+        self.index += 1
+        return batch
 
 
 class Prefetcher:

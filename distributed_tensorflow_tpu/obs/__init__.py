@@ -5,9 +5,13 @@ hooks (StepCounterHook / SummarySaverHook / ProfilerHook on
 MonitoredTrainingSession.run) rebuilt as one subsystem with a single
 design rule: every metric is a MERGEABLE SUFFICIENT STATISTIC (counters
 and histogram buckets add; quantiles are derived at read time from
-fixed log-spaced buckets). serve/engine.py and train/callbacks.py
-record into a Registry; obs/export.py renders Prometheus text
-exposition or appends JSONL events, chief-gated. See
+fixed log-spaced buckets). serve/engine.py, train/callbacks.py, and the
+recovery layer (resilience/retry.py's ``retry_*_total{site}``,
+resilience/supervisor.py's ``supervisor_restarts_total{cause}``) record
+into a Registry; obs/export.py renders Prometheus text exposition or
+appends JSONL events, chief-gated. Registries MERGE across supervised
+restarts (never reset), so counters stay exact over attempt boundaries;
+``Registry.total`` sums a labeled family for invariant checks. See
 docs/observability.md.
 """
 

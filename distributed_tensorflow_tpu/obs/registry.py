@@ -266,6 +266,18 @@ class Registry:
             (name, tuple(sorted(labels.items())))
         )
 
+    def total(self, name: str) -> float:
+        """Sum a metric family across ALL label sets — e.g.
+        Σ ``supervisor_restarts_total{cause=…}`` or
+        Σ ``retry_exhausted_total{site=…}``. Counters/gauges contribute
+        their value, histograms their observation count; 0.0 when the
+        name was never registered."""
+        with self._lock:
+            ms = [m for m in self._metrics.values() if m.name == name]
+        return float(sum(
+            m.count if isinstance(m, Histogram) else m.value for m in ms
+        ))
+
     def reset(self) -> None:
         """Zero every metric IN PLACE (handles stay valid — benches call
         this after warmup so compile-time observations don't pollute

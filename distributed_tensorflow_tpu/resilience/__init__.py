@@ -1,15 +1,23 @@
-"""Fault injection + recovery validation (docs/resilience.md).
+"""Fault injection + automatic recovery (docs/resilience.md).
 
 The durability layers (train/checkpoint.py preemption saves + manifests,
 serve admission control, crash-safe Trainer exits) are only as good as
 the faults that have actually been thrown at them. This package holds
-the deterministic fault harness that drives every recovery path
-end-to-end — in tests (tests/test_resilience.py, tests/chaos_worker.py)
-and in the CI chaos smoke (tools/chaos_smoke.py).
+both halves of that story:
+
+- the deterministic fault harness (faults.py) that drives every recovery
+  path end-to-end — in tests (tests/test_resilience.py,
+  tests/chaos_worker.py) and in the CI chaos smoke
+  (tools/chaos_smoke.py);
+- the recovery machinery itself: a generic retry/backoff executor with
+  seeded jitter and obs counters (retry.py), and the in-process training
+  Supervisor that classifies failures and restarts `Trainer.fit` from
+  the latest *valid* checkpoint under a restart budget (supervisor.py).
 """
 
 from .faults import (  # noqa: F401
     ClockStall,
+    CorruptCheckpoint,
     DataError,
     FaultCallback,
     FaultClock,
@@ -17,6 +25,23 @@ from .faults import (  # noqa: F401
     FaultyIterator,
     NaNBatch,
     Sigterm,
+    TransientIOError,
     corrupt_shard,
     truncate_shard,
+)
+from .retry import (  # noqa: F401
+    AttemptTimeout,
+    RetryExhausted,
+    RetryPolicy,
+    retry_call,
+)
+from .supervisor import (  # noqa: F401
+    FATAL,
+    POISONED,
+    PREEMPTION,
+    TRANSIENT,
+    Supervisor,
+    SupervisorConfig,
+    SupervisorExhausted,
+    classify_failure,
 )

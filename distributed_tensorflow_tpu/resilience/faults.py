@@ -23,21 +23,36 @@ it drives):
 - ``ClockStall(step, dt)``— the injectable ``FaultClock`` jumps forward
   after step N: drives the Watchdog budget and serve deadlines without
   real waiting.
+- ``TransientIOError(batch, times)`` — the data iterator raises
+  ``IOError`` fetching batch M, ``times`` times in total, then succeeds:
+  the retryable fault class ``RetryingIterator`` (data/pipeline.py)
+  absorbs by re-seeking; ``times`` past the retry budget models a
+  *permanent* IO failure and drives retry exhaustion instead.
+- ``CorruptCheckpoint(restart)`` — truncates the newest saved checkpoint
+  at the Nth supervisor restart boundary (``FaultPlan.restart_hook``
+  seam): the torn-write-discovered-at-restore fault that
+  ``Checkpointer.restore(fallback=True)`` must quarantine and fall past.
 
 Checkpoint corruption is a disk-level fault, not a run-level one, so it
 is a pair of standalone helpers (``truncate_shard`` / ``corrupt_shard``)
 aimed at a saved step dir; ``verify_manifest`` must reject the result at
-restore time.
+restore time. ``CorruptCheckpoint`` is the plan-scheduled wrapper over
+``truncate_shard`` for supervised runs.
 
 Everything is deterministic: faults fire at exact step/batch indices,
 and ``FaultPlan.seeded`` derives those indices from a seed so a chaos
-sweep is reproducible run-to-run.
+sweep is reproducible run-to-run. Fired-state lives ON THE PLAN (not the
+callback/iterator instance), so a fault fires at most once per plan even
+when the Supervisor rebuilds the callback list and re-wraps the data
+stream on every restart — a SIGTERM injected at step 3 does not re-fire
+after the restart resumes past step 3.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import random
 import signal as signal_lib
@@ -45,6 +60,8 @@ import signal as signal_lib
 import numpy as np
 
 from ..train.callbacks import Callback
+
+logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -116,7 +133,36 @@ class ClockStall:
     dt: float
 
 
-Fault = Sigterm | DataError | NaNBatch | ClockStall
+@dataclasses.dataclass(frozen=True)
+class TransientIOError:
+    """Raise ``IOError`` from the data iterator fetching the ``batch``-th
+    batch (1-based), ``times`` times IN TOTAL across every iterator
+    wrapping this plan, then succeed — the remaining-fires count is
+    plan-shared state, so a re-seeking retry wrapper sees the fault decay
+    exactly ``times`` fires regardless of how often it rebuilds the
+    stream. A huge ``times`` models a permanent IO failure (drives retry
+    exhaustion); no source batch is ever consumed by a faulted fetch
+    (FaultyIterator seam)."""
+
+    batch: int
+    times: int = 1
+    message: str = "injected transient IO fault"
+
+
+@dataclasses.dataclass(frozen=True)
+class CorruptCheckpoint:
+    """Truncate ``nbytes`` from the largest shard of the NEWEST saved
+    checkpoint when supervisor restart number ``restart`` begins (1 = the
+    first restart; ``FaultPlan.restart_hook`` seam). Models corruption
+    discovered at restore time — the case fallback restore must
+    quarantine and degrade past, not brick on."""
+
+    restart: int = 1
+    nbytes: int = 1
+
+
+Fault = (Sigterm | DataError | NaNBatch | ClockStall | TransientIOError
+         | CorruptCheckpoint)
 
 
 # ---------------------------------------------------------------------------
@@ -126,12 +172,25 @@ Fault = Sigterm | DataError | NaNBatch | ClockStall
 
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
-    """An immutable schedule of faults. One plan drives both seams:
+    """An immutable schedule of faults. One plan drives three seams:
     ``plan.callback()`` goes into the Trainer's callback list (step
     faults), ``plan.wrap(iterator)`` wraps the batch source (data
-    faults). Each fault fires at most once."""
+    faults), ``plan.restart_hook(dir)`` goes into the Supervisor's
+    ``on_restart`` list (restart-boundary disk faults).
+
+    Each fault fires at most once PER PLAN: the fired set (and the
+    remaining-fires count of TransientIOError) is shared mutable state on
+    the plan, excluded from equality — so re-wrapping the stream or
+    rebuilding the callback list (retry re-seeks, supervisor restarts)
+    never re-fires a fault that already happened."""
 
     faults: tuple[Fault, ...] = ()
+    #: indices of faults that already fired — plan-level, not per-seam
+    _fired: set = dataclasses.field(
+        default_factory=set, init=False, compare=False, repr=False)
+    #: fault index → remaining fires, for TransientIOError decay
+    _transient_left: dict = dataclasses.field(
+        default_factory=dict, init=False, compare=False, repr=False)
 
     @classmethod
     def seeded(cls, seed: int, num_steps: int,
@@ -154,6 +213,12 @@ class FaultPlan:
                 faults.append(NaNBatch(at))
             elif kind == "clock_stall":
                 faults.append(ClockStall(at, dt=rng.uniform(1.0, 600.0)))
+            elif kind == "transient_io":
+                faults.append(TransientIOError(at, times=rng.randint(1, 2)))
+            elif kind == "ckpt_corrupt":
+                # fires at the first restart boundary; `at` drawn anyway
+                # so every kind consumes rng state uniformly
+                faults.append(CorruptCheckpoint(restart=1))
             else:
                 raise ValueError(f"unknown fault kind {kind!r}")
         return cls(tuple(faults))
@@ -161,8 +226,46 @@ class FaultPlan:
     def callback(self, clock: FaultClock | None = None) -> "FaultCallback":
         return FaultCallback(self, clock=clock)
 
-    def wrap(self, iterator) -> "FaultyIterator":
-        return FaultyIterator(iterator, self)
+    def wrap(self, iterator, start: int = 0) -> "FaultyIterator":
+        """``start``: batches already consumed upstream (a resumed run's
+        restored step), so batch-indexed faults stay aligned with GLOBAL
+        step numbering across restarts and re-seeks."""
+        return FaultyIterator(iterator, self, start=start)
+
+    def restart_hook(self, directory: str):
+        """A ``Supervisor(on_restart=…)`` hook firing this plan's
+        CorruptCheckpoint faults against the newest step dir under
+        ``directory`` (no-op until a checkpoint exists)."""
+
+        def hook(restart_index: int, cause: str) -> None:
+            for i, fault in enumerate(self.faults):
+                if (not isinstance(fault, CorruptCheckpoint)
+                        or i in self._fired
+                        or restart_index < fault.restart):
+                    continue
+                step = _newest_step_on_disk(directory)
+                if step is None:
+                    continue  # nothing saved yet; try again next restart
+                self._fired.add(i)
+                path = truncate_shard(directory, step, nbytes=fault.nbytes)
+                logger.warning(
+                    "fault: truncated %d byte(s) of newest checkpoint "
+                    "(step %d) at restart %d: %s",
+                    fault.nbytes, step, restart_index, path,
+                )
+
+        return hook
+
+
+def _newest_step_on_disk(directory: str) -> int | None:
+    """Largest numeric step dir under ``directory`` (filesystem truth —
+    no manager involved, matching how disk faults see the world)."""
+    d = os.path.abspath(os.path.expanduser(directory))
+    if not os.path.isdir(d):
+        return None
+    steps = [int(n) for n in os.listdir(d)
+             if n.isdigit() and os.path.isdir(os.path.join(d, n))]
+    return max(steps) if steps else None
 
 
 class FaultCallback(Callback):
@@ -174,17 +277,17 @@ class FaultCallback(Callback):
     def __init__(self, plan: FaultPlan, clock: FaultClock | None = None):
         self.plan = plan
         self.clock = clock
-        self._fired: set[int] = set()
 
     def on_step_end(self, trainer, step, metrics):
+        fired = self.plan._fired  # plan-shared: at most once per PLAN
         for i, fault in enumerate(self.plan.faults):
-            if i in self._fired:
+            if i in fired:
                 continue
             if isinstance(fault, Sigterm) and step >= fault.step:
-                self._fired.add(i)
+                fired.add(i)
                 os.kill(os.getpid(), signal_lib.SIGTERM)
             elif isinstance(fault, ClockStall) and step >= fault.step:
-                self._fired.add(i)
+                fired.add(i)
                 if self.clock is None:
                     raise ValueError(
                         "ClockStall fault needs FaultPlan.callback(clock=...)"
@@ -194,32 +297,47 @@ class FaultCallback(Callback):
 
 class FaultyIterator:
     """Wraps a batch iterator and injects the plan's batch-indexed
-    faults. Batch numbering is 1-based and counts ``next()`` calls, so
-    with the standard loop batch i feeds train step i."""
+    faults. Batch numbering is 1-based and counts ``next()`` calls from
+    ``start``, so with the standard loop batch i feeds train step i —
+    pass ``start=restored_step`` on resume to keep global alignment.
 
-    def __init__(self, iterator, plan: FaultPlan):
+    Fired-state is plan-shared: a one-shot fault (DataError/NaNBatch)
+    fires once per PLAN even across re-wraps, and TransientIOError's
+    remaining-fires count decays across re-seeks — a faulted fetch never
+    consumes a source batch, so recovery sees the data it missed."""
+
+    def __init__(self, iterator, plan: FaultPlan, start: int = 0):
         self._it = iter(iterator)
         self.plan = plan
-        self.count = 0
-        self._fired: set[int] = set()
+        self.count = start
 
     def __iter__(self) -> "FaultyIterator":
         return self
 
     def __next__(self):
         self.count += 1
+        fired = self.plan._fired
+        left = self.plan._transient_left
         for i, fault in enumerate(self.plan.faults):
-            if i in self._fired or not isinstance(fault, DataError):
-                continue
-            if self.count >= fault.batch:
-                self._fired.add(i)
-                raise IOError(f"{fault.message} (batch {self.count})")
+            if isinstance(fault, DataError):
+                if i not in fired and self.count >= fault.batch:
+                    fired.add(i)
+                    raise IOError(f"{fault.message} (batch {self.count})")
+            elif isinstance(fault, TransientIOError):
+                if self.count >= fault.batch:
+                    remaining = left.setdefault(i, fault.times)
+                    if remaining > 0:
+                        left[i] = remaining - 1
+                        raise IOError(
+                            f"{fault.message} (batch {self.count}, "
+                            f"{remaining - 1} fire(s) left)"
+                        )
         batch = next(self._it)
         for i, fault in enumerate(self.plan.faults):
-            if i in self._fired or not isinstance(fault, NaNBatch):
+            if i in fired or not isinstance(fault, NaNBatch):
                 continue
             if self.count >= fault.batch:
-                self._fired.add(i)
+                fired.add(i)
                 batch = _poison_batch(batch, fault.key)
         return batch
 

@@ -1,0 +1,219 @@
+"""In-process training supervision: restart-from-last-valid-checkpoint.
+
+The reference's `MonitoredTrainingSession` hid a `_RecoverableSession`
+($TF monitored_session.py:1238): when a run-call died of a transient
+error it silently rebuilt the session from the last checkpoint and kept
+going. Our rebuild made recovery checkpoint-restart (train/checkpoint.py)
+but left the restart to an external scheduler; `Supervisor` closes the
+loop *in process* — it wraps `Trainer.fit`, classifies what killed an
+attempt, and relaunches from the newest checkpoint that passes integrity
+checks, under a restart budget with escalating, seeded-jitter backoff.
+
+Failure taxonomy (``classify_failure``, docs/resilience.md):
+
+- ``transient``  — IO-class errors (OSError/IOError, incl. a
+  RetryExhausted whose underlying failures were IO): the world glitched,
+  the state on disk is fine → restart and resume.
+- ``poisoned``   — FloatingPointError (NaNGuard abort,
+  validate-before-save refusal): the in-memory state went bad; the last
+  *valid* checkpoint predates the poison → roll back and retry. With
+  deterministic data the poison usually recurs and the restart budget
+  converts it into a loud, classified failure.
+- ``fatal``      — everything else (bugs, bad config, KeyboardInterrupt):
+  re-raised immediately, never retried.
+- ``preemption`` — not an exception: `Trainer.fit` returned cleanly with
+  ``trainer.preempted`` set (SIGTERM → coordinated save). Restartable in
+  process for single-host runs and chaos tests; on a real TPU slice the
+  machine is going away, so production configs typically drop it from
+  ``restart_on`` and let the cluster scheduler do the restart.
+
+The supervisor itself never touches a checkpoint: the *builder* callable
+constructs each attempt — fresh `Checkpointer` (fresh signal watcher),
+`init_or_restore(..., fallback=True)` so a corrupt newest checkpoint is
+quarantined and the run degrades by a few steps instead of bricking, a
+fresh `Trainer`, and the data stream positioned at the restored step.
+Driven end-to-end by the seedable FaultPlan in tests, so every recovery
+is exactly reproducible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Iterable, Sequence
+
+from ..obs.registry import Registry, default_registry
+from .retry import RetryExhausted, RetryPolicy
+
+logger = logging.getLogger(__name__)
+
+#: failure classes (classify_failure) and the preemption restart cause
+TRANSIENT = "transient"
+POISONED = "poisoned"
+FATAL = "fatal"
+PREEMPTION = "preemption"
+
+#: counter name (documented in docs/observability.md)
+RESTARTS_TOTAL = "supervisor_restarts_total"
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map an exception out of ``Trainer.fit`` to a failure class."""
+    if isinstance(exc, RetryExhausted):
+        # see through to what the retries were absorbing
+        under = exc.__cause__
+        if isinstance(under, FloatingPointError):
+            return POISONED
+        return TRANSIENT
+    if isinstance(exc, FloatingPointError):
+        return POISONED
+    if isinstance(exc, OSError):  # IOError/TimeoutError are aliases/subclasses
+        return TRANSIENT
+    return FATAL
+
+
+class SupervisorExhausted(RuntimeError):
+    """The restart budget ran out. ``cause`` is the classified failure
+    class of the last attempt; the last exception (if the attempt raised
+    rather than exiting via preemption) is chained as ``__cause__``."""
+
+    def __init__(self, cause: str, restarts: int, last: BaseException | None):
+        super().__init__(
+            f"supervisor restart budget exhausted after {restarts} "
+            f"restart(s); last failure class {cause!r}"
+            + (f": {last!r}" if last is not None else "")
+        )
+        self.cause = cause
+        self.restarts = restarts
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    #: restarts allowed (attempts = max_restarts + 1)
+    max_restarts: int = 3
+    #: failure classes that earn a restart; anything else re-raises
+    restart_on: tuple[str, ...] = (TRANSIENT, POISONED, PREEMPTION)
+    #: escalating backoff between attempts — reuses RetryPolicy's
+    #: seeded-jitter schedule (max_attempts is ignored here; the restart
+    #: budget is max_restarts above)
+    backoff: RetryPolicy = RetryPolicy(
+        base_s=0.2, multiplier=2.0, max_backoff_s=60.0)
+
+    def __post_init__(self):
+        if self.max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        unknown = set(self.restart_on) - {TRANSIENT, POISONED, PREEMPTION}
+        if unknown:
+            raise ValueError(f"unknown restart_on classes: {sorted(unknown)}")
+
+
+class Supervisor:
+    """Run ``build → fit`` until the target step is reached, restarting
+    restartable failures from the latest valid checkpoint.
+
+    ``build(restart_index)`` returns ``(trainer, data, checkpointer)``
+    for one attempt; ``checkpointer`` may be None, otherwise the
+    supervisor closes it when the attempt ends (success or failure) so
+    signal handlers and async savers never leak across attempts.
+
+    ``on_restart`` hooks run as ``hook(restart_index, cause)`` after the
+    backoff sleep and before the next ``build`` — the production seam
+    for cache cleanup or operator paging, and the seam
+    ``FaultPlan.restart_hook`` uses to model corruption discovered at
+    restart time. Hooks execute inside the next attempt's classified
+    try: a hook that raises transiently earns a restart like any other
+    failure, and the hooks re-run on that next attempt — keep them
+    idempotent. ``sleep`` is injectable so chaos tests run the full
+    escalation in microseconds.
+    """
+
+    def __init__(
+        self,
+        build: Callable[[int], tuple[Any, Iterable, Any]],
+        num_steps: int,
+        cfg: SupervisorConfig = SupervisorConfig(),
+        registry: Registry | None = None,
+        on_restart: Sequence[Callable[[int, str], None]] = (),
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.build = build
+        self.num_steps = num_steps
+        self.cfg = cfg
+        self.registry = registry if registry is not None else default_registry()
+        self.on_restart = tuple(on_restart)
+        self.sleep = sleep
+        #: restarts performed by the last run() (observability for tests)
+        self.restarts = 0
+
+    def run(self):
+        """Supervised ``Trainer.fit``; returns the final TrainState.
+
+        Raises SupervisorExhausted when the restart budget runs out, or
+        re-raises the attempt's exception for non-restartable classes.
+        A deliberate early stop (``trainer.request_stop`` without
+        preemption, or data exhaustion) is respected and returned as-is.
+        """
+        restarts = 0
+        last_exc: BaseException | None = None
+        #: (restart_index, cause) the on_restart hooks still owe a run for
+        pending_hook: tuple[int, str] | None = None
+        while True:
+            self.restarts = restarts
+            cause: str | None = None
+            trainer = ckpt = None
+            try:
+                try:
+                    # hooks and build are INSIDE the classified attempt:
+                    # a transient failure at the restart boundary (a
+                    # hook's disk work, a restore-time IO blip) earns
+                    # another restart, not a raw escape. Hooks re-run on
+                    # the next attempt if one raised — keep them
+                    # idempotent. A builder that dies after creating its
+                    # checkpointer must close it itself — the supervisor
+                    # never saw it.
+                    if pending_hook is not None:
+                        for hook in self.on_restart:
+                            hook(*pending_hook)
+                        pending_hook = None
+                    trainer, data, ckpt = self.build(restarts)
+                    state = trainer.fit(data, num_steps=self.num_steps)
+                except BaseException as e:
+                    cause = classify_failure(e)
+                    last_exc = e
+                    logger.error(
+                        "supervised attempt %d failed [%s]: %r",
+                        restarts, cause, e,
+                    )
+                    if cause not in self.cfg.restart_on:
+                        raise
+                else:
+                    done = int(state.step) >= self.num_steps
+                    if done or not getattr(trainer, "preempted", False):
+                        return state
+                    cause, last_exc = PREEMPTION, None
+                    if cause not in self.cfg.restart_on:
+                        return state
+            finally:
+                if ckpt is not None:
+                    try:
+                        ckpt.close()
+                    except Exception:
+                        logger.exception(
+                            "closing checkpointer after attempt %d failed",
+                            restarts,
+                        )
+            if restarts >= self.cfg.max_restarts:
+                raise SupervisorExhausted(cause, restarts, last_exc) from last_exc
+            delay = self.cfg.backoff.backoff_s(restarts)
+            restarts += 1
+            self.registry.counter(
+                RESTARTS_TOTAL, "supervised restarts by failure class",
+                cause=cause,
+            ).inc()
+            logger.warning(
+                "supervisor: restart %d/%d (cause=%s) after %.2fs backoff",
+                restarts, self.cfg.max_restarts, cause, delay,
+            )
+            self.sleep(delay)
+            pending_hook = (restarts, cause)

@@ -32,6 +32,9 @@ import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import cluster
+# submodule import: resilience/retry.py has no train/ dependency, so this
+# cannot cycle even though resilience/__init__ imports train.callbacks
+from ..resilience.retry import RetryExhausted, RetryPolicy, retry_call
 from ..utils import config as config_lib
 
 logger = logging.getLogger(__name__)
@@ -118,12 +121,21 @@ class Checkpointer:
     CheckpointManager. One instance per run; also usable standalone for
     eval-side restore (SURVEY.md §3.5 pattern)."""
 
-    def __init__(self, cfg: CheckpointConfig, mesh: Mesh, spec_tree: Any = None):
+    def __init__(self, cfg: CheckpointConfig, mesh: Mesh, spec_tree: Any = None,
+                 io_retry: RetryPolicy | None = None, registry=None):
+        """``io_retry``: transient-IO retry budget applied to the save /
+        restore / manifest-write seams (sites ``ckpt_save`` /
+        ``ckpt_restore`` / ``ckpt_manifest_write``); defaults to a
+        3-attempt exponential policy. ``registry``: obs.Registry for the
+        retry counters (default: the process-wide one). Kept out of
+        CheckpointConfig so the config stays JSON-serializable."""
         if not cfg.directory:
             raise ValueError("CheckpointConfig.directory is required")
         self.cfg = cfg
         self.mesh = mesh
         self.spec_tree = spec_tree
+        self.io_retry = io_retry if io_retry is not None else RetryPolicy()
+        self.registry = registry
         self.watcher = PreemptionWatcher() if cfg.save_on_preemption else None
         options = ocp.CheckpointManagerOptions(
             max_to_keep=cfg.max_to_keep,
@@ -203,8 +215,15 @@ class Checkpointer:
                 "refusing to checkpoint at step %d: non-finite params", step
             )
             return False
-        saved = self.manager.save(
-            step, args=ocp.args.StandardSave(state), force=force
+        # Transient-IO retry around the orbax save call. With async_save
+        # the heavy shard writes happen later on orbax's own threads (their
+        # failures surface at wait_until_finished); the sync path — and the
+        # metadata/dispatch work of the async one — gets the retry budget.
+        saved = retry_call(
+            lambda: self.manager.save(
+                step, args=ocp.args.StandardSave(state), force=force
+            ),
+            policy=self.io_retry, site="ckpt_save", registry=self.registry,
         )
         if saved and cluster.is_chief():
             logger.info("checkpoint saved at step %d", step)
@@ -258,7 +277,12 @@ class Checkpointer:
                     "bytes": os.path.getsize(p),
                 })
         payload = json.dumps({"step": step, "files": files}).encode()
-        io_lib.write_payload(os.path.join(d, "MANIFEST.dtf"), payload)
+        retry_call(
+            lambda: io_lib.write_payload(
+                os.path.join(d, "MANIFEST.dtf"), payload),
+            policy=self.io_retry, site="ckpt_manifest_write",
+            registry=self.registry,
+        )
 
     def verify_manifest(self, step: int) -> bool | None:
         """CRC-verify MANIFEST.dtf and check every listed file exists with
@@ -276,13 +300,18 @@ class Checkpointer:
             p = os.path.join(d, entry["path"])
             if not os.path.exists(p):
                 raise OSError(
-                    f"checkpoint step {step}: missing shard {entry['path']}"
+                    f"checkpoint step {step}: missing shard {entry['path']} "
+                    f"(manifest expects {entry['bytes']} bytes at {p})"
                 )
             size = os.path.getsize(p)
             if size != entry["bytes"]:
+                # name the offending shard and expected-vs-actual sizes:
+                # "a step was rejected" is undebuggable, "THIS shard lost
+                # 512 bytes" points straight at the torn write
                 raise OSError(
                     f"checkpoint step {step}: shard {entry['path']} is "
-                    f"{size} bytes, manifest says {entry['bytes']}"
+                    f"{size} bytes, manifest says {entry['bytes']} "
+                    f"({entry['bytes'] - size:+d} byte delta at {p})"
                 )
         return True
 
@@ -319,20 +348,80 @@ class Checkpointer:
         """latest_checkpoint analog ($TF checkpoint_management.py:329)."""
         return self.manager.latest_step()
 
-    def restore(self, abstract_state: Any, step: int | None = None) -> Any:
+    def restore(self, abstract_state: Any, step: int | None = None,
+                fallback: bool = False) -> Any:
         """Sharding-aware restore: each host reads only its shards.
 
         ``abstract_state``: pytree of jax.ShapeDtypeStruct (e.g. from
         jax.eval_shape over the init fn) — combined with spec_tree it tells
         orbax the target sharding. Returns None if no checkpoint exists
         (caller falls back to fresh init — the Scaffold init-or-restore
-        decision, $TF monitored_session.py:52, without a chief)."""
+        decision, $TF monitored_session.py:52, without a chief).
+
+        ``fallback=True``: walk checkpoints newest→oldest (starting at
+        ``step`` when given), QUARANTINING any step whose manifest check
+        fails (moved to ``<dir>/.corrupt/<step>``, never silently reused)
+        and restoring the newest step that verifies — a truncated newest
+        shard degrades the run by a few steps instead of bricking it.
+        With ``fallback=False`` an integrity failure raises OSError
+        naming the offending shard and its expected-vs-actual size."""
         if step is None:
             step = self.latest_step()
         if step is None:
             return None
-        if self.cfg.write_manifest:
-            self.verify_manifest(step)  # raises before a corrupt restore
+        if not fallback:
+            if self.cfg.write_manifest:
+                self.verify_manifest(step)  # raises before a corrupt restore
+            return self._restore_step(step, abstract_state)
+        for s in sorted(self.manager.all_steps(), reverse=True):
+            if s > step:
+                continue  # explicit ceiling: never restore past `step`
+            if self.cfg.write_manifest:
+                try:
+                    # retried: quarantine is destructive, so a transient
+                    # FS blip during the check must not condemn a good
+                    # step — only a failure that survives the retry
+                    # budget counts as corruption
+                    retry_call(
+                        lambda: self.verify_manifest(s),
+                        policy=self.io_retry, site="ckpt_verify",
+                        registry=self.registry,
+                    )
+                except RetryExhausted as e:
+                    self._quarantine_or_skip(s, "integrity check",
+                                             e.__cause__ or e)
+                    continue
+            try:
+                return self._restore_step(s, abstract_state)
+            except (OSError, RetryExhausted) as e:
+                # a step that verifies (or predates manifests) but fails
+                # at read time — e.g. committed shards whose manifest
+                # stamp never landed — must also fall back, not brick
+                self._quarantine_or_skip(s, "restore", e)
+                continue
+        return None
+
+    def _quarantine_or_skip(self, step: int, what: str,
+                            exc: BaseException) -> None:
+        """Condemn a step during the fallback walk. Chief-only rename:
+        every host rejects the same step (shared fs, deterministic
+        checks) but only one may move it — and a lost race (dir already
+        gone) must fall back, not crash."""
+        logger.error(
+            "checkpoint step %d failed %s (%s); quarantining and falling "
+            "back to an older step", step, what, exc,
+        )
+        if cluster.is_chief():
+            try:
+                self.quarantine_step(step, reason=str(exc))
+            except OSError:
+                logger.exception(
+                    "quarantining step %d failed; skipping it without "
+                    "quarantine", step)
+        elif hasattr(self.manager, "reload"):
+            self.manager.reload()  # pick up the chief's rename
+
+    def _restore_step(self, step: int, abstract_state: Any) -> Any:
         if self.spec_tree is not None:
             target = jax.tree.map(
                 lambda s, spec: jax.ShapeDtypeStruct(
@@ -344,10 +433,41 @@ class Checkpointer:
             )
         else:
             target = abstract_state
-        state = self.manager.restore(step, args=ocp.args.StandardRestore(target))
+        state = retry_call(
+            lambda: self.manager.restore(
+                step, args=ocp.args.StandardRestore(target)),
+            policy=self.io_retry, site="ckpt_restore", registry=self.registry,
+        )
         if cluster.is_chief():
             logger.info("restored checkpoint at step %d", step)
         return state
+
+    def quarantine_step(self, step: int, reason: str = "") -> str:
+        """Move a failed step dir to ``<dir>/.corrupt/<step>`` (suffixing
+        on collision) so fallback never reconsiders it and a later
+        ``save()`` at the same step number starts clean. A QUARANTINE
+        file records why. Multi-host: call on the chief — the move is a
+        single rename on the shared filesystem. Returns the new path."""
+        src = self._step_dir(step)
+        base = os.path.join(os.path.dirname(src), ".corrupt")
+        os.makedirs(base, exist_ok=True)
+        dst = os.path.join(base, str(step))
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(base, f"{step}-{n}")
+        os.rename(src, dst)
+        try:
+            with open(os.path.join(dst, "QUARANTINE"), "w") as f:
+                f.write(reason + "\n")
+        except OSError:  # the reason note is best-effort
+            logger.exception("writing QUARANTINE note under %s failed", dst)
+        # the orbax manager caches its step list; refresh so latest_step()
+        # and a re-save at this step number see the removal
+        if hasattr(self.manager, "reload"):
+            self.manager.reload()
+        logger.warning("quarantined checkpoint step %d -> %s", step, dst)
+        return dst
 
     def close(self) -> None:
         # Drain pending async commits AND their manifest stampers first —
@@ -374,12 +494,15 @@ def init_or_restore(
     tx,
     mesh: Mesh,
     rng: jax.Array,
+    fallback: bool = False,
     **init_kwargs,
 ):
     """The one-call init-or-restore every train script uses. Builds the
     sharded fresh state (train/step.init_train_state), then overwrites from
     the latest checkpoint if one exists. Returns (state, spec_tree,
-    restored_bool)."""
+    restored_bool). ``fallback=True`` = multi-checkpoint fallback restore
+    (corrupt steps quarantined, newest valid step wins) — what supervised
+    restarts use."""
     from . import step as step_lib
 
     state, specs = step_lib.init_train_state(
@@ -389,7 +512,7 @@ def init_or_restore(
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
     )
-    restored = checkpointer.restore(abstract)
+    restored = checkpointer.restore(abstract, fallback=fallback)
     if restored is not None:
         return restored, specs, True
     return state, specs, False

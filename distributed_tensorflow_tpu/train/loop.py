@@ -51,6 +51,10 @@ class Trainer:
         self.callbacks = list(callbacks)
         self._stop_reason: str | None = None
         self.failed = False  # set when fit() aborts on an exception
+        #: set when fit() exited via a coordinated preemption save — the
+        #: signal resilience.Supervisor uses to distinguish "restart and
+        #: resume" from a deliberate stop without string-matching reasons
+        self.preempted = False
         #: Checkpointer used for the best-effort save on an unhandled
         #: step exception (docs/resilience.md). Defaults to the manager
         #: of the first CheckpointCallback in ``callbacks``, so wiring a
@@ -76,6 +80,11 @@ class Trainer:
     @property
     def should_stop(self) -> bool:
         return self._stop_reason is not None
+
+    @property
+    def stop_reason(self) -> str | None:
+        """Why the loop stopped (None while running / never stopped)."""
+        return self._stop_reason
 
     # -- data -------------------------------------------------------------
     def put_batch(self, batch: Any) -> Any:
@@ -115,7 +124,9 @@ class Trainer:
                     cb.on_step_end(self, step_now, metrics)
         except PreemptionSaved as e:
             # Clean preemption exit (SURVEY.md §5.3): state is safely on
-            # disk; stop so the scheduler can restart-and-resume.
+            # disk; stop so the scheduler — or an in-process
+            # resilience.Supervisor — can restart-and-resume.
+            self.preempted = True
             self.request_stop(str(e))
         except BaseException:
             self.failed = True

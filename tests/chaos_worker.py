@@ -11,10 +11,19 @@ A and C must produce BIT-IDENTICAL params: the preemption save captured
 the full state exactly, and resume replays exactly the batches the
 straight run would have seen (batch i feeds global step i, i seeded).
 
+With ``--supervise`` the whole run goes through the in-process
+``resilience.Supervisor`` instead: SIGTERM → coordinated save →
+in-process restart, ``--corrupt-at-restart`` truncates the newest
+checkpoint at the restart boundary (fallback restore must quarantine it
+and land on an older valid step), and transient data faults are absorbed
+by a re-seeking ``RetryingIterator`` — one process, every recovery path.
+
 Markers on stdout (the drivers assert on these):
     CHAOS-DONE step=N        run reached the target step
     CHAOS-PREEMPTED step=K   clean PreemptionSaved exit, checkpoint at K
     CHAOS-DATAFAULT saved=K  injected IOError; emergency checkpoint at K
+    CHAOS-SUPERVISED step=N restarts=R finite=F quarantined=Q
+                             supervised run finished; F/Q are 0/1 flags
 """
 
 import argparse
@@ -52,6 +61,83 @@ def global_step_batch(i: int) -> dict:
     }
 
 
+def _supervised(args, mesh, model, tx) -> int:
+    """One supervised run: faults from the CLI become a FaultPlan, every
+    recovery path (retrying data, preemption restart, fallback restore)
+    runs in THIS process under resilience.Supervisor."""
+    import optax  # noqa: F401  (kept symmetric with main's imports)
+
+    from distributed_tensorflow_tpu.data.pipeline import RetryingIterator
+    from distributed_tensorflow_tpu.models import common
+    from distributed_tensorflow_tpu.resilience import (
+        CorruptCheckpoint, FaultPlan, RetryPolicy, Sigterm, Supervisor,
+        SupervisorConfig, TransientIOError,
+    )
+    from distributed_tensorflow_tpu.train import (
+        CheckpointConfig, Checkpointer, StepOptions, Trainer,
+        callbacks as cb, init_or_restore, make_train_step,
+    )
+
+    faults = []
+    if args.sigterm_at is not None:
+        faults.append(Sigterm(args.sigterm_at))
+    if args.transient_io_at is not None:
+        faults.append(TransientIOError(args.transient_io_at, times=2))
+    if args.corrupt_at_restart:
+        faults.append(CorruptCheckpoint(restart=1))
+    plan = FaultPlan(tuple(faults))
+    loss_fn = common.classification_loss_fn(model)
+
+    def batches_from(i0: int):
+        i = i0
+        while True:
+            i += 1
+            yield global_step_batch(i)
+
+    def build(restart_index: int):
+        ckpt = Checkpointer(
+            CheckpointConfig(directory=args.workdir, save_interval_steps=2,
+                             async_save=False, preemption_check_every=1),
+            mesh,
+        )
+        state, specs, _ = init_or_restore(
+            ckpt, common.make_init_fn(model, (8,)), tx, mesh,
+            jax.random.PRNGKey(0), fallback=True,
+        )
+        start = int(state.step)
+        trainer = Trainer(
+            make_train_step(loss_fn, tx, StepOptions()), state, mesh, specs,
+            callbacks=[cb.CheckpointCallback(ckpt), plan.callback()],
+        )
+        data = RetryingIterator(
+            lambda i: plan.wrap(batches_from(i), start=i),
+            RetryPolicy(max_attempts=4, base_s=0.0, jitter=0.0),
+            start_index=start, sleep=lambda s: None,
+        )
+        return trainer, data, ckpt
+
+    sup = Supervisor(
+        build, num_steps=args.steps,
+        cfg=SupervisorConfig(max_restarts=args.max_restarts,
+                             backoff=RetryPolicy(base_s=0.0, jitter=0.0)),
+        on_restart=[plan.restart_hook(args.workdir)],
+        sleep=lambda s: None,
+    )
+    state = sup.run()
+    leaves = [np.asarray(x) for x in
+              jax.tree.leaves(jax.device_get(state.params))]
+    finite = all(np.isfinite(x).all() for x in leaves)
+    quarantined = os.path.isdir(os.path.join(args.workdir, ".corrupt"))
+    if args.out:
+        np.savez(args.out, **{f"p{i}": x for i, x in enumerate(leaves)})
+    print(
+        f"CHAOS-SUPERVISED step={int(state.step)} restarts={sup.restarts} "
+        f"finite={int(finite)} quarantined={int(quarantined)}",
+        flush=True,
+    )
+    return 0 if int(state.step) == args.steps and finite else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("workdir", help="checkpoint directory")
@@ -63,6 +149,16 @@ def main(argv=None) -> int:
                     help="data iterator raises IOError feeding this GLOBAL step")
     ap.add_argument("--out", default=None,
                     help="write final params to this .npz on completion")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run under resilience.Supervisor (in-process "
+                         "restarts, fallback restore, retrying data)")
+    ap.add_argument("--corrupt-at-restart", action="store_true",
+                    help="supervised mode: truncate the newest checkpoint "
+                         "at the first restart boundary")
+    ap.add_argument("--transient-io-at", type=int, default=None,
+                    help="supervised mode: data fetch for this GLOBAL step "
+                         "raises IOError twice, then succeeds")
+    ap.add_argument("--max-restarts", type=int, default=3)
     args = ap.parse_args(argv)
 
     import optax
@@ -80,6 +176,9 @@ def main(argv=None) -> int:
     mesh = build_mesh(MeshSpec(data=-1))
     model = MLP(MLPConfig(hidden_sizes=(16,), num_classes=4))
     tx = optax.adam(1e-2)
+
+    if args.supervise:
+        return _supervised(args, mesh, model, tx)
     ckpt = Checkpointer(
         CheckpointConfig(directory=args.workdir, save_interval_steps=10**6,
                          async_save=False, preemption_check_every=1),

@@ -90,6 +90,41 @@ def test_faulty_iterator_data_error_and_nan_poison():
     assert next(it)["i"] == 4
 
 
+def test_transient_io_fires_n_times_total_across_rewraps():
+    """TransientIOError decays PER PLAN: the remaining-fires count
+    survives re-wrapping the stream (the RetryingIterator re-seek), and
+    a faulted fetch consumes no source batch."""
+
+    def src(start):
+        i = start
+        while True:
+            i += 1
+            yield {"i": i}
+
+    plan = rz.FaultPlan((rz.TransientIOError(batch=2, times=2),))
+    it = plan.wrap(src(0))
+    assert next(it)["i"] == 1  # batch 1: before the fault index
+    with pytest.raises(IOError, match="transient"):
+        next(it)  # fire 1 of 2
+    it2 = plan.wrap(src(1), start=1)  # fresh wrap = the re-seek case
+    with pytest.raises(IOError, match="transient"):
+        next(it2)  # fire 2 of 2
+    it3 = plan.wrap(src(1), start=1)
+    assert next(it3)["i"] == 2  # decayed: the owed batch, nothing lost
+    assert next(it3)["i"] == 3
+
+
+def test_one_shot_faults_fire_once_per_plan_across_seams():
+    """Sigterm/DataError fired-state lives on the plan, so a Supervisor
+    rebuilding the callback list / re-wrapping the data on restart never
+    re-fires a fault that already happened."""
+    plan = rz.FaultPlan((rz.DataError(2),))
+    with pytest.raises(IOError):
+        next(plan.wrap(iter([{"a": 1}, {"a": 2}]), start=1))
+    # a fresh wrap of the same plan does NOT re-fire
+    assert next(plan.wrap(iter([{"a": 2}]), start=1))["a"] == 2
+
+
 def test_clock_stall_fault_via_callback():
     clk = rz.FaultClock()
     fcb = rz.FaultPlan((rz.ClockStall(step=3, dt=120.0),)).callback(clock=clk)
@@ -257,6 +292,193 @@ def test_truncated_shard_rejected_at_restore(mesh8, tmp_path):
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
     with pytest.raises(OSError):
         ckpt.restore(abstract, step=0)
+    ckpt.close()
+
+
+def test_restore_error_names_shard_and_sizes(mesh8, tmp_path):
+    """Satellite: a manifest mismatch must name the offending shard file
+    and its expected-vs-actual size — 'step rejected' alone is
+    undebuggable."""
+    tx = optax.sgd(0.1)
+    ckpt = _checkpointer(mesh8, tmp_path / "msg")
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    assert ckpt.save(0, state, force=True)
+    victim = rz.truncate_shard(str(tmp_path / "msg"), 0, nbytes=3)
+    with pytest.raises(OSError,
+                       match=r"shard .+ is \d+ bytes, manifest says \d+"):
+        ckpt.verify_manifest(0)
+    try:
+        ckpt.verify_manifest(0)
+    except OSError as e:
+        assert os.path.basename(victim) in str(e)  # names THE file
+        assert "+3" in str(e)  # and the byte delta
+    ckpt.close()
+
+
+def test_fallback_restore_chain(mesh8, tmp_path):
+    """Satellite: newest corrupt → fallback restore quarantines it to
+    .corrupt/ and lands on the previous valid step; a subsequent save at
+    the quarantined step number succeeds cleanly."""
+    tx = optax.sgd(0.1)
+    d = tmp_path / "fb"
+    ckpt = _checkpointer(mesh8, d, save_interval_steps=1)
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    trainer = Trainer(
+        make_train_step(linear_loss, tx), state, mesh8, specs,
+        callbacks=[cb.CheckpointCallback(ckpt)],
+    )
+    trainer.fit(batches(3), num_steps=3)  # saves at steps 1, 2, 3
+    ckpt.close()
+    rz.truncate_shard(str(d), 3)
+
+    ckpt2 = _checkpointer(mesh8, d, save_interval_steps=1)
+    state2, specs2, restored = init_or_restore(
+        ckpt2, linear_init, tx, mesh8, jax.random.PRNGKey(0), fallback=True)
+    assert restored and int(state2.step) == 2  # previous valid step wins
+    assert ckpt2.latest_step() == 2
+    qdir = d / ".corrupt" / "3"
+    assert qdir.is_dir()  # quarantined, not deleted, not reused
+    note = (qdir / "QUARANTINE").read_text()
+    assert "shard" in note and "manifest says" in note
+    # re-saving at the quarantined step number starts clean
+    trainer2 = Trainer(
+        make_train_step(linear_loss, tx), state2, mesh8, specs2,
+        callbacks=[cb.CheckpointCallback(ckpt2)],
+    )
+    resumed = trainer2.fit(
+        (make_batch(16, seed=i) for i in range(2, 4)), num_steps=4)
+    assert int(resumed.step) == 4
+    assert ckpt2.verify_manifest(3) is True  # the re-save is intact
+    assert ckpt2.verify_manifest(4) is True
+    ckpt2.close()
+
+
+def test_fallback_restore_all_corrupt_returns_none(mesh8, tmp_path):
+    tx = optax.sgd(0.1)
+    d = tmp_path / "fb2"
+    ckpt = _checkpointer(mesh8, d)
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    for s in (0, 1):
+        assert ckpt.save(s, state if s == 0 else state.replace(step=s),
+                         force=True)
+    rz.truncate_shard(str(d), 0)
+    rz.truncate_shard(str(d), 1)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+    assert ckpt.restore(abstract, fallback=True) is None
+    assert ckpt.latest_step() is None
+    assert sorted(p.name for p in (d / ".corrupt").iterdir()) == ["0", "1"]
+    # non-fallback restore of a corrupt step still raises loudly
+    ckpt.close()
+
+
+def test_fallback_transient_verify_blip_does_not_quarantine(mesh8, tmp_path,
+                                                            monkeypatch):
+    """Quarantine is destructive, so a transient FS error during the
+    integrity check must be retried away — never condemn a good newest
+    step over a blip."""
+    from distributed_tensorflow_tpu.runtime import io as io_lib
+
+    tx = optax.sgd(0.1)
+    d = tmp_path / "blip"
+    ckpt = _checkpointer(mesh8, d)
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    assert ckpt.save(0, state, force=True)
+    ckpt.close()
+
+    real = io_lib.read_payload
+    fails = {"n": 1}
+
+    def flaky(path):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("transient stale handle")
+        return real(path)
+
+    monkeypatch.setattr(io_lib, "read_payload", flaky)
+    reg = Registry()
+    ckpt2 = Checkpointer(
+        CheckpointConfig(directory=str(d), async_save=False,
+                         save_on_preemption=False),
+        mesh8, registry=reg,
+        io_retry=rz.RetryPolicy(max_attempts=3, base_s=0.0, jitter=0.0))
+    state2, specs2, restored = init_or_restore(
+        ckpt2, linear_init, tx, mesh8, jax.random.PRNGKey(0), fallback=True)
+    assert restored  # the good step survived the blip
+    assert not (d / ".corrupt").exists()
+    assert reg.get("retry_attempts_total", site="ckpt_verify").value == 1.0
+    ckpt2.close()
+
+
+def test_fallback_walks_past_restore_time_failure(mesh8, tmp_path,
+                                                  monkeypatch):
+    """A step that verifies but fails at READ time (e.g. shards committed,
+    manifest never stamped, bytes unreadable) must be quarantined and the
+    walk continue to an older valid step — not escape fallback raw."""
+    tx = optax.sgd(0.1)
+    d = tmp_path / "rt"
+    ckpt = _checkpointer(mesh8, d, save_interval_steps=1)
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    trainer = Trainer(
+        make_train_step(linear_loss, tx), state, mesh8, specs,
+        callbacks=[cb.CheckpointCallback(ckpt)])
+    trainer.fit(batches(2), num_steps=2)  # saves steps 1, 2
+    ckpt.close()
+
+    ckpt2 = _checkpointer(mesh8, d, save_interval_steps=1)
+    ckpt2.io_retry = rz.RetryPolicy(max_attempts=2, base_s=0.0, jitter=0.0)
+    real = ckpt2.manager.restore
+
+    def flaky(step, args=None):
+        if step == 2:
+            raise OSError("unreadable shard bytes")
+        return real(step, args=args)
+
+    monkeypatch.setattr(ckpt2.manager, "restore", flaky)
+    state2, specs2, restored = init_or_restore(
+        ckpt2, linear_init, tx, mesh8, jax.random.PRNGKey(0), fallback=True)
+    assert restored and int(state2.step) == 1  # fell back past step 2
+    assert (d / ".corrupt" / "2").is_dir()
+    ckpt2.close()
+
+
+def test_checkpoint_manifest_write_retries_transient(mesh8, tmp_path,
+                                                     monkeypatch):
+    """The ckpt_manifest_write retry seam: a write that fails twice with
+    OSError still produces an intact manifest, and the obs counters
+    account for the re-attempts."""
+    from distributed_tensorflow_tpu.runtime import io as io_lib
+
+    reg = Registry()
+    real = io_lib.write_payload
+    fails = {"n": 2}
+
+    def flaky(path, data):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("injected transient write fault")
+        return real(path, data)
+
+    monkeypatch.setattr(io_lib, "write_payload", flaky)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "rw"), async_save=False,
+                         save_on_preemption=False),
+        mesh8, registry=reg,
+        io_retry=rz.RetryPolicy(max_attempts=4, base_s=0.0, jitter=0.0),
+    )
+    tx = optax.sgd(0.1)
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0))
+    assert ckpt.save(0, state, force=True)
+    assert ckpt.verify_manifest(0) is True
+    assert reg.get("retry_attempts_total",
+                   site="ckpt_manifest_write").value == 2.0
+    assert reg.total("retry_exhausted_total") == 0.0
     ckpt.close()
 
 

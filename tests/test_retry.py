@@ -1,0 +1,263 @@
+"""Retry/backoff layer (resilience/retry.py) and the re-seeking
+RetryingIterator (data/pipeline.py): budget semantics, deterministic
+seeded jitter, the obs counters, and exhaustion classification — all
+device-free."""
+
+import time
+
+import pytest
+
+from distributed_tensorflow_tpu import resilience as rz
+from distributed_tensorflow_tpu.data.pipeline import RetryingIterator
+from distributed_tensorflow_tpu.obs.registry import Registry
+from distributed_tensorflow_tpu.resilience.retry import retry_call
+
+
+def _noop_sleep(_):  # tests never really wait
+    pass
+
+
+def _fast(**kw):
+    base = dict(max_attempts=3, base_s=0.0, jitter=0.0)
+    base.update(kw)
+    return rz.RetryPolicy(**base)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        rz.RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        rz.RetryPolicy(multiplier=0.5)
+    with pytest.raises(ValueError):
+        rz.RetryPolicy(jitter=1.5)
+    with pytest.raises(ValueError):
+        rz.RetryPolicy(base_s=-1.0)
+
+
+def test_backoff_escalates_caps_and_is_deterministic():
+    p = rz.RetryPolicy(base_s=1.0, multiplier=2.0, max_backoff_s=4.0,
+                       jitter=0.5, seed=3)
+    for i in range(6):
+        d = p.backoff_s(i)
+        raw = min(1.0 * 2.0 ** i, 4.0)
+        assert raw * 0.5 <= d <= raw  # jitter only shrinks, within bound
+        assert d == p.backoff_s(i)  # same (seed, index) → same delay
+    # a different seed jitters differently somewhere in the schedule
+    q = rz.RetryPolicy(base_s=1.0, multiplier=2.0, max_backoff_s=4.0,
+                       jitter=0.5, seed=4)
+    assert any(p.backoff_s(i) != q.backoff_s(i) for i in range(6))
+    # jitter=0 → exact exponential schedule
+    z = rz.RetryPolicy(base_s=1.0, multiplier=2.0, max_backoff_s=40.0,
+                       jitter=0.0)
+    assert [z.backoff_s(i) for i in range(3)] == [1.0, 2.0, 4.0]
+
+
+# ---------------------------------------------------------------------------
+# retry_call
+# ---------------------------------------------------------------------------
+
+
+def test_retry_call_absorbs_transient_and_counts():
+    reg = Registry()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise IOError("transient")
+        return "ok"
+
+    slept = []
+    out = retry_call(flaky, policy=_fast(max_attempts=5, base_s=0.25),
+                     site="t", registry=reg, sleep=slept.append)
+    assert out == "ok" and calls["n"] == 3
+    assert reg.get("retry_attempts_total", site="t").value == 2
+    assert reg.get("retry_exhausted_total", site="t").value == 0
+    assert slept == [0.25, 0.5]  # escalating, jitter=0
+
+
+def test_retry_call_exhausts_attempt_budget():
+    reg = Registry()
+
+    def always():
+        raise IOError("permanent")
+
+    with pytest.raises(rz.RetryExhausted) as ei:
+        retry_call(always, policy=_fast(max_attempts=3), site="t",
+                   registry=reg, sleep=_noop_sleep)
+    assert ei.value.site == "t" and ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, IOError)  # what actually failed
+    assert "t" in str(ei.value) and "3" in str(ei.value)
+    assert reg.get("retry_attempts_total", site="t").value == 2
+    assert reg.get("retry_exhausted_total", site="t").value == 1
+
+
+def test_retry_call_total_deadline():
+    reg = Registry()
+    clk = rz.FaultClock()
+
+    def always():
+        clk.advance(10.0)  # each attempt burns fake wall time
+        raise IOError("slow and broken")
+
+    with pytest.raises(rz.RetryExhausted) as ei:
+        retry_call(
+            always,
+            policy=_fast(max_attempts=100, base_s=1.0, deadline_s=25.0),
+            site="dl", registry=reg, clock=clk, sleep=clk.advance,
+        )
+    assert ei.value.reason == "total deadline"
+    assert ei.value.attempts < 100  # the clock, not the count, gave up
+    assert reg.get("retry_exhausted_total", site="dl").value == 1
+
+
+def test_retry_call_non_retryable_passes_through():
+    reg = Registry()
+
+    def bug():
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        retry_call(bug, policy=_fast(), site="t", registry=reg,
+                   sleep=_noop_sleep)
+    assert reg.get("retry_attempts_total", site="t").value == 0
+    assert reg.get("retry_exhausted_total", site="t").value == 0
+
+
+def test_retry_call_attempt_timeout():
+    reg = Registry()
+
+    def hangs():
+        time.sleep(5.0)
+
+    with pytest.raises(rz.RetryExhausted) as ei:
+        retry_call(
+            hangs,
+            policy=_fast(max_attempts=2, attempt_timeout_s=0.05),
+            site="hang", registry=reg, sleep=_noop_sleep,
+        )
+    assert isinstance(ei.value.__cause__, rz.AttemptTimeout)
+    assert reg.get("retry_exhausted_total", site="hang").value == 1
+
+
+def test_retry_call_on_retry_failure_obeys_budget():
+    """A hook (re-seek) that hits the same outage as the attempt counts
+    against the budget and surfaces as RetryExhausted — never escapes
+    retry_call raw."""
+    reg = Registry()
+
+    def always():
+        raise IOError("fetch down")
+
+    def broken_reseek(n, e):
+        raise IOError("reopen down too")
+
+    with pytest.raises(rz.RetryExhausted) as ei:
+        retry_call(always, policy=_fast(max_attempts=3), site="rk",
+                   registry=reg, sleep=_noop_sleep, on_retry=broken_reseek)
+    assert isinstance(ei.value.__cause__, IOError)
+    assert reg.get("retry_exhausted_total", site="rk").value == 1
+
+
+def test_retry_call_on_retry_hook_runs_between_attempts():
+    seen = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise IOError("once")
+        return calls["n"]
+
+    out = retry_call(flaky, policy=_fast(), site="h", registry=Registry(),
+                     sleep=_noop_sleep,
+                     on_retry=lambda n, e: seen.append((n, str(e))))
+    assert out == 2 and seen == [(1, "once")]
+
+
+# ---------------------------------------------------------------------------
+# RetryingIterator: re-seek via the deterministic (seed, index) scheme
+# ---------------------------------------------------------------------------
+
+
+def _counting_stream(start):
+    i = start
+    while True:
+        i += 1
+        yield {"i": i}
+
+
+def test_retrying_iterator_absorbs_transient_reseek():
+    reg = Registry()
+    plan = rz.FaultPlan((rz.TransientIOError(batch=3, times=2),))
+    it = RetryingIterator(
+        lambda i: plan.wrap(_counting_stream(i), start=i),
+        _fast(max_attempts=5), registry=reg, sleep=_noop_sleep,
+    )
+    # the faulted fetch loses no data: the stream re-seeks to index 3
+    assert [next(it)["i"] for _ in range(5)] == [1, 2, 3, 4, 5]
+    assert it.index == 5
+    assert reg.get("retry_attempts_total", site="data").value == 2
+    assert reg.get("retry_exhausted_total", site="data").value == 0
+
+
+def test_retrying_iterator_exhausts_on_permanent_fault():
+    reg = Registry()
+    plan = rz.FaultPlan((rz.TransientIOError(batch=2, times=10 ** 9),))
+    it = RetryingIterator(
+        lambda i: plan.wrap(_counting_stream(i), start=i),
+        _fast(max_attempts=3), registry=reg, sleep=_noop_sleep,
+    )
+    assert next(it)["i"] == 1
+    with pytest.raises(rz.RetryExhausted) as ei:
+        next(it)
+    assert ei.value.site == "data"
+    assert isinstance(ei.value.__cause__, IOError)
+    assert reg.get("retry_exhausted_total", site="data").value == 1
+    # exhaustion classifies as transient for the Supervisor
+    assert rz.classify_failure(ei.value) == rz.TRANSIENT
+
+
+def test_retrying_iterator_finite_stream_ends_cleanly():
+    def bounded(i):
+        return iter([{"i": j} for j in range(i + 1, 4)])
+
+    it = RetryingIterator(bounded, _fast(), registry=Registry(),
+                          sleep=_noop_sleep)
+    assert [b["i"] for b in it] == [1, 2, 3]
+
+
+def test_retrying_iterator_resume_from_offset():
+    """start_index positions the stream mid-run (checkpoint resume), and
+    batch-indexed faults stay aligned with the GLOBAL index."""
+    reg = Registry()
+    plan = rz.FaultPlan((rz.TransientIOError(batch=2, times=1),))
+    it = RetryingIterator(
+        lambda i: plan.wrap(_counting_stream(i), start=i),
+        _fast(), start_index=5, registry=reg, sleep=_noop_sleep,
+    )
+    # batches 6, 7: past the batch-2 fault index, but count>=batch means
+    # the pending transient still fires once before decaying
+    assert [next(it)["i"] for _ in range(2)] == [6, 7]
+    assert reg.get("retry_attempts_total", site="data").value == 1
+
+
+# ---------------------------------------------------------------------------
+# seeded plans with the new kinds
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_plan_new_kinds_deterministic():
+    kinds = ("sigterm", "transient_io", "ckpt_corrupt")
+    a = rz.FaultPlan.seeded(7, 20, kinds=kinds)
+    b = rz.FaultPlan.seeded(7, 20, kinds=kinds)
+    assert a == b
+    assert a != rz.FaultPlan.seeded(8, 20, kinds=kinds)
+    assert isinstance(a.faults[1], rz.TransientIOError)
+    assert 1 <= a.faults[1].times <= 2
+    assert isinstance(a.faults[2], rz.CorruptCheckpoint)

@@ -1,0 +1,331 @@
+"""In-process Supervisor (resilience/supervisor.py): failure
+classification, restart budget, fallback restore at restart boundaries,
+the reproducible-recovery acceptance gate, and the telemetry
+merge-not-reset invariant across restarts."""
+
+import signal
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu import resilience as rz
+from distributed_tensorflow_tpu.data.pipeline import RetryingIterator
+from distributed_tensorflow_tpu.obs.registry import Registry
+from distributed_tensorflow_tpu.train import (
+    CheckpointConfig,
+    Checkpointer,
+    Trainer,
+    callbacks as cb,
+    init_or_restore,
+    make_train_step,
+)
+
+from test_step import linear_init, linear_loss, make_batch
+
+
+def _global_batch(i):
+    """The batch feeding GLOBAL step i — pure function of i, so resumed
+    attempts replay exactly what the straight run would have seen."""
+    return make_batch(16, seed=1000 + i)
+
+
+def _batches_from(i0):
+    i = i0
+    while True:
+        i += 1
+        yield _global_batch(i)
+
+
+def _fast_cfg(**kw):
+    base = dict(backoff=rz.RetryPolicy(base_s=0.0, jitter=0.0))
+    base.update(kw)
+    return rz.SupervisorConfig(**base)
+
+
+def _builder(workdir, mesh, plan, registry, *, tx, save_every=1,
+             retry_policy=None, extra_cbs=lambda: [], starts=None):
+    """A production-shaped attempt builder: fresh Checkpointer (fresh
+    signal watcher), fallback restore, Trainer + fault seams, data
+    re-seekable at the restored step."""
+
+    def build(restart_index):
+        ckpt = Checkpointer(
+            CheckpointConfig(directory=str(workdir),
+                             save_interval_steps=save_every,
+                             async_save=False, save_on_preemption=True,
+                             preemption_check_every=1),
+            mesh, registry=registry,
+        )
+        state, specs, _ = init_or_restore(
+            ckpt, linear_init, tx, mesh, jax.random.PRNGKey(0),
+            fallback=True,
+        )
+        start = int(state.step)
+        if starts is not None:
+            starts.append(start)
+        # observers (telemetry) go FIRST: maybe_save raises
+        # PreemptionSaved from CheckpointCallback.on_step_end, which
+        # skips every later callback for that step — a sink placed after
+        # it would miss the final, checkpointed step of the attempt
+        trainer = Trainer(
+            make_train_step(linear_loss, tx), state, mesh, specs,
+            callbacks=extra_cbs()
+            + [cb.CheckpointCallback(ckpt), plan.callback()],
+        )
+        data = RetryingIterator(
+            lambda i: plan.wrap(_batches_from(i), start=i),
+            retry_policy or rz.RetryPolicy(max_attempts=4, base_s=0.0,
+                                           jitter=0.0),
+            start_index=start, registry=registry, sleep=lambda s: None,
+        )
+        return trainer, data, ckpt
+
+    return build
+
+
+def _params(state):
+    return [np.asarray(x) for x in
+            jax.tree.leaves(jax.device_get(state.params))]
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+
+def test_classify_failure():
+    assert rz.classify_failure(IOError("io")) == rz.TRANSIENT
+    assert rz.classify_failure(TimeoutError("t")) == rz.TRANSIENT
+    assert rz.classify_failure(FloatingPointError("nan")) == rz.POISONED
+    assert rz.classify_failure(ValueError("bug")) == rz.FATAL
+    assert rz.classify_failure(KeyboardInterrupt()) == rz.FATAL
+    ex = rz.RetryExhausted("s", 3, "attempt budget", IOError("x"))
+    ex.__cause__ = IOError("x")
+    assert rz.classify_failure(ex) == rz.TRANSIENT
+    with pytest.raises(ValueError):
+        rz.SupervisorConfig(restart_on=("meteor",))
+
+
+# ---------------------------------------------------------------------------
+# restart paths
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_restarts_after_preemption(mesh8, tmp_path):
+    orig = signal.getsignal(signal.SIGTERM)
+    try:
+        reg = Registry()
+        plan = rz.FaultPlan((rz.Sigterm(3),))
+        starts = []
+        sup = rz.Supervisor(
+            _builder(tmp_path / "p", mesh8, plan, reg, tx=optax.sgd(0.1),
+                     save_every=2, starts=starts),
+            num_steps=8, cfg=_fast_cfg(), registry=reg,
+            sleep=lambda s: None,
+        )
+        state = sup.run()
+        assert int(state.step) == 8
+        assert sup.restarts == 1
+        # SIGTERM after step 3 → coordinated save at 4 → resume from 4
+        assert starts == [0, 4]
+        assert reg.get("supervisor_restarts_total",
+                       cause="preemption").value == 1.0
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+def test_supervisor_fatal_passes_through_no_restart(mesh8, tmp_path):
+    reg = Registry()
+
+    class Boom(cb.Callback):
+        def on_step_end(self, trainer, step, metrics):
+            if step == 2:
+                raise ValueError("a bug, not the weather")
+
+    sup = rz.Supervisor(
+        _builder(tmp_path / "f", mesh8, rz.FaultPlan(), reg,
+                 tx=optax.sgd(0.1), extra_cbs=lambda: [Boom()]),
+        num_steps=8, cfg=_fast_cfg(), registry=reg, sleep=lambda s: None,
+    )
+    with pytest.raises(ValueError, match="a bug"):
+        sup.run()
+    assert sup.restarts == 0
+    assert reg.total("supervisor_restarts_total") == 0.0
+
+
+def test_supervisor_transient_build_failure_earns_restart(mesh8, tmp_path):
+    """A transient failure during attempt CONSTRUCTION (e.g. restore-time
+    IO) is classified and restarted like one during fit — build runs
+    inside the supervised attempt."""
+    reg = Registry()
+    flaky = {"n": 1}
+    inner = _builder(tmp_path / "b", mesh8, rz.FaultPlan(), reg,
+                     tx=optax.sgd(0.1), save_every=2)
+
+    def build(restart_index):
+        if flaky["n"] > 0:
+            flaky["n"] -= 1
+            raise IOError("restore-time blip")
+        return inner(restart_index)
+
+    sup = rz.Supervisor(build, num_steps=4, cfg=_fast_cfg(), registry=reg,
+                        sleep=lambda s: None)
+    state = sup.run()
+    assert int(state.step) == 4
+    assert sup.restarts == 1
+    assert reg.get("supervisor_restarts_total",
+                   cause="transient").value == 1.0
+
+
+def test_supervisor_retry_exhausted_classified_and_counted(mesh8, tmp_path):
+    """Acceptance gate, exhaustion half: a permanent IO fault exhausts
+    the data retry budget in every attempt, the supervisor restarts it
+    as `transient` until ITS budget exhausts, and the counters account
+    for every give-up exactly."""
+    reg = Registry()
+    plan = rz.FaultPlan((rz.TransientIOError(batch=3, times=10 ** 9),))
+    sup = rz.Supervisor(
+        _builder(tmp_path / "x", mesh8, plan, reg, tx=optax.sgd(0.1),
+                 save_every=2,
+                 retry_policy=rz.RetryPolicy(max_attempts=3, base_s=0.0,
+                                             jitter=0.0)),
+        num_steps=8, cfg=_fast_cfg(max_restarts=2), registry=reg,
+        sleep=lambda s: None,
+    )
+    with pytest.raises(rz.SupervisorExhausted) as ei:
+        sup.run()
+    assert ei.value.cause == rz.TRANSIENT
+    assert ei.value.restarts == 2
+    assert isinstance(ei.value.__cause__, rz.RetryExhausted)
+    # 3 attempts (1 + 2 restarts), each exhausting one data retry budget
+    assert reg.get("retry_exhausted_total", site="data").value == 3.0
+    # each attempt burned max_attempts-1 = 2 re-seeks
+    assert reg.get("retry_attempts_total", site="data").value == 6.0
+    assert reg.get("supervisor_restarts_total",
+                   cause="transient").value == 2.0
+
+
+def test_supervisor_transient_hook_failure_earns_restart(mesh8, tmp_path):
+    """An on_restart hook that hits transient IO at the restart boundary
+    is classified and restarted like any attempt failure — and re-runs
+    on the next attempt (hooks must be idempotent)."""
+    orig = signal.getsignal(signal.SIGTERM)
+    try:
+        reg = Registry()
+        plan = rz.FaultPlan((rz.Sigterm(3),))
+        hook_calls = []
+
+        def flaky_hook(restart_index, cause):
+            hook_calls.append((restart_index, cause))
+            if len(hook_calls) == 1:
+                raise IOError("boundary disk blip")
+
+        sup = rz.Supervisor(
+            _builder(tmp_path / "h", mesh8, plan, reg, tx=optax.sgd(0.1),
+                     save_every=2),
+            num_steps=8, cfg=_fast_cfg(), registry=reg,
+            on_restart=[flaky_hook], sleep=lambda s: None,
+        )
+        state = sup.run()
+        assert int(state.step) == 8
+        # restart 1: preemption; its hook raised -> restart 2: transient;
+        # the hook re-ran (idempotently) and the run completed
+        assert sup.restarts == 2
+        assert hook_calls == [(1, "preemption"), (2, "transient")]
+        assert reg.get("supervisor_restarts_total",
+                       cause="preemption").value == 1.0
+        assert reg.get("supervisor_restarts_total",
+                       cause="transient").value == 1.0
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance criterion: seeded multi-fault recovery, bit-identical
+# ---------------------------------------------------------------------------
+
+
+def _seeded_recovery_run(workdir, mesh, seed, registry):
+    # seed 1 at 10 steps places: TransientIOError(batch=3, times=2)
+    # (absorbed mid-attempt by the re-seeking iterator), Sigterm(step=4)
+    # (preemption save at 5, in-process restart), CorruptCheckpoint at
+    # the restart boundary (fallback restore must quarantine the newest
+    # step and land on an older valid one).
+    plan = rz.FaultPlan.seeded(
+        seed, 10, kinds=("sigterm", "transient_io", "ckpt_corrupt"))
+    sup = rz.Supervisor(
+        _builder(workdir, mesh, plan, registry, tx=optax.adam(1e-2),
+                 save_every=1),
+        num_steps=10, cfg=_fast_cfg(max_restarts=4), registry=registry,
+        on_restart=[plan.restart_hook(str(workdir))],
+        sleep=lambda s: None,
+    )
+    return sup.run(), sup
+
+
+def test_supervisor_seeded_recovery_bit_identical(mesh8, tmp_path):
+    orig = signal.getsignal(signal.SIGTERM)
+    try:
+        reg_a, reg_b = Registry(), Registry()
+        state_a, sup_a = _seeded_recovery_run(tmp_path / "a", mesh8, 1, reg_a)
+        state_b, sup_b = _seeded_recovery_run(tmp_path / "b", mesh8, 1, reg_b)
+        assert int(state_a.step) == int(state_b.step) == 10
+        assert sup_a.restarts == sup_b.restarts == 1
+        # the corrupt newest checkpoint was quarantined, not reused
+        assert (tmp_path / "a" / ".corrupt").is_dir()
+        assert (tmp_path / "b" / ".corrupt").is_dir()
+        # the transient data fault was absorbed by re-seek, not a restart
+        assert reg_a.get("retry_attempts_total", site="data").value == 2.0
+        assert reg_a.get("retry_exhausted_total", site="data").value == 0.0
+        # the corrupt newest step IS one exhausted verify budget — real
+        # corruption survives the transient-blip retries, then quarantines
+        assert reg_a.get("retry_exhausted_total",
+                         site="ckpt_verify").value == 1.0
+        # recovery is exactly reproducible: params BIT-identical
+        pa, pb = _params(state_a), _params(state_b)
+        assert len(pa) == len(pb) and pa
+        for a, b in zip(pa, pb):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+# ---------------------------------------------------------------------------
+# telemetry invariant across restarts (registry merges, never resets)
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_accumulates_across_supervised_restarts(mesh8, tmp_path):
+    orig = signal.getsignal(signal.SIGTERM)
+    try:
+        reg = Registry()
+        plan = rz.FaultPlan((rz.Sigterm(3),))
+        sup = rz.Supervisor(
+            _builder(
+                tmp_path / "t", mesh8, plan, reg, tx=optax.sgd(0.1),
+                save_every=2,
+                extra_cbs=lambda: [cb.TelemetryCallback(registry=reg,
+                                                        every_n=100)],
+            ),
+            num_steps=8, cfg=_fast_cfg(), registry=reg,
+            sleep=lambda s: None,
+        )
+        state = sup.run()
+        assert int(state.step) == 8 and sup.restarts == 1
+        attempts = sup.restarts + 1
+        # attempt 0 executed steps 1..4, attempt 1 resumed 4 → 5..8:
+        # every completed step ticked the counter exactly once — the
+        # PR 3 invariant holds ACROSS the restart boundary because the
+        # shared registry merges; a reset would drop attempt 0's 4 steps
+        steps_total = reg.get("train_steps_total").value
+        assert steps_total == 8.0
+        # the per-step latency histogram observes every step except the
+        # first of each attempt (no previous dispatch to measure from)
+        hist = reg.get("train_step_seconds")
+        assert hist.count == steps_total - attempts
+        assert reg.get("supervisor_restarts_total",
+                       cause="preemption").value == 1.0
+    finally:
+        signal.signal(signal.SIGTERM, orig)

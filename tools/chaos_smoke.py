@@ -1,9 +1,9 @@
 #!/usr/bin/env python
 """Fast chaos smoke — the resilience gates quick enough for tools/ci_fast.sh.
 
-Two stages (full coverage lives in tests/test_resilience.py and
-tests/test_serve.py; this is the canary that the recovery machinery is
-wired at all):
+Three stages (full coverage lives in tests/test_resilience.py,
+tests/test_supervisor.py and tests/test_serve.py; this is the canary
+that the recovery machinery is wired at all):
 
 1. **Scheduler admission invariants** (pure host, no device work):
    bounded-queue backpressure raises QueueFull, deadlines evict with
@@ -13,6 +13,11 @@ wired at all):
    subprocesses): a tiny train run SIGTERMs itself mid-run, exits via
    the coordinated preemption save, and a fresh process restores and
    finishes at the target step.
+3. **One supervised recovery round** (one chaos_worker subprocess,
+   --supervise): SIGTERM *and* a truncated-newest-checkpoint in the same
+   run — the in-process Supervisor restarts, fallback restore
+   quarantines the corrupt step and lands on an older valid one, and the
+   run must still finish at the target step with finite params.
 
 Usage: JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 """
@@ -62,33 +67,51 @@ def scheduler_invariants() -> None:
     print("chaos_smoke: scheduler admission invariants OK")
 
 
-def sigterm_resume_round() -> None:
+def _run_worker(*args):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
-
-    def run(*args):
-        p = subprocess.run(
-            [sys.executable, WORKER, *args],
-            capture_output=True, text=True, timeout=240, env=env,
+    p = subprocess.run(
+        [sys.executable, WORKER, *args],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    if p.returncode != 0:
+        raise AssertionError(
+            f"chaos worker rc={p.returncode}:\n{p.stdout}\n{p.stderr}"
         )
-        if p.returncode != 0:
-            raise AssertionError(
-                f"chaos worker rc={p.returncode}:\n{p.stdout}\n{p.stderr}"
-            )
-        return p.stdout
+    return p.stdout
 
+
+def sigterm_resume_round() -> None:
     with tempfile.TemporaryDirectory(prefix="chaos_smoke_") as d:
-        out = run(os.path.join(d, "ckpt"), "--steps", "6", "--sigterm-at", "2")
+        out = _run_worker(os.path.join(d, "ckpt"), "--steps", "6",
+                          "--sigterm-at", "2")
         assert "CHAOS-PREEMPTED step=3" in out, out
-        out = run(os.path.join(d, "ckpt"), "--steps", "6")
+        out = _run_worker(os.path.join(d, "ckpt"), "--steps", "6")
         assert "CHAOS-DONE step=6" in out, out
     print("chaos_smoke: SIGTERM -> coordinated save -> resume OK")
+
+
+def supervised_recovery_round() -> None:
+    """SIGTERM + truncated-newest-checkpoint in ONE supervised run: the
+    Supervisor must restart in process, quarantine the corrupt newest
+    step, fall back to an older valid one, and finish with finite
+    params."""
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke_sup_") as d:
+        out = _run_worker(os.path.join(d, "ckpt"), "--supervise",
+                          "--steps", "8", "--sigterm-at", "3",
+                          "--corrupt-at-restart")
+        assert "CHAOS-SUPERVISED step=8" in out, out
+        assert "finite=1" in out and "quarantined=1" in out, out
+        assert "restarts=1" in out, out
+    print("chaos_smoke: supervised SIGTERM + corrupt-newest -> "
+          "fallback restore -> finish OK")
 
 
 def main() -> int:
     scheduler_invariants()
     sigterm_resume_round()
+    supervised_recovery_round()
     return 0
 
 

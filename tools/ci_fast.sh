@@ -7,7 +7,9 @@
 #      exposition render → format lint → JSONL round-trip (ISSUE 2)
 #   3. tools/chaos_smoke.py    — resilience smoke: scheduler
 #      timeout/cancel/backpressure invariants + one SIGTERM →
-#      coordinated-save → resume subprocess round (ISSUE 3)
+#      coordinated-save → resume subprocess round (ISSUE 3) + one
+#      supervised SIGTERM + corrupt-newest-checkpoint run that must
+#      recover via fallback restore and finish finite (ISSUE 4)
 #
 # Usage: tools/ci_fast.sh   (extra args are passed to smoke_collect)
 set -euo pipefail
