@@ -293,11 +293,17 @@ def main() -> None:
     log(f"pipeline-fed: steps/sec={fed_steps_per_sec:.3f} "
         f"({pipeline_efficiency:.1%} of resident-batch)")
     # flops_per_example is fwd-only (framework contract, utils/flops.py);
-    # training MFU applies the fwd+bwd multiplier exactly here.
-    model_flops = (flops_per_example(cfg, image) * global_batch
-                   * flops_lib.train_flops_multiplier())
+    # the SHARED helper obs/goodput.train_mfu applies the fwd+bwd
+    # multiplier and publishes the `mfu` gauge into the process registry,
+    # so this JSON line and a scrape can never disagree.
+    from distributed_tensorflow_tpu.obs import goodput
+    from distributed_tensorflow_tpu.obs.registry import default_registry
+
     peak = flops_lib.peak_flops_per_chip(devices[0])
-    mfu = flops_lib.mfu(model_flops, steps_per_sec, n_chips, peak)
+    mfu = goodput.train_mfu(
+        flops_per_example(cfg, image) * global_batch, steps_per_sec,
+        n_chips=n_chips, peak_per_chip=peak, registry=default_registry(),
+    )
     log(f"steps/sec={steps_per_sec:.3f} images/sec/chip={images_per_sec_per_chip:.1f} "
         f"MFU={mfu:.3f} (peak={peak:.3g})")
 

@@ -11,8 +11,15 @@ resilience/supervisor.py's ``supervisor_restarts_total{cause}``) record
 into a Registry; obs/export.py renders Prometheus text exposition or
 appends JSONL events, chief-gated. Registries MERGE across supervised
 restarts (never reset), so counters stay exact over attempt boundaries;
-``Registry.total`` sums a labeled family for invariant checks. See
-docs/observability.md.
+``Registry.total`` sums a labeled family for invariant checks.
+
+Two layers answer the questions counters can't: obs/flightrec.py is the
+bounded ring of causal events (what happened, in what order — dumped as
+a JSONL postmortem on abnormal exits, rendered by tools/postmortem.py)
+and obs/goodput.py is the wall-clock ledger (productive-step vs
+compile-warmup/retry-backoff/restart-recovery buckets, the
+``goodput_fraction``/``mfu`` gauges, and the one shared MFU/percentile
+arithmetic). See docs/observability.md.
 """
 
 from .registry import (  # noqa: F401
@@ -26,3 +33,11 @@ from .registry import (  # noqa: F401
 )
 from .trace import Span, Tracer, default_tracer, span  # noqa: F401
 from .export import JsonlLogger, render, serve_http  # noqa: F401
+from .flightrec import (  # noqa: F401
+    EVENT_KINDS,
+    FlightRecorder,
+    contains_in_order,
+    default_recorder,
+    validate_dump,
+)
+from . import goodput  # noqa: F401

@@ -1,0 +1,285 @@
+"""Flight recorder — a bounded ring buffer of structured run events.
+
+The reference's MonitoredTrainingSession assumed an operator could
+answer "what was the job doing when it died?" from scattered logs; the
+metrics registry (obs/registry.py) answers "how much" but not "in what
+order". This module is the causal record: every layer that already has
+a seam — the train loop, the checkpoint manager, the retry executor,
+the Supervisor, the fault harness, the serve scheduler — emits a small
+structured event (monotonic timestamp, kind, step, attrs) into one
+process-wide ring. The ring is bounded (old events are dropped, counted)
+so a week-long run costs the same memory as a smoke test, and
+lock-protected so the watchdog poll thread, async manifest stampers, and
+the train loop can emit concurrently.
+
+On any abnormal exit — emergency checkpoint, ``SupervisorExhausted``,
+an unhandled ``fit`` exception — the owning layer dumps the ring as a
+JSONL postmortem into the run directory; ``tools/postmortem.py`` renders
+it as a human-readable causal timeline ("fault fired → emergency
+checkpoint → restart → fallback restore"), and ``validate_dump`` is the
+schema gate shared by ``tools/obs_check.py`` and CI.
+
+The event vocabulary is CLOSED (``EVENT_KINDS``): ``emit`` rejects
+unknown kinds, so a new emitter must extend the vocabulary here — which
+is exactly what keeps the postmortem renderer, the dump validator, and
+the docs event table in sync.
+
+Nothing here imports jax — plain stdlib, usable from the scheduler's
+pure-host tests and from tools that never touch a device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterable, Mapping, Sequence
+
+__all__ = [
+    "EVENT_KINDS",
+    "SCHEMA",
+    "FlightRecorder",
+    "default_recorder",
+    "validate_dump",
+    "contains_in_order",
+]
+
+#: dump header schema tag — bump when the record layout changes
+SCHEMA = "dtf-flightrec-1"
+
+#: the closed event vocabulary (docs/observability.md has the table)
+EVENT_KINDS = (
+    # train loop (train/loop.py) + host callbacks (train/callbacks.py)
+    "train_start",          # fit() entered                 {step}
+    "step_start",           # step dispatch begins          {step}
+    "step_end",             # step + callbacks done         {step}
+    "train_stop",           # fit() returned                {step, reason}
+    "train_exception",      # unhandled step exception      {step, error, etype}
+    "emergency_checkpoint", # best-effort crash save        {step, saved}
+    "watchdog_stall",       # no step within the budget     {overdue_s, budget_s}
+    # checkpoint lifecycle (train/checkpoint.py)
+    "ckpt_save",            # checkpoint written            {step, trigger}
+    "ckpt_restore",         # state restored                {step, fallback}
+    "ckpt_quarantine",      # corrupt step condemned        {step, note}
+    # retry/backoff (resilience/retry.py)
+    "retry_attempt",        # re-attempt after a failure    {site, failures}
+    "retry_exhausted",      # budget ran out                {site, failures, reason}
+    # supervision (resilience/supervisor.py)
+    "sup_attempt",          # supervised attempt begins     {attempt}
+    "sup_failure",          # attempt died, classified      {attempt, cause, error}
+    "sup_restart",          # restart granted               {restart, cause, backoff_s}
+    "sup_exhausted",        # restart budget ran out        {cause, restarts}
+    # fault injection (resilience/faults.py)
+    "fault_fired",          # a planned fault fired         {fault, step, ...}
+    # serving (serve/scheduler.py, serve/engine.py)
+    "serve_admit",          # request placed into a slot    {uid, slot}
+    "serve_evict",          # request left (any reason)     {uid, reason}
+    "serve_drain",          # engine graceful shutdown      {finished}
+    "serve_close",          # scheduler admission stopped   {cancelled}
+    # free-form operator note
+    "note",
+)
+
+_KNOWN = frozenset(EVENT_KINDS)
+#: record keys an attr may not shadow
+_RESERVED = frozenset(("t", "kind", "step", "schema"))
+
+
+class FlightRecorder:
+    """Lock-protected ring of events, newest-``capacity`` retained.
+
+    ``emit`` is the single write path: it stamps the monotonic clock
+    *inside* the lock, so event order in the ring is timestamp order
+    even under concurrent emitters — the property the postmortem
+    validator checks as "monotonic timestamps".
+    """
+
+    def __init__(self, capacity: int = 2048,
+                 clock: Callable[[], float] = time.monotonic):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self.clock = clock
+        self._ring: deque[dict] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._dropped = 0
+
+    # -- write -------------------------------------------------------------
+
+    def emit(self, kind: str, step: int | None = None, **attrs: Any) -> None:
+        """Record one event. ``kind`` must be in ``EVENT_KINDS``; attrs
+        are free-form JSON-able fields (non-JSON values are repr'd at
+        dump time, never at emit time — the hot path does no encoding)."""
+        if kind not in _KNOWN:
+            raise ValueError(
+                f"unknown flight-recorder event kind {kind!r} "
+                f"(extend EVENT_KINDS to add one)"
+            )
+        bad = _RESERVED.intersection(attrs)
+        if bad:
+            raise ValueError(f"attrs shadow reserved keys: {sorted(bad)}")
+        rec: dict = {"kind": kind}
+        if step is not None:
+            rec["step"] = int(step)
+        rec.update(attrs)
+        with self._lock:
+            # clock INSIDE the lock: ring order == time order
+            rec["t"] = float(self.clock())
+            if len(self._ring) == self.capacity:
+                self._dropped += 1
+            self._ring.append(rec)
+
+    # -- read --------------------------------------------------------------
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted by the ring bound since the last clear()."""
+        with self._lock:
+            return self._dropped
+
+    def events(self) -> list[dict]:
+        """Snapshot copy, oldest first."""
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._dropped = 0
+
+    # -- dump --------------------------------------------------------------
+
+    def dump(self, path: str, reason: str = "") -> str:
+        """Write the ring as a JSONL postmortem: one header line
+        (schema, reason, counts) then one line per event, oldest first.
+        Returns ``path``. Never raises on unserializable attrs — they
+        are repr'd."""
+        with self._lock:
+            events = [dict(e) for e in self._ring]
+            dropped = self._dropped
+        header = {
+            "schema": SCHEMA,
+            "reason": reason,
+            "dumped_t": float(self.clock()),
+            "events": len(events),
+            "dropped": dropped,
+            "capacity": self.capacity,
+            "pid": os.getpid(),
+        }
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header, sort_keys=True, default=repr) + "\n")
+            for e in events:
+                f.write(json.dumps(e, sort_keys=True, default=repr) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # a torn dump must not look complete
+        return path
+
+    def dump_unique(self, directory: str, reason: str = "",
+                    basename: str = "postmortem") -> str:
+        """Dump into ``directory`` as ``postmortem.jsonl``, suffixing
+        ``-1``, ``-2``, … instead of overwriting an earlier postmortem
+        (a supervised run can die more than once)."""
+        d = os.path.abspath(os.path.expanduser(directory))
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"{basename}.jsonl")
+        n = 0
+        while os.path.exists(path):
+            n += 1
+            path = os.path.join(d, f"{basename}-{n}.jsonl")
+        return self.dump(path, reason=reason)
+
+
+# ---------------------------------------------------------------------------
+# Dump validation + ordering queries (shared by tools/postmortem.py,
+# tools/obs_check.py, and the chaos tests)
+# ---------------------------------------------------------------------------
+
+
+def validate_dump(path: str) -> list[str]:
+    """Schema-check a postmortem dump; returns failures (empty == pass).
+
+    Checks: header schema tag, event count agreement, required keys
+    (``t`` number, ``kind`` in the known vocabulary, ``step`` an int
+    when present), and non-decreasing timestamps.
+    """
+    failures: list[str] = []
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        return [f"unreadable dump: {e}"]
+    if not lines:
+        return ["empty dump (no header line)"]
+    try:
+        header = json.loads(lines[0])
+    except ValueError as e:
+        return [f"header is not JSON: {e}"]
+    if header.get("schema") != SCHEMA:
+        failures.append(
+            f"header schema {header.get('schema')!r} != {SCHEMA!r}")
+    n_events = len(lines) - 1
+    if header.get("events") != n_events:
+        failures.append(
+            f"header says {header.get('events')} events, dump has {n_events}")
+    prev_t = None
+    for i, line in enumerate(lines[1:], 2):
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            failures.append(f"line {i}: not JSON ({e})")
+            continue
+        t = rec.get("t")
+        if not isinstance(t, (int, float)) or isinstance(t, bool):
+            failures.append(f"line {i}: missing/non-numeric 't': {rec!r}")
+        elif prev_t is not None and t < prev_t:
+            failures.append(
+                f"line {i}: timestamp {t} decreases (prev {prev_t})")
+        else:
+            prev_t = t
+        kind = rec.get("kind")
+        if kind not in _KNOWN:
+            failures.append(f"line {i}: unknown event kind {kind!r}")
+        if "step" in rec and not isinstance(rec["step"], int):
+            failures.append(f"line {i}: non-int step {rec['step']!r}")
+    return failures
+
+
+def contains_in_order(
+    events: Iterable[Mapping],
+    specs: Sequence[tuple[str, Mapping[str, Any]] | str],
+) -> bool:
+    """True when ``events`` (time-ordered) contains a subsequence
+    matching ``specs``: each spec is a kind, or ``(kind, {attr: value})``
+    where every given attr must equal the event's (compared as str, so
+    CLI-supplied expectations work). The causal-order oracle for
+    postmortem timelines."""
+    want = list(specs)
+    it = iter(events)
+    for spec in want:
+        kind, attrs = (spec, {}) if isinstance(spec, str) else spec
+        for e in it:
+            if e.get("kind") != kind:
+                continue
+            if all(str(e.get(k)) == str(v) for k, v in attrs.items()):
+                break
+        else:
+            return False
+    return True
+
+
+_default = FlightRecorder()
+
+
+def default_recorder() -> FlightRecorder:
+    """The process-wide flight recorder every emitter defaults to —
+    one ring per process, so a postmortem interleaves train, checkpoint,
+    retry, supervisor, fault, and serve events in true causal order."""
+    return _default

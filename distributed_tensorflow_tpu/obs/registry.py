@@ -255,11 +255,14 @@ class Registry:
             )
         return h
 
+    def _sorted_unlocked(self) -> list[_Metric]:
+        return sorted(self._metrics.values(),
+                      key=lambda m: (m.name, m.labels))
+
     def collect(self) -> list[_Metric]:
         """All metrics, stable order: by name, then label values."""
         with self._lock:
-            return sorted(self._metrics.values(),
-                          key=lambda m: (m.name, m.labels))
+            return self._sorted_unlocked()
 
     def get(self, name: str, **labels) -> _Metric | None:
         return self._metrics.get(
@@ -307,9 +310,21 @@ class Registry:
                     mine.merge_from(om)
 
     def snapshot(self) -> dict:
-        """JSON-able dump (the JSONL exporter's payload)."""
+        """JSON-able dump (the JSONL exporter's payload).
+
+        Reads every metric UNDER the registry lock: ``merge`` mutates a
+        histogram's ``counts`` then ``sum`` while holding this lock, so
+        a snapshot taken lock-free could capture the counts of one merge
+        and the sum of another (torn ``sum``/``count``). Holding the
+        lock for the whole read makes the snapshot a consistent cut
+        w.r.t. merges; lock-free hot-path ``observe()`` keeps its
+        documented one-update skew."""
+        with self._lock:
+            return self._snapshot_unlocked()
+
+    def _snapshot_unlocked(self) -> dict:
         out = {}
-        for m in self.collect():
+        for m in self._sorted_unlocked():
             key = m.name if not m.labels else (
                 m.name + "{" + ",".join(f"{k}={v}" for k, v in m.labels) + "}"
             )

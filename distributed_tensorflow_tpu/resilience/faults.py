@@ -59,9 +59,17 @@ import signal as signal_lib
 
 import numpy as np
 
+from ..obs import flightrec as flightrec_lib
 from ..train.callbacks import Callback
 
 logger = logging.getLogger(__name__)
+
+
+def _record_fault(fault: str, **attrs) -> None:
+    """Every injected fault lands in the process flight recorder the
+    instant it fires — the postmortem timeline's ground truth for "what
+    was done to this run" (tools/postmortem.py)."""
+    flightrec_lib.default_recorder().emit("fault_fired", fault=fault, **attrs)
 
 
 # ---------------------------------------------------------------------------
@@ -248,6 +256,8 @@ class FaultPlan:
                     continue  # nothing saved yet; try again next restart
                 self._fired.add(i)
                 path = truncate_shard(directory, step, nbytes=fault.nbytes)
+                _record_fault("ckpt_corrupt", step=step,
+                              restart=restart_index)
                 logger.warning(
                     "fault: truncated %d byte(s) of newest checkpoint "
                     "(step %d) at restart %d: %s",
@@ -285,6 +295,7 @@ class FaultCallback(Callback):
                 continue
             if isinstance(fault, Sigterm) and step >= fault.step:
                 fired.add(i)
+                _record_fault("sigterm", step=step)
                 os.kill(os.getpid(), signal_lib.SIGTERM)
             elif isinstance(fault, ClockStall) and step >= fault.step:
                 fired.add(i)
@@ -292,6 +303,7 @@ class FaultCallback(Callback):
                     raise ValueError(
                         "ClockStall fault needs FaultPlan.callback(clock=...)"
                     )
+                _record_fault("clock_stall", step=step, dt=fault.dt)
                 self.clock.advance(fault.dt)
 
 
@@ -322,12 +334,15 @@ class FaultyIterator:
             if isinstance(fault, DataError):
                 if i not in fired and self.count >= fault.batch:
                     fired.add(i)
+                    _record_fault("data_error", step=self.count)
                     raise IOError(f"{fault.message} (batch {self.count})")
             elif isinstance(fault, TransientIOError):
                 if self.count >= fault.batch:
                     remaining = left.setdefault(i, fault.times)
                     if remaining > 0:
                         left[i] = remaining - 1
+                        _record_fault("transient_io", step=self.count,
+                                      fires_left=remaining - 1)
                         raise IOError(
                             f"{fault.message} (batch {self.count}, "
                             f"{remaining - 1} fire(s) left)"
@@ -338,6 +353,7 @@ class FaultyIterator:
                 continue
             if self.count >= fault.batch:
                 fired.add(i)
+                _record_fault("nan_batch", step=self.count)
                 batch = _poison_batch(batch, fault.key)
         return batch
 

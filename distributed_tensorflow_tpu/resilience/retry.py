@@ -10,6 +10,10 @@ counters every site shares:
     retry_attempts_total{site}   re-attempts after a retryable failure
     retry_exhausted_total{site}  budgets exhausted (the give-up events)
 
+plus the flight-recorder events ``retry_attempt``/``retry_exhausted``
+(obs/flightrec.py) and a ``wasted_seconds_total{cause=retry_backoff}``
+goodput entry for every backoff slept (obs/goodput.py).
+
 Determinism is a design requirement, not a nicety: the jitter is derived
 from ``(seed, retry_index)``, so a chaos run that retries is exactly
 reproducible — the same property FaultPlan.seeded gives the faults
@@ -33,6 +37,9 @@ import threading
 import time
 from typing import Any, Callable
 
+from ..obs import flightrec as flightrec_lib
+from ..obs import goodput
+from ..obs.flightrec import FlightRecorder
 from ..obs.registry import Registry, default_registry
 
 logger = logging.getLogger(__name__)
@@ -148,6 +155,7 @@ def retry_call(
     clock: Callable[[], float] = time.monotonic,
     sleep: Callable[[float], None] = time.sleep,
     on_retry: Callable[[int, BaseException], None] | None = None,
+    flightrec: FlightRecorder | None = None,
 ) -> Any:
     """Call ``fn`` under ``policy``; return its value or raise
     RetryExhausted (chaining the last failure).
@@ -158,6 +166,7 @@ def retry_call(
     propagate untouched and never touch the counters.
     """
     reg = registry if registry is not None else default_registry()
+    rec = flightrec if flightrec is not None else flightrec_lib.default_recorder()
     attempts_c = reg.counter(
         ATTEMPTS_TOTAL, "re-attempts after a retryable failure", site=site)
     exhausted_c = reg.counter(
@@ -181,16 +190,28 @@ def retry_call(
             failures += 1
             if failures >= policy.max_attempts:
                 exhausted_c.inc()
+                rec.emit("retry_exhausted", site=site, failures=failures,
+                         reason="attempt budget")
                 raise RetryExhausted(site, failures, "attempt budget", e) from e
             delay = policy.backoff_s(failures - 1)
             if (policy.deadline_s is not None
                     and (clock() - t0) + delay > policy.deadline_s):
                 exhausted_c.inc()
+                rec.emit("retry_exhausted", site=site, failures=failures,
+                         reason="total deadline")
                 raise RetryExhausted(site, failures, "total deadline", e) from e
             attempts_c.inc()
+            rec.emit("retry_attempt", site=site, failures=failures)
             logger.warning(
                 "retry[%s]: attempt %d/%d failed (%s); backing off %.3fs",
                 site, failures, policy.max_attempts, e, delay,
             )
+            t_sleep = clock()
             sleep(delay)
+            # goodput books ELAPSED time around the (injectable) sleep,
+            # not the nominal delay: a no-op test sleep wastes nothing
+            slept = clock() - t_sleep
+            if slept > 0:
+                goodput.note_wasted(goodput.WASTE_RETRY_BACKOFF, slept,
+                                    registry=reg)
             pending = e

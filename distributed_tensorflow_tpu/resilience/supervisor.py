@@ -43,6 +43,9 @@ import logging
 import time
 from typing import Any, Callable, Iterable, Sequence
 
+from ..obs import flightrec as flightrec_lib
+from ..obs import goodput
+from ..obs.flightrec import FlightRecorder
 from ..obs.registry import Registry, default_registry
 from .retry import RetryExhausted, RetryPolicy
 
@@ -136,6 +139,9 @@ class Supervisor:
         registry: Registry | None = None,
         on_restart: Sequence[Callable[[int, str], None]] = (),
         sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+        flightrec: FlightRecorder | None = None,
+        postmortem_dir: str | None = None,
     ):
         self.build = build
         self.num_steps = num_steps
@@ -143,6 +149,12 @@ class Supervisor:
         self.registry = registry if registry is not None else default_registry()
         self.on_restart = tuple(on_restart)
         self.sleep = sleep
+        self.clock = clock
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
+        #: where the exhaustion postmortem lands; defaults to the first
+        #: attempt checkpointer's directory (the run dir) when not given
+        self.postmortem_dir = postmortem_dir
         #: restarts performed by the last run() (observability for tests)
         self.restarts = 0
 
@@ -162,6 +174,7 @@ class Supervisor:
             self.restarts = restarts
             cause: str | None = None
             trainer = ckpt = None
+            self.flightrec.emit("sup_attempt", attempt=restarts)
             try:
                 try:
                     # hooks and build are INSIDE the classified attempt:
@@ -172,15 +185,31 @@ class Supervisor:
                     # idempotent. A builder that dies after creating its
                     # checkpointer must close it itself — the supervisor
                     # never saw it.
+                    t_boundary = self.clock()
                     if pending_hook is not None:
                         for hook in self.on_restart:
                             hook(*pending_hook)
                         pending_hook = None
                     trainer, data, ckpt = self.build(restarts)
+                    # goodput: hook + build time (restore, re-init) is
+                    # wall-clock the job did not train — startup counts
+                    # as warmup, restart boundaries as recovery
+                    goodput.note_wasted(
+                        goodput.WASTE_COMPILE_WARMUP if restarts == 0
+                        else goodput.WASTE_RESTART_RECOVERY,
+                        self.clock() - t_boundary, registry=self.registry,
+                    )
+                    if self.postmortem_dir is None:
+                        self.postmortem_dir = getattr(
+                            getattr(ckpt, "cfg", None), "directory", None)
                     state = trainer.fit(data, num_steps=self.num_steps)
                 except BaseException as e:
                     cause = classify_failure(e)
                     last_exc = e
+                    self.flightrec.emit(
+                        "sup_failure", attempt=restarts, cause=cause,
+                        error=repr(e)[:200],
+                    )
                     logger.error(
                         "supervised attempt %d failed [%s]: %r",
                         restarts, cause, e,
@@ -204,6 +233,9 @@ class Supervisor:
                             restarts,
                         )
             if restarts >= self.cfg.max_restarts:
+                self.flightrec.emit("sup_exhausted", cause=cause,
+                                    restarts=restarts)
+                self._dump_postmortem(f"supervisor_exhausted:{cause}")
                 raise SupervisorExhausted(cause, restarts, last_exc) from last_exc
             delay = self.cfg.backoff.backoff_s(restarts)
             restarts += 1
@@ -211,9 +243,30 @@ class Supervisor:
                 RESTARTS_TOTAL, "supervised restarts by failure class",
                 cause=cause,
             ).inc()
+            self.flightrec.emit("sup_restart", restart=restarts, cause=cause,
+                                backoff_s=round(delay, 6))
             logger.warning(
                 "supervisor: restart %d/%d (cause=%s) after %.2fs backoff",
                 restarts, self.cfg.max_restarts, cause, delay,
             )
+            t_sleep = self.clock()
             self.sleep(delay)
+            # ELAPSED, not nominal: an injected no-op sleep wastes nothing
+            slept = self.clock() - t_sleep
+            if slept > 0:
+                goodput.note_wasted(goodput.WASTE_RESTART_RECOVERY, slept,
+                                    registry=self.registry)
             pending_hook = (restarts, cause)
+
+    def _dump_postmortem(self, reason: str) -> None:
+        """Best-effort flight-recorder dump to the run dir — the whole
+        point of the recorder is this moment, so never let a dump
+        failure mask the SupervisorExhausted being raised."""
+        if not self.postmortem_dir:
+            return
+        try:
+            path = self.flightrec.dump_unique(self.postmortem_dir,
+                                              reason=reason)
+            logger.warning("flight-recorder postmortem dumped to %s", path)
+        except Exception:
+            logger.exception("flight-recorder postmortem dump failed")

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import Transformer, TransformerConfig, make_init_fn
+from ..obs import flightrec as flightrec_lib
 from ..obs.registry import Registry
 from . import decode as decode_lib
 from . import sampling
@@ -86,6 +87,7 @@ class ServeEngine:
         seed: int = 0,
         registry: Registry | None = None,
         clock: Callable[[], float] = time.perf_counter,
+        flightrec=None,
     ):
         if not cfg.causal:
             raise ValueError("ServeEngine requires a causal (decoder) model")
@@ -96,8 +98,12 @@ class ServeEngine:
             cfg, num_slots, max_len=max_len, dtype=cache_dtype
         )
         self.clock = clock
+        # one recorder feeds the scheduler's admit/evict events and the
+        # engine's drain event, so the postmortem timeline interleaves
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
         self.sched = Scheduler(num_slots, self.cache.max_len, clock=clock,
-                               max_queue=max_queue)
+                               max_queue=max_queue, flightrec=self.flightrec)
         self.temperature = temperature
         self.top_k = top_k
         self._rng = jax.random.PRNGKey(seed)
@@ -251,7 +257,9 @@ class ServeEngine:
             self.step()
         self._park_idle_written()
         self._m_occupancy.set(0.0)
-        return self.sched.drain_finished()
+        done = self.sched.drain_finished()
+        self.flightrec.emit("serve_drain", finished=len(done))
+        return done
 
     # -- internals ---------------------------------------------------------
 

@@ -45,6 +45,8 @@ import time
 from collections import deque
 from typing import Callable, Iterable
 
+from ..obs import flightrec as flightrec_lib
+
 #: why a request finished
 FINISH_EOS = "eos"
 FINISH_MAX_NEW = "max_new_tokens"
@@ -101,7 +103,7 @@ class Scheduler:
 
     def __init__(self, num_slots: int, max_len: int,
                  clock: Callable[[], float] = time.perf_counter,
-                 max_queue: int | None = None):
+                 max_queue: int | None = None, flightrec=None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if max_queue is not None and max_queue < 1:
@@ -110,6 +112,10 @@ class Scheduler:
         self.max_len = max_len
         self.max_queue = max_queue
         self.clock = clock  # injectable for deterministic latency tests
+        #: flight recorder for admit/evict/close lifecycle events
+        #: (obs/flightrec.py — stdlib-only, so this stays jax-free)
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * num_slots
         self._next_uid = 0
@@ -178,14 +184,19 @@ class Scheduler:
                 req.t_admit = self.clock()
                 self.slots[slot] = req
                 placed.append((slot, req))
+                self.flightrec.emit("serve_admit", uid=req.uid, slot=slot)
         return placed
 
     # -- eviction beyond token-driven finish -------------------------------
 
     def _finish(self, req: Request, reason: str, now: float | None = None) -> None:
+        """The single eviction bottleneck — every finished request, token-
+        driven or not, passes through here exactly once (one flight-
+        recorder ``serve_evict`` per request, reason attached)."""
         req.finish_reason = reason
         req.t_finish = self.clock() if now is None else now
         self.finished[req.uid] = req
+        self.flightrec.emit("serve_evict", uid=req.uid, reason=reason)
 
     def cancel(self, uid: int) -> Request | None:
         """Evict ``uid`` with ``FINISH_CANCELLED`` wherever it lives —
@@ -235,12 +246,15 @@ class Scheduler:
         """Stop admission and cancel everything still queued (they would
         never run); resident requests are left to finish decoding.
         Returns the cancelled requests; idempotent."""
+        first_close = not self._closed
         self._closed = True
         evicted: list[Request] = []
         while self.queue:
             req = self.queue.popleft()
             self._finish(req, FINISH_CANCELLED)
             evicted.append(req)
+        if first_close:
+            self.flightrec.emit("serve_close", cancelled=len(evicted))
         return evicted
 
     # -- decode-loop bookkeeping -------------------------------------------
@@ -281,9 +295,8 @@ class Scheduler:
         elif P + g > self.max_len:
             req.finish_reason = FINISH_MAX_LEN
         if req.done:
-            req.t_finish = self.clock()
             self.slots[slot] = None
-            self.finished[req.uid] = req
+            self._finish(req, req.finish_reason)
             return req
         return None
 

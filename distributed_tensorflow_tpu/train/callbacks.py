@@ -18,9 +18,10 @@ from typing import Any
 import jax
 import numpy as np
 
+from ..obs import flightrec as flightrec_lib
+from ..obs import goodput
 from ..obs.registry import Registry, default_registry
 from ..parallel import cluster
-from ..utils import flops as flops_lib
 
 logger = logging.getLogger(__name__)
 
@@ -52,9 +53,10 @@ class MetricsLogger(Callback):
                  model_flops_per_step: float | None = None,
                  history: bool = False, clock=time.perf_counter):
         """``model_flops_per_step``: FORWARD FLOPs per step (the framework
-        contract — every model's flops_per_example is fwd-only). This
-        callback is the single place the ×3 training multiplier
-        (utils/flops.train_flops_multiplier) is applied for MFU."""
+        contract — every model's flops_per_example is fwd-only). The ×3
+        training multiplier is applied by the shared MFU helper
+        (obs/goodput.train_mfu), the one consumer site for all of
+        MetricsLogger, bench.py, and the ``mfu`` gauge."""
         self.every_n = every_n
         self.batch_size = batch_size
         self.model_flops = model_flops_per_step
@@ -84,10 +86,10 @@ class MetricsLogger(Callback):
             if self.batch_size:
                 fetched["examples_per_sec"] = steps_per_sec * self.batch_size
             if self.model_flops:
-                fetched["mfu"] = flops_lib.mfu(
-                    self.model_flops * flops_lib.train_flops_multiplier(),
-                    steps_per_sec, jax.device_count()
-                )
+                # one MFU definition for log line, bench JSON, and gauge:
+                # obs/goodput.py applies the fwd+bwd multiplier
+                fetched["mfu"] = goodput.train_mfu(
+                    self.model_flops, steps_per_sec)
         self._t0, self._step0 = now, step
         self.last, self.last_step = fetched, step
         if self.history is not None:
@@ -164,16 +166,26 @@ class TelemetryCallback(Callback):
       happened at this step (same staleness rule as SummaryWriter);
       otherwise fetches directly — the same cadence'd device sync every
       other observer pays.
+
+    With ``track_goodput`` (default on) the same host clock also feeds
+    the goodput ledger (obs/goodput.py): the interval from
+    ``on_train_start`` to the first completed step — compile + warmup —
+    is booked as ``wasted_seconds_total{cause=compile_warmup}``, every
+    later inter-step interval as productive seconds. Counters, so the
+    accounting survives supervised restarts by the registry's
+    merge-not-reset invariant.
     """
 
     def __init__(self, registry: Registry | None = None, every_n: int = 100,
                  metrics_logger: "MetricsLogger | None" = None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, track_goodput: bool = True):
         self.registry = registry if registry is not None else default_registry()
         self.every_n = every_n
         self.metrics_logger = metrics_logger
         self.clock = clock
+        self.track_goodput = track_goodput
         self._t_prev: float | None = None
+        self._t_start: float | None = None
         self._step_prev = 0
         self._m_step = self.registry.histogram(
             "train_step_seconds", "host wall-clock between step dispatches")
@@ -189,6 +201,7 @@ class TelemetryCallback(Callback):
 
     def on_train_start(self, trainer):
         self._t_prev = None
+        self._t_start = self.clock() if self.track_goodput else None
 
     def on_step_end(self, trainer, step, metrics):
         now = self.clock()
@@ -197,16 +210,30 @@ class TelemetryCallback(Callback):
             # loop calls us every step, so this is one step's wall time)
             n = max(step - self._step_prev, 1)
             self._m_step.observe((now - self._t_prev) / n)
+            if self.track_goodput:
+                goodput.note_productive(now - self._t_prev,
+                                        registry=self.registry)
+        elif self.track_goodput and self._t_start is not None:
+            # attempt's first completed step: train_start → here is jit
+            # compile + warmup, not productive throughput — the histogram
+            # skips it (no baseline) and goodput books it as warmup waste
+            goodput.note_wasted(goodput.WASTE_COMPILE_WARMUP,
+                                now - self._t_start, registry=self.registry)
         self._t_prev, self._step_prev = now, step
         self._m_steps.inc()
         self._m_gstep.set(step)
         if step % self.every_n != 0:
             return
-        for k, v in _fresh_scalars(self.metrics_logger, step,
-                                   metrics).items():
+        scalars = _fresh_scalars(self.metrics_logger, step, metrics)
+        for k, v in scalars.items():
             self.registry.gauge(
                 self._gauge_name(k), "train metric (cadence-sampled)"
             ).set(v)
+        if self.track_goodput and "mfu" in scalars:
+            # mirror the paired logger's MFU into the canonical gauge
+            self.registry.gauge(
+                goodput.MFU, "model FLOPs utilization of the train step"
+            ).set(scalars["mfu"])
 
 
 class NaNGuard(Callback):
@@ -250,10 +277,13 @@ class Watchdog(Callback):
     """
 
     def __init__(self, budget_s: float = 300.0, registry: Registry | None = None,
-                 poll_s: float | None = None, clock=time.monotonic):
+                 poll_s: float | None = None, clock=time.monotonic,
+                 flightrec=None):
         if budget_s <= 0:
             raise ValueError("budget_s must be positive")
         self.budget_s = budget_s
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
         self.registry = registry if registry is not None else default_registry()
         self.poll_s = poll_s if poll_s is not None else max(
             min(budget_s / 4, 1.0), 0.005)
@@ -307,6 +337,10 @@ class Watchdog(Callback):
                 # until a step completes
                 self._m_stalled.set(1.0)
                 self._m_stalls.inc()
+            # outside the lock: the recorder has its own
+            self.flightrec.emit("watchdog_stall",
+                                overdue_s=round(overdue, 3),
+                                budget_s=self.budget_s)
             logger.error(
                 "watchdog: no step completed for %.1fs "
                 "(budget %.1fs) — host loop or a collective is hung",
@@ -363,5 +397,6 @@ class CheckpointCallback(Callback):
             logger.warning("skipping final checkpoint: training failed")
             self.manager.wait()
             return
-        self.manager.save(int(trainer.state.step), trainer.state, force=True)
+        self.manager.save(int(trainer.state.step), trainer.state, force=True,
+                          trigger="final")
         self.manager.wait()

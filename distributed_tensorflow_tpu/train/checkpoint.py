@@ -31,6 +31,7 @@ import jax
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import flightrec as flightrec_lib
 from ..parallel import cluster
 # submodule import: resilience/retry.py has no train/ dependency, so this
 # cannot cycle even though resilience/__init__ imports train.callbacks
@@ -122,13 +123,16 @@ class Checkpointer:
     eval-side restore (SURVEY.md §3.5 pattern)."""
 
     def __init__(self, cfg: CheckpointConfig, mesh: Mesh, spec_tree: Any = None,
-                 io_retry: RetryPolicy | None = None, registry=None):
+                 io_retry: RetryPolicy | None = None, registry=None,
+                 flightrec=None):
         """``io_retry``: transient-IO retry budget applied to the save /
         restore / manifest-write seams (sites ``ckpt_save`` /
         ``ckpt_restore`` / ``ckpt_manifest_write``); defaults to a
         3-attempt exponential policy. ``registry``: obs.Registry for the
-        retry counters (default: the process-wide one). Kept out of
-        CheckpointConfig so the config stays JSON-serializable."""
+        retry counters (default: the process-wide one). ``flightrec``:
+        obs.FlightRecorder for checkpoint lifecycle events (save /
+        restore / quarantine; default: the process-wide ring). Kept out
+        of CheckpointConfig so the config stays JSON-serializable."""
         if not cfg.directory:
             raise ValueError("CheckpointConfig.directory is required")
         self.cfg = cfg
@@ -136,6 +140,8 @@ class Checkpointer:
         self.spec_tree = spec_tree
         self.io_retry = io_retry if io_retry is not None else RetryPolicy()
         self.registry = registry
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
         self.watcher = PreemptionWatcher() if cfg.save_on_preemption else None
         options = ocp.CheckpointManagerOptions(
             max_to_keep=cfg.max_to_keep,
@@ -155,7 +161,7 @@ class Checkpointer:
         (then asks the caller loop to stop via the returned flag +
         PreemptionError)."""
         if self.watcher is not None and self._any_host_preempted(step):
-            saved = self.save(step, state, force=True)
+            saved = self.save(step, state, force=True, trigger="preemption")
             self.wait()
             latest = self.latest_step()
             if not saved and (latest is None or latest < step):
@@ -207,7 +213,10 @@ class Checkpointer:
             self._finite_check = jax.jit(all_finite)
         return bool(jax.device_get(self._finite_check(params)))
 
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
+    def save(self, step: int, state: Any, force: bool = False,
+             trigger: str = "cadence") -> bool:
+        """``trigger`` labels the flight-recorder event only (cadence /
+        preemption / final / emergency) — save semantics are identical."""
         if step in self.manager.all_steps():
             return False  # already saved (e.g. cadence save + final save)
         if self.cfg.validate_before_save and not self._params_finite(state):
@@ -224,7 +233,10 @@ class Checkpointer:
                 step, args=ocp.args.StandardSave(state), force=force
             ),
             policy=self.io_retry, site="ckpt_save", registry=self.registry,
+            flightrec=self.flightrec,
         )
+        if saved:
+            self.flightrec.emit("ckpt_save", step=step, trigger=trigger)
         if saved and cluster.is_chief():
             logger.info("checkpoint saved at step %d", step)
         if saved and self.cfg.write_manifest and cluster.is_chief():
@@ -281,7 +293,7 @@ class Checkpointer:
             lambda: io_lib.write_payload(
                 os.path.join(d, "MANIFEST.dtf"), payload),
             policy=self.io_retry, site="ckpt_manifest_write",
-            registry=self.registry,
+            registry=self.registry, flightrec=self.flightrec,
         )
 
     def verify_manifest(self, step: int) -> bool | None:
@@ -372,7 +384,9 @@ class Checkpointer:
         if not fallback:
             if self.cfg.write_manifest:
                 self.verify_manifest(step)  # raises before a corrupt restore
-            return self._restore_step(step, abstract_state)
+            state = self._restore_step(step, abstract_state)
+            self.flightrec.emit("ckpt_restore", step=step, fallback=False)
+            return state
         for s in sorted(self.manager.all_steps(), reverse=True):
             if s > step:
                 continue  # explicit ceiling: never restore past `step`
@@ -385,14 +399,16 @@ class Checkpointer:
                     retry_call(
                         lambda: self.verify_manifest(s),
                         policy=self.io_retry, site="ckpt_verify",
-                        registry=self.registry,
+                        registry=self.registry, flightrec=self.flightrec,
                     )
                 except RetryExhausted as e:
                     self._quarantine_or_skip(s, "integrity check",
                                              e.__cause__ or e)
                     continue
             try:
-                return self._restore_step(s, abstract_state)
+                state = self._restore_step(s, abstract_state)
+                self.flightrec.emit("ckpt_restore", step=s, fallback=True)
+                return state
             except (OSError, RetryExhausted) as e:
                 # a step that verifies (or predates manifests) but fails
                 # at read time — e.g. committed shards whose manifest
@@ -437,6 +453,7 @@ class Checkpointer:
             lambda: self.manager.restore(
                 step, args=ocp.args.StandardRestore(target)),
             policy=self.io_retry, site="ckpt_restore", registry=self.registry,
+            flightrec=self.flightrec,
         )
         if cluster.is_chief():
             logger.info("restored checkpoint at step %d", step)
@@ -448,6 +465,8 @@ class Checkpointer:
         ``save()`` at the same step number starts clean. A QUARANTINE
         file records why. Multi-host: call on the chief — the move is a
         single rename on the shared filesystem. Returns the new path."""
+        self.flightrec.emit("ckpt_quarantine", step=step,
+                            note=str(reason)[:160])
         src = self._step_dir(step)
         base = os.path.join(os.path.dirname(src), ".corrupt")
         os.makedirs(base, exist_ok=True)

@@ -20,6 +20,7 @@ from typing import Any, Callable, Iterable, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding
 
+from ..obs import flightrec as flightrec_lib
 from ..parallel import sharding as sh
 from . import step as step_lib
 from .callbacks import Callback, CheckpointCallback
@@ -44,6 +45,8 @@ class Trainer:
         callbacks: Sequence[Callback] = (),
         donate: bool = True,
         emergency_checkpoint=None,
+        flightrec=None,
+        postmortem_dir: str | None = None,
     ):
         self.mesh = mesh
         self.spec_tree = spec_tree
@@ -65,6 +68,17 @@ class Trainer:
                 if isinstance(cb, CheckpointCallback):
                     self.emergency_checkpoint = cb.manager
                     break
+        #: flight recorder for the loop's causal events (obs/flightrec.py);
+        #: defaults to the process ring so every layer shares one timeline
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
+        #: where an abnormal-exit postmortem dump lands; defaults to the
+        #: emergency checkpointer's directory (the run dir)
+        self.postmortem_dir = postmortem_dir
+        if self.postmortem_dir is None:
+            self.postmortem_dir = getattr(
+                getattr(self.emergency_checkpoint, "cfg", None),
+                "directory", None)
         if donate:
             self.step_fn = step_lib.jit_train_step(train_step, mesh, spec_tree)
         else:
@@ -100,6 +114,8 @@ class Trainer:
         # Host-side step mirror: reading state.step would sync the device
         # every iteration and serialize dispatch with execution.
         step_now = int(self.state.step)
+        rec = self.flightrec
+        rec.emit("train_start", step=step_now)
         try:
             # inside the try: a raising on_train_start (or iter()) must
             # still reach the finally's on_train_end, or started
@@ -117,19 +133,26 @@ class Trainer:
                 except StopIteration:
                     self.request_stop("data exhausted")
                     break
+                rec.emit("step_start", step=step_now + 1)
                 batch = self.put_batch(batch)
                 self.state, metrics = self.step_fn(self.state, batch)
                 step_now += 1
                 for cb in self.callbacks:
                     cb.on_step_end(self, step_now, metrics)
+                # after the callbacks: step_end marks the step COMPLETE
+                # (checkpoint cadence included), so a missing step_end in
+                # a postmortem points at the exact step that died
+                rec.emit("step_end", step=step_now)
         except PreemptionSaved as e:
             # Clean preemption exit (SURVEY.md §5.3): state is safely on
             # disk; stop so the scheduler — or an in-process
             # resilience.Supervisor — can restart-and-resume.
             self.preempted = True
             self.request_stop(str(e))
-        except BaseException:
+        except BaseException as e:
             self.failed = True
+            rec.emit("train_exception", step=step_now,
+                     etype=type(e).__name__, error=repr(e)[:200])
             # Crash-safe exit: one best-effort emergency checkpoint of
             # the last completed step before re-raising. save() itself
             # applies validate_before_save, so a poisoned state (the
@@ -137,10 +160,14 @@ class Trainer:
             # latest checkpoint; any error here must not mask the
             # original exception.
             self._emergency_save(step_now)
+            # abnormal exit: dump the flight recorder as a postmortem
+            # (best-effort, never masks the original exception)
+            self._dump_postmortem(f"train_exception:{type(e).__name__}")
             raise
         finally:
             for cb in self.callbacks:
                 cb.on_train_end(self)
+        rec.emit("train_stop", step=step_now, reason=self._stop_reason or "")
         if self._stop_reason:
             logger.info("training stopped: %s", self._stop_reason)
         return self.state
@@ -159,13 +186,32 @@ class Trainer:
         if ckpt is None or step <= 0:
             return
         try:
-            if ckpt.save(step, self.state, force=True):
+            if ckpt.save(step, self.state, force=True, trigger="emergency"):
                 ckpt.wait()
+                self.flightrec.emit("emergency_checkpoint", step=step,
+                                    saved=True)
                 logger.warning("emergency checkpoint saved at step %d", step)
             else:
+                self.flightrec.emit("emergency_checkpoint", step=step,
+                                    saved=False)
                 logger.warning(
                     "emergency checkpoint at step %d not written "
                     "(refused by validation or already on disk)", step
                 )
         except Exception:
+            self.flightrec.emit("emergency_checkpoint", step=step,
+                                saved=False, error="save raised")
             logger.exception("emergency checkpoint at step %d failed", step)
+
+    def _dump_postmortem(self, reason: str) -> None:
+        """Best-effort JSONL postmortem of the flight recorder into the
+        run dir (tools/postmortem.py renders it). Runs on the abnormal
+        exit path, so it must never raise past the original failure."""
+        if not self.postmortem_dir:
+            return
+        try:
+            path = self.flightrec.dump_unique(self.postmortem_dir,
+                                              reason=reason)
+            logger.warning("flight-recorder postmortem dumped to %s", path)
+        except Exception:
+            logger.exception("flight-recorder postmortem dump failed")

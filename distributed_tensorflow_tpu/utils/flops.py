@@ -8,9 +8,11 @@ config for new hardware.
 FRAMEWORK-WIDE CONTRACT (round-2 unification, VERDICT.md item 2): every
 model's ``flops_per_example`` and every workload's
 ``WorkloadParts.flops_per_step`` are FORWARD-only. The fwd+bwd training
-multiplier (``train_flops_multiplier()``, ×3) is applied in exactly two
-consumer sites: ``MetricsLogger`` (train-loop MFU) and ``bench.py``.
-``tests/test_flops_contract.py`` enforces this for all workloads.
+multiplier (``train_flops_multiplier()``, ×3) is applied in exactly ONE
+consumer site: ``obs/goodput.train_mfu`` — the shared MFU helper that
+``MetricsLogger`` (train-loop MFU), ``bench.py``, and the family
+benches all route through, and which publishes the ``mfu`` gauge.
+``tests/test_flops_contract.py`` enforces both halves.
 """
 
 from __future__ import annotations

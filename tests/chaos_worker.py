@@ -22,8 +22,13 @@ Markers on stdout (the drivers assert on these):
     CHAOS-DONE step=N        run reached the target step
     CHAOS-PREEMPTED step=K   clean PreemptionSaved exit, checkpoint at K
     CHAOS-DATAFAULT saved=K  injected IOError; emergency checkpoint at K
-    CHAOS-SUPERVISED step=N restarts=R finite=F quarantined=Q
-                             supervised run finished; F/Q are 0/1 flags
+    CHAOS-SUPERVISED step=N restarts=R finite=F quarantined=Q ordered=O
+                             supervised run finished; F/Q/O are 0/1 flags
+                             (O: flight-recorder timeline causal order)
+    CHAOS-POSTMORTEM path=P events=N ordered=O
+                             flight recorder dumped to P (--flightrec)
+    CHAOS-GOODPUT fraction=F productive_s=P wall_s=W ok=K
+                             goodput gauge vs measured wall-clock
 """
 
 import argparse
@@ -64,11 +69,21 @@ def global_step_batch(i: int) -> dict:
 def _supervised(args, mesh, model, tx) -> int:
     """One supervised run: faults from the CLI become a FaultPlan, every
     recovery path (retrying data, preemption restart, fallback restore)
-    runs in THIS process under resilience.Supervisor."""
+    runs in THIS process under resilience.Supervisor — and the flight
+    recorder + goodput ledger must agree with what actually happened:
+    the postmortem timeline is asserted to contain the injected fault,
+    the restart, and the fallback restore IN CAUSAL ORDER, and the
+    exported ``goodput_fraction`` gauge to equal productive-step seconds
+    over total wall-clock within tolerance."""
+    import time
+
     import optax  # noqa: F401  (kept symmetric with main's imports)
 
     from distributed_tensorflow_tpu.data.pipeline import RetryingIterator
     from distributed_tensorflow_tpu.models import common
+    from distributed_tensorflow_tpu.obs import flightrec as fr
+    from distributed_tensorflow_tpu.obs import goodput
+    from distributed_tensorflow_tpu.obs.registry import default_registry
     from distributed_tensorflow_tpu.resilience import (
         CorruptCheckpoint, FaultPlan, RetryPolicy, Sigterm, Supervisor,
         SupervisorConfig, TransientIOError,
@@ -107,7 +122,10 @@ def _supervised(args, mesh, model, tx) -> int:
         start = int(state.step)
         trainer = Trainer(
             make_train_step(loss_fn, tx, StepOptions()), state, mesh, specs,
-            callbacks=[cb.CheckpointCallback(ckpt), plan.callback()],
+            # telemetry FIRST: maybe_save raises PreemptionSaved from
+            # CheckpointCallback, skipping later callbacks for that step
+            callbacks=[cb.TelemetryCallback(every_n=10 ** 6),
+                       cb.CheckpointCallback(ckpt), plan.callback()],
         )
         data = RetryingIterator(
             lambda i: plan.wrap(batches_from(i), start=i),
@@ -123,19 +141,61 @@ def _supervised(args, mesh, model, tx) -> int:
         on_restart=[plan.restart_hook(args.workdir)],
         sleep=lambda s: None,
     )
+    t_run0 = time.monotonic()
     state = sup.run()
+    wall_s = time.monotonic() - t_run0
     leaves = [np.asarray(x) for x in
               jax.tree.leaves(jax.device_get(state.params))]
     finite = all(np.isfinite(x).all() for x in leaves)
     quarantined = os.path.isdir(os.path.join(args.workdir, ".corrupt"))
     if args.out:
         np.savez(args.out, **{f"p{i}": x for i, x in enumerate(leaves)})
+
+    # -- flight-recorder causal-order assertion (ISSUE 6 acceptance) ------
+    events = fr.default_recorder().events()
+    ordered = True
+    if args.sigterm_at is not None and args.corrupt_at_restart:
+        # the postmortem timeline must tell the recovery story in order:
+        # injected SIGTERM → preemption (emergency) checkpoint → restart
+        # → corruption fault at the boundary → quarantine → fallback
+        # restore onto an older valid step
+        ordered = fr.contains_in_order(events, [
+            ("fault_fired", {"fault": "sigterm"}),
+            ("ckpt_save", {"trigger": "preemption"}),
+            ("sup_restart", {}),
+            ("fault_fired", {"fault": "ckpt_corrupt"}),
+            ("ckpt_quarantine", {}),
+            ("ckpt_restore", {"fallback": True}),
+        ])
+    if args.flightrec:
+        fr.default_recorder().dump(args.flightrec, reason="chaos_worker")
+        print(f"CHAOS-POSTMORTEM path={args.flightrec} "
+              f"events={len(events)} ordered={int(ordered)}", flush=True)
+
+    # -- goodput accounting vs real wall-clock (ISSUE 6 acceptance) -------
+    reg = default_registry()
+    productive = reg.total(goodput.PRODUCTIVE_SECONDS)
+    frac_gauge = reg.get(goodput.GOODPUT_FRACTION)
+    frac = frac_gauge.value if frac_gauge is not None else float("nan")
+    # the tracked buckets partition sup.run()'s wall time up to small
+    # untracked slivers (classification, final save, ckpt.close), so the
+    # exported fraction must track productive/wall within tolerance
+    goodput_ok = (0.0 < frac <= 1.0
+                  and abs(frac - productive / wall_s) <= 0.15)
+    print(
+        f"CHAOS-GOODPUT fraction={frac:.4f} productive_s={productive:.4f} "
+        f"wall_s={wall_s:.4f} ok={int(goodput_ok)}", flush=True,
+    )
+
     print(
         f"CHAOS-SUPERVISED step={int(state.step)} restarts={sup.restarts} "
-        f"finite={int(finite)} quarantined={int(quarantined)}",
+        f"finite={int(finite)} quarantined={int(quarantined)} "
+        f"ordered={int(ordered)}",
         flush=True,
     )
-    return 0 if int(state.step) == args.steps and finite else 1
+    ok = (int(state.step) == args.steps and finite and ordered
+          and goodput_ok)
+    return 0 if ok else 1
 
 
 def main(argv=None) -> int:
@@ -159,6 +219,9 @@ def main(argv=None) -> int:
                     help="supervised mode: data fetch for this GLOBAL step "
                          "raises IOError twice, then succeeds")
     ap.add_argument("--max-restarts", type=int, default=3)
+    ap.add_argument("--flightrec", default=None,
+                    help="supervised mode: dump the flight recorder to this "
+                         "JSONL path at the end of the run")
     args = ap.parse_args(argv)
 
     import optax

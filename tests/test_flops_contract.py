@@ -64,18 +64,20 @@ def test_declared_flops_are_forward_only(name):
 
 
 def test_train_multiplier_single_site():
-    """The ×3 multiplier must have exactly two call sites: MetricsLogger
-    and bench.py — grep-level guard against reintroducing it in models."""
+    """The ×3 multiplier must have exactly ONE call site —
+    obs/goodput.train_mfu, the shared MFU helper that MetricsLogger and
+    bench.py both route through — grep-level guard against
+    reintroducing it in models, workloads, or report scripts."""
     import pathlib
 
     root = pathlib.Path(__file__).resolve().parents[1]
     call = "flops_lib.train_flops_multiplier()"
     hits = []
-    for py in (root / "distributed_tensorflow_tpu").rglob("*.py"):
-        if call in py.read_text():
-            hits.append(py.relative_to(root).as_posix())
+    for sub in ("distributed_tensorflow_tpu", "tools"):
+        for py in (root / sub).rglob("*.py"):
+            if call in py.read_text():
+                hits.append(py.relative_to(root).as_posix())
     hits += ["bench.py"] if call in (root / "bench.py").read_text() else []
     assert sorted(hits) == [
-        "bench.py",
-        "distributed_tensorflow_tpu/train/callbacks.py",
+        "distributed_tensorflow_tpu/obs/goodput.py",
     ], hits

@@ -156,6 +156,47 @@ def test_render_survives_non_finite_values():
     assert "g_inf +Inf" in text and "g_ninf -Inf" in text
 
 
+def test_snapshot_consistent_under_concurrent_merge():
+    """Regression (ISSUE 6 satellite): a snapshot taken while a merge is
+    in flight must be a consistent cut. ``merge`` mutates a histogram's
+    ``counts`` then ``sum`` under the registry lock; ``snapshot`` now
+    reads under the same lock, so it can never capture the counts of
+    merge k and the sum of merge k-1. Every source observation is 1.0,
+    so consistency is exactly ``sum == count`` in every snapshot. The
+    bucket array is wide enough that the numpy ``counts +=`` releases
+    the GIL — the lock-free-snapshot tear reproduces within ~1000
+    merges on this shape, so this test genuinely detects a revert."""
+    import threading
+
+    src = obs.Registry()
+    wide = tuple(float(x) for x in np.linspace(1e-3, 1e3, 100_000))
+    hs = src.histogram("h_seconds", buckets=wide)
+    hs.observe(1.0)
+    src.counter("c_total").inc(1)
+
+    dst = obs.Registry()
+    stop = threading.Event()
+    torn: list[dict] = []
+
+    def snapshotter():
+        while not stop.is_set():
+            snap = dst.snapshot()
+            h = snap.get("h_seconds")
+            if h is not None and h["sum"] != float(h["count"]):
+                torn.append(h)
+                return
+
+    t = threading.Thread(target=snapshotter)
+    t.start()
+    for _ in range(1500):
+        dst.merge(src)
+    stop.set()
+    t.join()
+    assert not torn, f"torn histogram snapshot: {torn[:1]}"
+    assert dst.get("h_seconds").count == 1500
+    assert dst.get("c_total").value == 1500.0
+
+
 def test_registry_reset_keeps_handles():
     r = obs.Registry()
     c, h, g = r.counter("c_total"), r.histogram("h"), r.gauge("g")

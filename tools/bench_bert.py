@@ -170,11 +170,14 @@ def main() -> None:
     examples_per_sec_per_chip = steps_per_sec * global_batch / n_chips
     n_pred = (batch["masked_positions"].shape[1]
               if "masked_positions" in batch else None)
-    model_flops = (tfm.flops_per_example(cfg, seq, n_predictions=n_pred)
-                   * global_batch
-                   * flops_lib.train_flops_multiplier())
+    # shared MFU helper (obs/goodput.py): applies the fwd+bwd multiplier
+    from distributed_tensorflow_tpu.obs import goodput
+
     peak = flops_lib.peak_flops_per_chip(devices[0])
-    mfu = flops_lib.mfu(model_flops, steps_per_sec, n_chips, peak)
+    mfu = goodput.train_mfu(
+        tfm.flops_per_example(cfg, seq, n_predictions=n_pred) * global_batch,
+        steps_per_sec, n_chips=n_chips, peak_per_chip=peak,
+    )
     log(f"steps/sec={steps_per_sec:.3f} "
         f"examples/sec/chip={examples_per_sec_per_chip:.1f} MFU={mfu:.3f}")
 

@@ -76,10 +76,10 @@ def main(argv=None):
         stats.append(eng.step())
     wall = time.perf_counter() - t0
 
+    from distributed_tensorflow_tpu.obs import goodput
+
     reg = eng.registry
     ttft = reg.get("serve_ttft_seconds")
-    tpot = reg.get("serve_tpot_seconds")
-    qwait = reg.get("serve_queue_wait_seconds")
     tokens = int(reg.get("serve_tokens_total").value)
     finished = int(sum(
         m.value for m in reg.collect() if m.name == "serve_finished_total"
@@ -91,7 +91,13 @@ def main(argv=None):
 
     decode_steps = [s for s in stats if s.decoded_slots]
     full = sum(1 for s in decode_steps if s.occupancy == 1.0)
-    ms = lambda s: round(s * 1e3, 3)  # noqa: E731
+    # percentile read-back via the SHARED helper (obs/goodput.py): one
+    # formula for the printed numbers and any registry consumer
+    pct = lambda name, qs=(0.5, 0.99): goodput.latency_percentiles_ms(  # noqa: E731
+        reg, name, quantiles=qs)
+    ttft_ms = pct("serve_ttft_seconds")
+    tpot_ms = pct("serve_tpot_seconds")
+    qwait_ms = pct("serve_queue_wait_seconds", (0.5,))
     result = {
         "requests": args.requests,
         "slots": args.slots,
@@ -99,11 +105,11 @@ def main(argv=None):
         "generated_tokens": tokens,
         "wall_s": round(wall, 3),
         "tokens_per_sec": round(tokens / wall, 1),
-        "ttft_p50_ms": ms(ttft.percentile(0.5)),
-        "ttft_p99_ms": ms(ttft.percentile(0.99)),
-        "tpot_p50_ms": ms(tpot.percentile(0.5)),
-        "tpot_p99_ms": ms(tpot.percentile(0.99)),
-        "queue_wait_p50_ms": ms(qwait.percentile(0.5)),
+        "ttft_p50_ms": ttft_ms["p50_ms"],
+        "ttft_p99_ms": ttft_ms["p99_ms"],
+        "tpot_p50_ms": tpot_ms["p50_ms"],
+        "tpot_p99_ms": tpot_ms["p99_ms"],
+        "queue_wait_p50_ms": qwait_ms["p50_ms"],
         "mean_occupancy": round(
             sum(s.occupancy for s in decode_steps) / len(decode_steps), 3
         ),

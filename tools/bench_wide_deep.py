@@ -122,10 +122,14 @@ def main() -> None:
     # (3x total) for deep tables + the 1-wide columns, both per feature.
     bytes_per_example = 3 * n_feat * (embed + 1) * 4
     embed_gbps = examples_per_sec_per_chip * bytes_per_example / 1e9
-    model_flops = (wd.flops_per_example(cfg) * global_batch
-                   * flops_lib.train_flops_multiplier())
+    # shared MFU helper (obs/goodput.py): applies the fwd+bwd multiplier
+    from distributed_tensorflow_tpu.obs import goodput
+
     peak = flops_lib.peak_flops_per_chip(devices[0])
-    mfu = flops_lib.mfu(model_flops, steps_per_sec, n_chips, peak)
+    mfu = goodput.train_mfu(
+        wd.flops_per_example(cfg) * global_batch, steps_per_sec,
+        n_chips=n_chips, peak_per_chip=peak,
+    )
     log(f"steps/sec={steps_per_sec:.3f} "
         f"examples/sec/chip={examples_per_sec_per_chip:.0f} "
         f"embed-traffic={embed_gbps:.1f} GB/s MFU={mfu:.4f}")

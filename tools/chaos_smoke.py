@@ -92,20 +92,46 @@ def sigterm_resume_round() -> None:
     print("chaos_smoke: SIGTERM -> coordinated save -> resume OK")
 
 
+#: where the supervised round's flight-recorder dump lands — a stable
+#: artifact so tools/ci_fast.sh can re-validate it with tools/postmortem.py
+POSTMORTEM_ARTIFACT = os.environ.get(
+    "DTF_CHAOS_POSTMORTEM",
+    os.path.join(_REPO, "artifacts", "chaos_postmortem.jsonl"),
+)
+
+#: the causal story the supervised round's timeline must tell, in order
+#: (shared with ci_fast.sh's postmortem gate)
+POSTMORTEM_EXPECT = (
+    "fault_fired[fault=sigterm],ckpt_save[trigger=preemption],"
+    "sup_restart,fault_fired[fault=ckpt_corrupt],ckpt_quarantine,"
+    "ckpt_restore[fallback=True]"
+)
+
+
 def supervised_recovery_round() -> None:
     """SIGTERM + truncated-newest-checkpoint in ONE supervised run: the
     Supervisor must restart in process, quarantine the corrupt newest
     step, fall back to an older valid one, and finish with finite
-    params."""
+    params — and the flight recorder must have recorded the whole story
+    (fault → preemption save → restart → quarantine → fallback restore
+    in causal order; goodput gauge consistent with measured wall-clock).
+    The dump is left at POSTMORTEM_ARTIFACT for the ci_fast postmortem
+    gate."""
+    os.makedirs(os.path.dirname(POSTMORTEM_ARTIFACT), exist_ok=True)
     with tempfile.TemporaryDirectory(prefix="chaos_smoke_sup_") as d:
         out = _run_worker(os.path.join(d, "ckpt"), "--supervise",
                           "--steps", "8", "--sigterm-at", "3",
-                          "--corrupt-at-restart")
+                          "--corrupt-at-restart",
+                          "--flightrec", POSTMORTEM_ARTIFACT)
         assert "CHAOS-SUPERVISED step=8" in out, out
         assert "finite=1" in out and "quarantined=1" in out, out
         assert "restarts=1" in out, out
+        assert "ordered=1" in out, out
+        assert "CHAOS-GOODPUT" in out and "ok=1" in out, out
+    assert os.path.exists(POSTMORTEM_ARTIFACT), POSTMORTEM_ARTIFACT
     print("chaos_smoke: supervised SIGTERM + corrupt-newest -> "
-          "fallback restore -> finish OK")
+          "fallback restore -> finish OK (postmortem at "
+          f"{POSTMORTEM_ARTIFACT})")
 
 
 def main() -> int:

@@ -10,6 +10,10 @@
 #      coordinated-save → resume subprocess round (ISSUE 3) + one
 #      supervised SIGTERM + corrupt-newest-checkpoint run that must
 #      recover via fallback restore and finish finite (ISSUE 4)
+#   4. tools/postmortem.py     — flight-recorder gate: the supervised
+#      round's postmortem dump must pass schema validation AND contain
+#      fault → preemption save → restart → quarantine → fallback-restore
+#      in causal order (ISSUE 6)
 #
 # Usage: tools/ci_fast.sh   (extra args are passed to smoke_collect)
 set -euo pipefail
@@ -17,4 +21,7 @@ cd "$(dirname "$0")/.."
 bash tools/smoke_collect.sh "$@"
 env JAX_PLATFORMS=cpu python tools/obs_check.py >/dev/null
 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+env JAX_PLATFORMS=cpu python tools/postmortem.py \
+  "${DTF_CHAOS_POSTMORTEM:-artifacts/chaos_postmortem.jsonl}" --quiet \
+  --expect 'fault_fired[fault=sigterm],ckpt_save[trigger=preemption],sup_restart,fault_fired[fault=ckpt_corrupt],ckpt_quarantine,ckpt_restore[fallback=True]'
 echo "ci_fast: all gates passed"

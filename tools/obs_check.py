@@ -6,7 +6,12 @@ JSONL logger, renders Prometheus text exposition, and lints the output
 against the exposition-format grammar with a regex — so a formatting
 regression (bad label escaping, non-cumulative buckets, missing
 ``_sum``/``_count``) fails loudly before anything tries to scrape a
-real run. No device, no model: the obs layer is plain host code.
+real run. Also gates the flight-recorder dump schema (required keys,
+monotonic timestamps, known event kinds, ring-overflow accounting —
+obs/flightrec.py) and the goodput/MFU surface (``goodput_fraction`` /
+``mfu`` gauges, ``wasted_seconds_total{cause}`` counters, the shared
+percentile read-back — obs/goodput.py). No device, no model: the obs
+layer is plain host code.
 
 Usage:
     python tools/obs_check.py
@@ -86,6 +91,9 @@ def check(verbose: bool = True) -> list[str]:
         if snap["obs_check_events_total"]["value"] != 3:
             failures.append(f"snapshot counter wrong: {snap}")
 
+    failures += _check_flightrec()
+    failures += _check_goodput(reg)
+
     if verbose:
         print(text, end="")
         for f in failures:
@@ -94,6 +102,108 @@ def check(verbose: bool = True) -> list[str]:
             print(f"OK: {len(text.splitlines())} exposition lines, "
                   f"{len(reg.collect())} metrics, jsonl round-trip clean",
                   file=sys.stderr)
+    return failures
+
+
+def _check_flightrec() -> list[str]:
+    """Flight-recorder gate: emit through a small ring, dump, and push
+    the dump through the same schema validator tools/postmortem.py and
+    CI use — plus negative cases the validator must catch."""
+    import os
+
+    from distributed_tensorflow_tpu.obs import flightrec as fr
+
+    failures: list[str] = []
+    rec = fr.FlightRecorder(capacity=4)
+    rec.emit("train_start", step=0)
+    rec.emit("fault_fired", step=3, fault="sigterm")
+    rec.emit("ckpt_save", step=4, trigger="preemption")
+    rec.emit("sup_restart", restart=1, cause="preemption")
+    rec.emit("ckpt_restore", step=2, fallback=True)
+    rec.emit("train_stop", step=8, reason="num_steps=8")
+    if len(rec) != 4 or rec.dropped != 2:
+        failures.append(
+            f"ring overflow wrong: len={len(rec)} dropped={rec.dropped} "
+            f"(want 4/2)")
+    try:
+        rec.emit("not_a_kind")
+        failures.append("emit accepted an unknown event kind")
+    except ValueError:
+        pass
+
+    with tempfile.TemporaryDirectory(prefix="obs_check_fr_") as d:
+        path = rec.dump(os.path.join(d, "pm.jsonl"), reason="obs_check")
+        for f in fr.validate_dump(path):
+            failures.append(f"flightrec dump invalid: {f}")
+        if not fr.contains_in_order(
+                rec.events(),
+                [("sup_restart", {}), ("ckpt_restore", {"fallback": True})]):
+            failures.append("contains_in_order missed a present sequence")
+        if fr.contains_in_order(
+                rec.events(), [("ckpt_restore", {}), ("sup_restart", {})]):
+            failures.append("contains_in_order accepted a reversed sequence")
+        # the validator must catch what emit() can never produce: an
+        # unknown kind, a decreasing timestamp, a key-less record
+        bad = os.path.join(d, "bad.jsonl")
+        with open(path) as f_in:
+            lines = f_in.read().splitlines()
+        with open(bad, "w") as f_out:
+            f_out.write(lines[0] + "\n")
+            f_out.write('{"t": 5.0, "kind": "meteor_strike"}\n')
+            f_out.write('{"t": 4.0, "kind": "train_start"}\n')
+            f_out.write('{"kind": "train_stop"}\n')
+            f_out.write('{"t": 6.0, "kind": "train_stop", "step": "x"}\n')
+            # a 5th event under a header claiming 4: count mismatch
+            f_out.write('{"t": 7.0, "kind": "train_stop"}\n')
+        bad_failures = fr.validate_dump(bad)
+        for needle in ("unknown event kind", "decreases",
+                       "missing/non-numeric", "non-int step",
+                       "events, dump has"):
+            if not any(needle in b for b in bad_failures):
+                failures.append(
+                    f"validator missed a '{needle}' violation: "
+                    f"{bad_failures}")
+    return failures
+
+
+def _check_goodput(reg) -> list[str]:
+    """Goodput/MFU gate: the gauge names the docs promise exist with the
+    arithmetic they promise, device-free (peak/chips passed in)."""
+    from distributed_tensorflow_tpu.obs import goodput
+
+    failures: list[str] = []
+    goodput.note_productive(3.0, registry=reg)
+    goodput.note_wasted(goodput.WASTE_COMPILE_WARMUP, 0.5, registry=reg)
+    goodput.note_wasted(goodput.WASTE_RETRY_BACKOFF, 0.25, registry=reg)
+    goodput.note_wasted(goodput.WASTE_RESTART_RECOVERY, 0.25, registry=reg)
+    frac = reg.get(goodput.GOODPUT_FRACTION)
+    if frac is None or abs(frac.value - 0.75) > 1e-9:
+        failures.append(f"goodput_fraction gauge wrong: "
+                        f"{frac and frac.value} (want 0.75)")
+    if abs(goodput.goodput_fraction(reg) - 0.75) > 1e-9:
+        failures.append("goodput_fraction() read-back disagrees with gauge")
+    for cause in goodput.WASTE_CAUSES:
+        if reg.get(goodput.WASTED_SECONDS, cause=cause) is None:
+            failures.append(f"missing wasted_seconds_total{{cause={cause}}}")
+    try:
+        goodput.note_wasted("procrastination", 1.0, registry=reg)
+        failures.append("note_wasted accepted an unknown cause")
+    except ValueError:
+        pass
+    # fwd 1e12 FLOPs/step × ×3 training multiplier × 1.5 steps/s over
+    # 3 chips × 1e12 peak → MFU 1.5 exactly, published as the gauge
+    mfu = goodput.train_mfu(1e12, 1.5, n_chips=3, peak_per_chip=1e12,
+                            registry=reg)
+    gauge = reg.get(goodput.MFU)
+    if gauge is None or abs(gauge.value - mfu) > 1e-12 or abs(mfu - 1.5) > 1e-9:
+        failures.append(f"mfu gauge/return mismatch: gauge="
+                        f"{gauge and gauge.value} returned={mfu} (want 1.5)")
+    # shared percentile read-back == the histogram's own percentile()
+    h = reg.get("obs_check_latency_seconds")
+    ms = goodput.latency_percentiles_ms(reg, "obs_check_latency_seconds")
+    if abs(ms["p50_ms"] - round(float(h.percentile(0.5)) * 1e3, 3)) > 1e-9:
+        failures.append(f"latency_percentiles_ms disagrees with "
+                        f"Histogram.percentile: {ms}")
     return failures
 
 

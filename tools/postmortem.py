@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Render a flight-recorder postmortem dump as a causal timeline.
+
+The dump (obs/flightrec.py: one JSON header line + one JSON event per
+line, monotonic timestamps) is written by the train loop on an unhandled
+step exception, by the Supervisor on ``SupervisorExhausted``, or on
+request (``tests/chaos_worker.py --flightrec``). This tool answers the
+operator question the raw JSONL can't: *what happened, in what order,
+and what did recovery do about it* — e.g.
+
+    t+0.412s  fault_fired          step=3   fault=sigterm
+    t+0.498s  ckpt_save            step=4   trigger=preemption
+    t+0.501s  train_stop           step=4   reason=preempted; ...
+    t+0.502s  sup_restart                   restart=1 cause=preemption
+    t+0.607s  fault_fired          step=4   fault=ckpt_corrupt restart=1
+    t+0.633s  ckpt_quarantine      step=4   note=...
+    t+0.671s  ckpt_restore         step=2   fallback=True
+
+Validation (exit 1 on failure, the CI gate in tools/ci_fast.sh):
+
+- schema: header tag, per-event required keys, known event kinds,
+  non-decreasing timestamps (``obs.flightrec.validate_dump``);
+- ordering: ``--expect k1,k2[attr=v],...`` asserts the timeline contains
+  those events as a causal subsequence (``obs.flightrec.contains_in_order``).
+
+Usage:
+    python tools/postmortem.py <dump.jsonl>
+    python tools/postmortem.py <dump.jsonl> --expect \
+        'fault_fired[fault=sigterm],ckpt_save[trigger=preemption],sup_restart'
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+#: step_start/step_end floods are collapsed into one summary line when a
+#: run of them is at least this long
+COLLAPSE_RUN = 5
+_STEP_KINDS = ("step_start", "step_end")
+
+
+def load(path):
+    """Returns (header_dict, [event_dict, ...])."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    if not lines:
+        raise ValueError(f"empty dump: {path}")
+    header = json.loads(lines[0])
+    events = [json.loads(line) for line in lines[1:]]
+    return header, events
+
+
+def parse_expect(spec: str):
+    """``kind`` or ``kind[attr=v,attr2=v2]`` items, comma-separated at
+    the top level only."""
+    specs = []
+    for item in filter(None, (s.strip() for s in _split_top(spec))):
+        if "[" in item:
+            kind, _, rest = item.partition("[")
+            if not rest.endswith("]"):
+                raise ValueError(f"bad expect item {item!r}")
+            attrs = {}
+            for pair in rest[:-1].split(","):
+                k, _, v = pair.partition("=")
+                if not k or not _:
+                    raise ValueError(f"bad expect attr {pair!r} in {item!r}")
+                attrs[k.strip()] = v.strip()
+            specs.append((kind.strip(), attrs))
+        else:
+            specs.append((item, {}))
+    return specs
+
+
+def _split_top(spec: str):
+    """Split on commas not inside [...] brackets."""
+    out, buf, depth = [], [], 0
+    for ch in spec:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    out.append("".join(buf))
+    return out
+
+
+def _fmt_event(e, t0):
+    attrs = {k: v for k, v in e.items() if k not in ("t", "kind", "step")}
+    step = f"step={e['step']:<6}" if "step" in e else " " * 11
+    body = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+    return f"  t+{e['t'] - t0:9.3f}s  {e['kind']:<20} {step} {body}".rstrip()
+
+
+def render(header, events, out=sys.stdout):
+    """Human timeline; consecutive step_start/step_end runs collapsed."""
+    t0 = events[0]["t"] if events else header.get("dumped_t", 0.0)
+    span = events[-1]["t"] - t0 if events else 0.0
+    print(
+        f"FLIGHT RECORDER POSTMORTEM  reason={header.get('reason') or '-'}  "
+        f"{len(events)} events ({header.get('dropped', 0)} dropped, "
+        f"ring capacity {header.get('capacity')})  span {span:.3f}s  "
+        f"pid {header.get('pid')}",
+        file=out,
+    )
+    i = 0
+    while i < len(events):
+        e = events[i]
+        if e["kind"] in _STEP_KINDS:
+            j = i
+            while j < len(events) and events[j]["kind"] in _STEP_KINDS:
+                j += 1
+            if j - i >= COLLAPSE_RUN:
+                steps = [ev.get("step") for ev in events[i:j]
+                         if ev.get("step") is not None]
+                span_lbl = (f"steps {min(steps)}–{max(steps)}" if steps
+                            else "no step ids")  # step is optional
+                print(
+                    f"  t+{e['t'] - t0:9.3f}s  … {j - i} step events "
+                    f"({span_lbl}) over "
+                    f"{events[j - 1]['t'] - e['t']:.3f}s …",
+                    file=out,
+                )
+                i = j
+                continue
+        print(_fmt_event(e, t0), file=out)
+        i += 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("dump", help="postmortem JSONL written by the recorder")
+    ap.add_argument("--expect", default=None,
+                    help="comma-separated 'kind' or 'kind[attr=val,...]' "
+                         "items that must appear in this causal order")
+    ap.add_argument("--quiet", action="store_true",
+                    help="validate only; skip the rendered timeline")
+    args = ap.parse_args(argv)
+
+    from distributed_tensorflow_tpu.obs import flightrec as fr
+
+    failures = fr.validate_dump(args.dump)
+    header, events = ({}, [])
+    if not failures:
+        header, events = load(args.dump)
+        if not args.quiet:
+            render(header, events)
+    if args.expect and not failures:
+        specs = parse_expect(args.expect)
+        if not fr.contains_in_order(events, specs):
+            failures.append(
+                f"timeline does not contain the expected causal sequence: "
+                f"{args.expect}"
+            )
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(f"OK: {args.dump} valid ({len(events)} events"
+              + (f", causal order '{args.expect}' present" if args.expect
+                 else "") + ")",
+              file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
