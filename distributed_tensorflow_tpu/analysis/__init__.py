@@ -1,0 +1,46 @@
+"""dtflint — framework-aware static analysis for this repo.
+
+An AST-based lint layer that mechanically enforces the invariants the
+PR 1-6 review rounds caught by hand: host syncs inside jit-traced step
+functions, reuse of donated pytrees, lock-guarded state touched outside
+its lock, closed-vocabulary drift (flight-recorder kinds, metric names
+vs docs, the single ×3 MFU-multiplier site), and swallowed exceptions
+in the fault-classification seams.
+
+Entry points:
+
+- ``tools/dtf_lint.py`` — the CLI (``--strict`` gates tools/ci_fast.sh;
+  ``--self-check`` proves every rule still fires on its shipped
+  fixtures and that the tree is clean).
+- :func:`lint_paths` / :func:`lint_sources` — the library API
+  (tests/test_lint.py drives the fixtures through these).
+
+Rule catalog, suppression syntax, and pre-fix examples:
+docs/static-analysis.md.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    LintContext,
+    Module,
+    Rule,
+    RULES,
+    lint_paths,
+    lint_sources,
+    register,
+    repo_root,
+)
+from . import rules  # noqa: F401 — registers the rule set
+from . import fixtures  # noqa: F401 — the self-check corpus
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "Module",
+    "Rule",
+    "RULES",
+    "lint_paths",
+    "lint_sources",
+    "register",
+    "repo_root",
+]

@@ -1,0 +1,295 @@
+"""dtflint core — findings, rule registry, suppressions, the lint driver.
+
+PRs 1-6 each ended with a review round catching the same invariant
+classes by hand: a torn lock-free ``Registry.snapshot``, donated-buffer
+reuse after a jitted call, the "exactly one ×3 MFU multiplier site"
+contract, host syncs hiding in jit-traced step functions, swallowed
+exceptions defeating the supervisor's fault taxonomy. This package turns
+those review catches into *mechanical*, CI-gated checks: every rule here
+encodes one invariant the framework already relies on, phrased as an
+AST query over the repo's own idioms.
+
+Design constraints:
+
+- **stdlib-only.** The analyzer imports nothing heavy — no jax, no
+  numpy — so ``tools/dtf_lint.py`` runs on a bare CI box in well under a
+  second. Framework vocabularies (flight-recorder event kinds, waste
+  causes, the docs metric tables) are extracted by *parsing* the source
+  files, never by importing them.
+- **Heuristic, but precise on this repo's idioms.** Rules are
+  intraprocedural/module-local where whole-program analysis would be
+  needed for exactness; the heuristics are tuned so the shipped tree
+  lints clean without drowning real violations in noise. Each rule's
+  docstring states its approximations.
+- **Suppressible, loudly.** ``# dtflint: disable=<rule>[,<rule>...]``
+  on the flagged line (or the line directly above it) suppresses a
+  finding — the reviewable, greppable escape hatch for deliberate
+  negatives (e.g. tools/obs_check.py's must-raise vocabulary tests).
+  ``# dtflint: disable-file=<rule>`` anywhere in a file suppresses the
+  rule for the whole file.
+
+Exit-code contract (tools/dtf_lint.py): 0 = clean, 1 = findings (or a
+failed ``--self-check``), 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Callable, Iterable, Iterator
+
+__all__ = [
+    "Finding",
+    "Module",
+    "LintContext",
+    "Rule",
+    "RULES",
+    "register",
+    "lint_paths",
+    "lint_sources",
+    "repo_root",
+]
+
+#: ``# dtflint: disable=a,b`` / ``# dtflint: disable-file=a,b``
+_SUPPRESS_RE = re.compile(
+    r"#\s*dtflint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\- ]+)"
+)
+
+
+def repo_root() -> str:
+    """The repository this analyzer ships in (vocabulary files and
+    docs tables are resolved relative to it)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a source line."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Module:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        #: line -> set of rule names disabled on that line
+        self.line_disables: dict[int, set[str]] = {}
+        #: rules disabled for the entire file
+        self.file_disables: set[str] = set()
+        # suppressions bind to real COMMENT tokens only — a per-line
+        # regex would also match marker text inside string literals
+        # (docstrings, fixture corpora), silently disabling rules for
+        # the whole file
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except (tokenize.TokenError, IndentationError):
+            tokens = []  # ast parsed it, so this is effectively dead
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            names = {n.strip() for n in m.group(2).split(",") if n.strip()}
+            if m.group(1) == "disable-file":
+                self.file_disables |= names
+            else:
+                self.line_disables.setdefault(
+                    tok.start[0], set()).update(names)
+
+    def suppressed(self, finding: Finding) -> bool:
+        """A finding is suppressed by a marker on its own line, on the
+        line directly above it, or by a file-level disable."""
+        for names in (self.file_disables,
+                      self.line_disables.get(finding.line, ()),
+                      self.line_disables.get(finding.line - 1, ())):
+            if finding.rule in names or "all" in names:
+                return True
+        return False
+
+    def constant_strings(self) -> dict[str, str]:
+        """Module-level ``NAME = "literal"`` bindings — lets rules see
+        through the repo's metric-name/site-name constants."""
+        out: dict[str, str] = {}
+        for node in self.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                out[node.targets[0].id] = node.value.value
+        return out
+
+
+class LintContext:
+    """Shared state across one lint run: the repo root (for vocabulary
+    extraction) and a free-form scratch dict project-scope rules use to
+    accumulate across modules before ``finalize``."""
+
+    def __init__(self, root: str | None = None):
+        self.root = root if root is not None else repo_root()
+        self.scratch: dict = {}
+
+    def read_repo_file(self, relpath: str) -> str | None:
+        try:
+            with open(os.path.join(self.root, relpath)) as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+class Rule:
+    """One lint rule. Subclasses set ``name``/``summary`` and implement
+    ``check_module``; project-scope rules may also implement
+    ``finalize`` (runs once after every module was scanned)."""
+
+    name: str = ""
+    summary: str = ""
+
+    def check_module(self, module: Module,
+                     ctx: LintContext) -> Iterator[Finding]:
+        return iter(())
+
+    def finalize(self, ctx: LintContext) -> Iterator[Finding]:
+        return iter(())
+
+
+RULES: dict[str, Rule] = {}
+
+
+def register(rule) -> Rule:
+    """Register a Rule (instances and classes both accepted, so rules
+    can use ``@register`` as a class decorator)."""
+    if isinstance(rule, type):
+        rule = rule()
+    if not rule.name:
+        raise ValueError("rule must have a name")
+    if rule.name in RULES:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    RULES[rule.name] = rule
+    return rule
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def _iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if not d.startswith(".") and d != "__pycache__"
+                )
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        yield os.path.join(root, n)
+        else:
+            raise FileNotFoundError(p)
+
+
+def _active_rules(rules: Iterable[str] | None) -> list[Rule]:
+    from . import rules as _rules  # noqa: F401 — registration side effect
+
+    if rules is None:
+        return list(RULES.values())
+    missing = [r for r in rules if r not in RULES]
+    if missing:
+        raise KeyError(f"unknown rule(s): {missing} (known: {sorted(RULES)})")
+    return [RULES[r] for r in rules]
+
+
+def lint_sources(
+    sources: dict[str, str],
+    rules: Iterable[str] | None = None,
+    root: str | None = None,
+    on_parse_error: Callable[[str, SyntaxError], None] | None = None,
+) -> list[Finding]:
+    """Lint in-memory ``{path: source}`` pairs (tests, fixtures, and
+    the file driver below all funnel through here). Findings come back
+    sorted by (path, line, rule); suppressed findings are dropped."""
+    active = _active_rules(rules)
+    ctx = LintContext(root=root)
+    findings: list[Finding] = []
+    modules: list[Module] = []
+    for path, source in sources.items():
+        try:
+            modules.append(Module(path, source))
+        except SyntaxError as e:
+            if on_parse_error is not None:
+                on_parse_error(path, e)
+            else:
+                raise
+    for module in modules:
+        for rule in active:
+            for f in rule.check_module(module, ctx):
+                if not module.suppressed(f):
+                    findings.append(f)
+    by_path = {m.path: m for m in modules}
+    for rule in active:
+        for f in rule.finalize(ctx):
+            m = by_path.get(f.path)
+            if m is None or not m.suppressed(f):
+                findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_paths(
+    paths: Iterable[str],
+    rules: Iterable[str] | None = None,
+    root: str | None = None,
+    on_parse_error: Callable[[str, SyntaxError], None] | None = None,
+) -> list[Finding]:
+    """Lint files/directories on disk. Paths are reported as given
+    (relative in → relative out, the CI-log-friendly form)."""
+    sources: dict[str, str] = {}
+    for path in _iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            sources[path] = f.read()
+    return lint_sources(sources, rules=rules, root=root,
+                        on_parse_error=on_parse_error)
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers shared by the rules
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)

@@ -1,0 +1,268 @@
+"""Shipped lint fixtures — the self-check corpus.
+
+For every rule: a POSITIVE snippet (must produce exactly that rule at
+the line marked ``# fires-here``), a NEGATIVE snippet (the idiomatic
+clean version — must produce nothing), and a SUPPRESSED snippet (the
+positive plus a ``# dtflint: disable=<rule>`` marker — must produce
+nothing). ``tools/dtf_lint.py --self-check`` runs all three for every
+rule before the tree lint, so the CI gate can never rot silently: a
+rule that stops firing on its own positive fixture fails the gate even
+though the (now-unprotected) tree still lints clean.
+
+tests/test_lint.py drives the same corpus through the library API and
+additionally pins file:line anchoring and the exit-code contract.
+"""
+
+from __future__ import annotations
+
+FIRES_MARKER = "# fires-here"
+
+
+def expected_line(source: str) -> int:
+    """1-based line carrying the ``# fires-here`` marker."""
+    for i, line in enumerate(source.splitlines(), 1):
+        if FIRES_MARKER in line:
+            return i
+    raise ValueError("fixture has no fires-here marker")
+
+
+POSITIVE: dict[str, str] = {
+    "host-sync-in-step": '''\
+import jax
+import numpy as np
+
+
+@jax.jit
+def train_step(state, batch):
+    grads = batch["x"] * 2.0
+    loss = float(grads.sum())  # fires-here
+    return state, {"loss": loss}
+''',
+    "donation-after-use": '''\
+import jax
+
+
+def _step(state, batch):
+    return state
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+
+def run_once(state, batch):
+    new_state = step(state, batch)
+    print(state.params)  # fires-here
+    return new_state
+''',
+    "lock-discipline": '''\
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def size(self):
+        return len(self._items)  # fires-here
+''',
+    "closed-vocab": '''\
+class Engine:
+    def __init__(self, flightrec):
+        self.flightrec = flightrec
+
+    def poke(self):
+        self.flightrec.emit("warp_core_breach", step=1)  # fires-here
+''',
+    "exception-hygiene": '''\
+def best_effort_cleanup(path):
+    try:
+        open(path).close()
+    except:  # fires-here
+        pass
+''',
+}
+
+
+NEGATIVE: dict[str, str] = {
+    "host-sync-in-step": '''\
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def train_step(state, batch):
+    grads = batch["x"] * 2.0
+    loss = jnp.sum(grads)
+    return state, {"loss": loss}
+
+
+def report(metrics):
+    # host side, outside the jitted step: syncing is the point
+    return float(metrics["loss"])
+''',
+    "donation-after-use": '''\
+import jax
+
+
+def _step(state, batch):
+    return state
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+
+def run_once(state, batch):
+    state = step(state, batch)
+    return state.params
+''',
+    "lock-discipline": '''\
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def size(self):
+        with self._lock:
+            return len(self._items)
+
+    def _size_unlocked(self):
+        return len(self._items)
+''',
+    "closed-vocab": '''\
+class Engine:
+    def __init__(self, flightrec):
+        self.flightrec = flightrec
+
+    def poke(self):
+        self.flightrec.emit("serve_admit", uid=1, slot=0)
+''',
+    "exception-hygiene": '''\
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def best_effort_cleanup(path):
+    try:
+        open(path).close()
+    except OSError:
+        logger.exception("cleanup of %s failed", path)
+''',
+}
+
+
+SUPPRESSED: dict[str, str] = {
+    "host-sync-in-step": '''\
+import jax
+
+
+@jax.jit
+def train_step(state, batch):
+    loss = float(batch.sum())  # dtflint: disable=host-sync-in-step
+    return state, {"loss": loss}
+''',
+    "donation-after-use": '''\
+import jax
+
+
+def _step(state, batch):
+    return state
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+
+def run_once(state, batch):
+    new_state = step(state, batch)
+    # dtflint: disable=donation-after-use
+    print(state.params)
+    return new_state
+''',
+    "lock-discipline": '''\
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def size(self):
+        return len(self._items)  # dtflint: disable=lock-discipline
+''',
+    "closed-vocab": '''\
+class Engine:
+    def __init__(self, flightrec):
+        self.flightrec = flightrec
+
+    def poke(self):
+        # deliberate negative-path probe, e.g. a must-raise test
+        self.flightrec.emit("warp_core_breach")  # dtflint: disable=closed-vocab
+''',
+    "exception-hygiene": '''\
+def best_effort_cleanup(path):
+    try:
+        open(path).close()
+    except:  # dtflint: disable=exception-hygiene
+        pass
+''',
+}
+
+
+def self_check() -> list[str]:
+    """Run every fixture through the real rule set; returns failure
+    descriptions (empty == the lint layer is alive and precise)."""
+    from .core import RULES, lint_sources
+
+    failures: list[str] = []
+    for rule in sorted(RULES):
+        for corpus, name in ((POSITIVE, "positive"), (NEGATIVE, "negative"),
+                             (SUPPRESSED, "suppressed")):
+            if rule not in corpus:
+                failures.append(f"{rule}: no {name} fixture shipped")
+    for rule, src in POSITIVE.items():
+        want_line = expected_line(src)
+        found = lint_sources({f"<fixture:{rule}:positive>": src})
+        hits = [f for f in found if f.rule == rule]
+        if not hits:
+            failures.append(
+                f"{rule}: positive fixture produced no finding — the "
+                f"rule went dead")
+        elif all(f.line != want_line for f in hits):
+            failures.append(
+                f"{rule}: positive fixture fired at line(s) "
+                f"{[f.line for f in hits]}, expected {want_line}")
+        for f in found:
+            if f.rule != rule:
+                failures.append(
+                    f"{rule}: positive fixture also tripped {f.rule} "
+                    f"at line {f.line} — fixtures must isolate one rule")
+    for rule, src in NEGATIVE.items():
+        found = lint_sources({f"<fixture:{rule}:negative>": src})
+        if found:
+            failures.append(
+                f"{rule}: negative fixture not clean: "
+                f"{[f.format() for f in found]}")
+    for rule, src in SUPPRESSED.items():
+        found = lint_sources({f"<fixture:{rule}:suppressed>": src})
+        if found:
+            failures.append(
+                f"{rule}: suppression marker ignored: "
+                f"{[f.format() for f in found]}")
+    return failures

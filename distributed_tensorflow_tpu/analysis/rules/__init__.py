@@ -1,0 +1,9 @@
+"""dtflint rule modules — importing this package registers every rule.
+
+One module per rule family; each rule's docstring is its catalog entry
+(docs/static-analysis.md mirrors them with real pre-fix examples).
+"""
+
+from . import donation, exceptions, host_sync, locks, vocab  # noqa: F401
+
+__all__ = ["donation", "exceptions", "host_sync", "locks", "vocab"]

@@ -1,0 +1,212 @@
+"""donation-after-use — never touch a pytree a jitted call donated.
+
+``donate_argnums`` lets XLA alias an input's buffers into the output —
+the in-place update that halves train-state HBM (train/step.py) and
+removes the per-token KV-cache copy (serve/decode.py). The contract is
+that the caller REBINDS and never reads the donated pytree again; a read
+after the call sees deleted buffers at best and, with the buggy
+cache-deserialized executables the ROADMAP documents, heap corruption
+and silently-NaN params at worst. This rule makes the contract
+mechanical.
+
+Detection (intraprocedural, documented approximation):
+
+- **Donating callables.** Any local binding of the form
+  ``f = jax.jit(..., donate_argnums=...)`` (including ``self.attr``
+  targets and the decorator form), plus the framework's donating
+  factories — ``jit_train_step`` (donates position 0, the TrainState)
+  and ``jit_prefill`` / ``jit_decode_step`` (donate position 1, the
+  KVCache) — whose wrapping happens in another module where a local
+  scan can't see the ``donate_argnums``.
+- **Consumption.** A call to a donating callable taints the plain-name
+  or ``self.attr`` argument at each donated position.
+- **Violation.** Any later read of the tainted name in the same
+  function, before a rebind. The canonical clean pattern — rebinding in
+  the call's own assignment, ``state, metrics = step(state, batch)`` —
+  untaints immediately.
+
+Per-line ordering: uses are judged against consumption from *earlier*
+lines, so a same-line rebind is never a false positive; a use-then-
+consume loop body can evade the rule (it is a linter, not a verifier).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, LintContext, Module, Rule, dotted_name, register
+
+#: framework factories that return donating callables: name -> donated
+#: positional indices of the RETURNED callable (train/step.py,
+#: serve/decode.py keep these contracts)
+FACTORY_DONATIONS: dict[str, tuple[int, ...]] = {
+    "jit_train_step": (0,),
+    "jit_prefill": (1,),
+    "jit_decode_step": (1,),
+}
+
+
+def _donated_positions(call: ast.Call) -> tuple[int, ...] | None:
+    """donate_argnums of a ``jax.jit(...)`` call, when literal."""
+    if dotted_name(call.func) not in ("jax.jit", "jit", "pjit", "jax.pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+                else:
+                    return None  # non-literal: cannot resolve
+            return tuple(out)
+        return None
+    return None
+
+
+def _binding_repr(node: ast.AST) -> str | None:
+    """A trackable lvalue/rvalue: plain name or dotted self-attr."""
+    dn = dotted_name(node)
+    if dn is None:
+        return None
+    head = dn.split(".", 1)[0]
+    if head in ("self", "cls") or "." not in dn:
+        return dn
+    return None
+
+
+def _donating_call_positions(call: ast.Call,
+                             donators: dict[str, tuple[int, ...]],
+                             ) -> tuple[int, ...] | None:
+    """Donated positions when ``call`` invokes a known donating
+    callable (bound name or framework factory product)."""
+    dn = dotted_name(call.func)
+    if dn is not None and dn in donators:
+        return donators[dn]
+    if isinstance(call.func, ast.Call):
+        # immediately-invoked form: jax.jit(f, donate_argnums=...)(x, y)
+        inline = _donated_positions(call.func)
+        if inline:
+            return inline
+    return None
+
+
+class _FunctionLister(ast.NodeVisitor):
+    def __init__(self):
+        self.functions: list[ast.AST] = []
+
+    def visit_FunctionDef(self, node):
+        self.functions.append(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _scope_walk(fn: ast.AST):
+    """Walk one function's own scope: nested defs are skipped (each is
+    analyzed independently) — line-order taint must never leak across
+    scope boundaries, where a same-named variable is a different
+    binding and textual order says nothing about execution order."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class DonationRule(Rule):
+    name = "donation-after-use"
+    summary = ("a pytree passed at a donate_argnums position is read "
+               "again after the jitted call consumed it")
+
+    def check_module(self, module: Module,
+                     ctx: LintContext) -> Iterator[Finding]:
+        donators = self._collect_donators(module.tree)
+        lister = _FunctionLister()
+        lister.visit(module.tree)
+        for fn in lister.functions:
+            yield from self._check_function(fn, donators, module)
+
+    @staticmethod
+    def _collect_donators(tree: ast.Module) -> dict[str, tuple[int, ...]]:
+        """binding repr -> donated positions, from assignments of
+        ``jax.jit(..., donate_argnums=...)`` or framework factories."""
+        donators: dict[str, tuple[int, ...]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            positions = _donated_positions(call)
+            if positions is None:
+                callee = dotted_name(call.func)
+                if callee is not None:
+                    positions = FACTORY_DONATIONS.get(
+                        callee.rpartition(".")[2])
+            if not positions:
+                continue
+            for target in node.targets:
+                rep = _binding_repr(target)
+                if rep is not None:
+                    donators[rep] = positions
+        return donators
+
+    def _check_function(self, fn, donators: dict[str, tuple[int, ...]],
+                        module: Module) -> Iterator[Finding]:
+        # events per line: (kind, repr, node); processed line-by-line as
+        # uses -> consumes -> rebinds so same-line rebinding stays clean
+        consumes: dict[int, list[tuple[str, str]]] = {}
+        uses: dict[int, list[tuple[str, ast.AST]]] = {}
+        rebinds: dict[int, list[str]] = {}
+
+        for node in _scope_walk(fn):
+            if isinstance(node, ast.Call):
+                positions = _donating_call_positions(node, donators)
+                if positions:
+                    callee = dotted_name(node.func) or "<jitted>"
+                    for pos in positions:
+                        if pos < len(node.args):
+                            rep = _binding_repr(node.args[pos])
+                            if rep is not None:
+                                consumes.setdefault(node.lineno, []).append(
+                                    (rep, callee))
+            elif isinstance(node, (ast.Name, ast.Attribute)):
+                rep = _binding_repr(node)
+                if rep is None:
+                    continue
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    rebinds.setdefault(node.lineno, []).append(rep)
+                elif isinstance(node.ctx, ast.Load):
+                    uses.setdefault(node.lineno, []).append((rep, node))
+
+        tainted: dict[str, tuple[int, str]] = {}  # repr -> (line, callee)
+        for line in sorted(set(consumes) | set(uses) | set(rebinds)):
+            for rep, node in uses.get(line, ()):
+                # an Attribute load also loads its prefixes; check the
+                # exact repr and any tainted prefix (state.params after
+                # `state` was donated)
+                for t_rep, (t_line, callee) in tainted.items():
+                    if (rep == t_rep or rep.startswith(t_rep + ".")) \
+                            and line > t_line:
+                        yield Finding(
+                            self.name, module.path, node.lineno,
+                            node.col_offset,
+                            f"{rep!r} was donated to {callee!r} on line "
+                            f"{t_line} (donate_argnums) and must not be "
+                            f"read afterwards: XLA aliased its buffers "
+                            f"into the result — rebind the output "
+                            f"instead",
+                        )
+                        break
+            for rep, callee in consumes.get(line, ()):
+                tainted[rep] = (line, callee)
+            for rep in rebinds.get(line, ()):
+                tainted.pop(rep, None)

@@ -1,0 +1,120 @@
+"""exception-hygiene — no swallowed failures in the classification seams.
+
+The recovery stack (resilience/retry.py → supervisor.py →
+train/checkpoint.py) is a fault *taxonomy*: OSError means transient,
+FloatingPointError means poisoned, everything else is fatal, and
+``RetryExhausted.__cause__`` carries the real failure through the
+layers. A bare ``except:`` or a silently-swallowed broad handler breaks
+that chain — the supervisor restarts on garbage, or a real corruption
+is classified as "nothing happened". PR 3-6 reviews policed this by
+hand ("never mask the original exception", "log, don't drop"); this
+rule does it mechanically.
+
+Checks:
+
+- **bare except** — flagged everywhere. Even on a best-effort path,
+  name the exception class (``except Exception``) so ``SystemExit`` /
+  ``KeyboardInterrupt`` keep propagating.
+- **silent broad handler** — ``except Exception`` / ``BaseException``
+  whose body does nothing but ``pass`` / ``...`` / ``continue``:
+  flagged everywhere (a broad catch must raise, log, or record).
+- **silent handler in a fault-classification seam** — inside
+  ``resilience/``, ``train/checkpoint.py``, or ``train/loop.py`` even
+  a *narrow* handler may not be silent: these modules ARE the
+  classification layer, and a dropped exception there is a dropped
+  fault. Handle it, log it, or suppress the finding with a comment
+  explaining why the drop is sound.
+
+"Silent" is syntactic: the handler body contains only ``pass`` /
+``...`` / bare ``continue`` / string constants. A handler that raises,
+returns, logs, assigns, or emits is never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, LintContext, Module, Rule, dotted_name, register
+
+#: modules where even a narrow silent handler defeats fault
+#: classification (see module docstring)
+SEAM_PATHS = (
+    "resilience/",
+    "train/checkpoint.py",
+    "train/loop.py",
+)
+
+_BROAD = frozenset({"Exception", "BaseException"})
+
+
+def _is_seam(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(s in p for s in SEAM_PATHS)
+
+
+def _caught_names(node: ast.ExceptHandler) -> list[str]:
+    t = node.type
+    if t is None:
+        return []
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    names = []
+    for e in elts:
+        dn = dotted_name(e)
+        if dn is not None:
+            names.append(dn.rpartition(".")[2])
+    return names
+
+
+def _is_silent(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+@register
+class ExceptionHygieneRule(Rule):
+    name = "exception-hygiene"
+    summary = ("bare except, or a silently-swallowed handler in a "
+               "retry/supervisor/checkpoint seam")
+
+    def check_module(self, module: Module,
+                     ctx: LintContext) -> Iterator[Finding]:
+        seam = _is_seam(module.path)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield Finding(
+                    self.name, module.path, node.lineno, node.col_offset,
+                    "bare `except:` catches SystemExit and "
+                    "KeyboardInterrupt too — name the class (`except "
+                    "Exception:` at the broadest) so control-flow "
+                    "exceptions keep propagating",
+                )
+                continue
+            if not _is_silent(node.body):
+                continue
+            names = _caught_names(node)
+            if any(n in _BROAD for n in names):
+                yield Finding(
+                    self.name, module.path, node.lineno, node.col_offset,
+                    f"`except {'/'.join(names)}` swallows every failure "
+                    f"silently — raise, log, or record it; a silent "
+                    f"broad catch hides the exact bug class the "
+                    f"supervisor's fault taxonomy exists to classify",
+                )
+            elif seam:
+                yield Finding(
+                    self.name, module.path, node.lineno, node.col_offset,
+                    f"silent `except {'/'.join(names) or '?'}` inside a "
+                    f"fault-classification seam — this layer IS the "
+                    f"taxonomy (transient/poisoned/fatal); a dropped "
+                    f"exception here is a dropped fault. Log it or "
+                    f"suppress with a comment explaining why the drop "
+                    f"is sound",
+                )

@@ -1,0 +1,186 @@
+"""host-sync-in-step — no device round-trips inside jit-traced code.
+
+The framework's whole performance story is host-drives/device-computes:
+the train loop dispatches step N+1 while N executes, the serve engine
+keeps one fused decode program hot. A ``float()`` / ``bool()`` /
+``.item()`` / ``np.asarray()`` / ``jax.device_get()`` on a traced value
+inside a jit-compiled step either fails at trace time (concretization
+error) or — worse, when it slips through on a re-traced python value —
+silently serializes dispatch with execution, the ~40x step-rate cliff
+utils/benchmarking.py documents for tunneled platforms.
+
+What counts as jit-reachable (module-local, documented approximation):
+
+- functions decorated with ``jax.jit`` / ``jit`` / ``pjit`` /
+  ``jax.pmap`` (bare or via ``functools.partial``);
+- functions passed to those wrappers anywhere in the module
+  (``step = jax.jit(train_step)``, ``jax.jit(partial(fn, model))``);
+- the framework's step-function naming convention: ``train_step`` /
+  ``eval_step`` / ``decode_step`` / ``prefill``, which are jitted by
+  factories in *other* modules (train/step.jit_train_step,
+  serve/decode.jit_prefill) — the module-local scan cannot see that
+  wrapping, so the names are part of the framework contract;
+- anything those functions call by bare name in the same module
+  (transitive), including nested defs (a ``lax.scan`` body is traced).
+
+``float()``/``bool()`` on literal constants are ignored (static config
+arithmetic, not a sync). Numpy aliases are resolved from the module's
+imports; ``jnp.asarray`` is device-side and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, LintContext, Module, Rule, dotted_name, register
+
+#: functions jitted by factories in other modules — the framework's
+#: step-function naming contract (see module docstring)
+STEP_FUNCTION_NAMES = frozenset({
+    "train_step", "eval_step", "decode_step", "prefill",
+})
+
+_JIT_WRAPPERS = frozenset({
+    "jit", "jax.jit", "pjit", "jax.pjit", "jax.pmap", "pmap",
+})
+
+#: method-call syncs on any receiver
+_SYNC_METHODS = frozenset({"item"})
+
+
+def _numpy_aliases(tree: ast.Module) -> set[str]:
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    aliases.add(a.asname or "numpy")
+    return aliases
+
+
+def _partial_target(call: ast.Call) -> ast.AST | None:
+    """``partial(f, ...)`` / ``functools.partial(f, ...)`` → f."""
+    if dotted_name(call.func) in ("partial", "functools.partial") and call.args:
+        return call.args[0]
+    return None
+
+
+def _wrapped_function_name(node: ast.AST) -> str | None:
+    """The bare name of the function being jit-wrapped, if resolvable."""
+    if isinstance(node, ast.Call):
+        inner = _partial_target(node)
+        if inner is not None:
+            return _wrapped_function_name(inner)
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _FunctionIndex(ast.NodeVisitor):
+    """name -> FunctionDef nodes (module, class, and nested scopes; a
+    name maps to every def sharing it — conservative union)."""
+
+    def __init__(self):
+        self.defs: dict[str, list[ast.AST]] = {}
+
+    def visit_FunctionDef(self, node):
+        self.defs.setdefault(node.name, []).append(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync-in-step"
+    summary = ("float()/bool()/.item()/np.asarray()/jax.device_get() "
+               "inside a jit-reachable step/decode function")
+
+    def check_module(self, module: Module,
+                     ctx: LintContext) -> Iterator[Finding]:
+        tree = module.tree
+        index = _FunctionIndex()
+        index.visit(tree)
+        np_aliases = _numpy_aliases(tree)
+
+        roots: set[str] = set()
+        for name, defs in index.defs.items():
+            if name in STEP_FUNCTION_NAMES:
+                roots.add(name)
+            for d in defs:
+                for dec in d.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    dn = dotted_name(target)
+                    if dn in _JIT_WRAPPERS:
+                        roots.add(name)
+                    elif isinstance(dec, ast.Call) and dn in (
+                            "partial", "functools.partial"):
+                        inner = dec.args[0] if dec.args else None
+                        if dotted_name(inner) in _JIT_WRAPPERS:
+                            roots.add(name)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and dotted_name(node.func) in _JIT_WRAPPERS and node.args:
+                wrapped = _wrapped_function_name(node.args[0])
+                if wrapped and wrapped in index.defs:
+                    roots.add(wrapped)
+
+        # transitive closure over bare-name calls within the module
+        reachable: set[str] = set()
+        frontier = sorted(roots & set(index.defs))
+        while frontier:
+            name = frontier.pop()
+            if name in reachable:
+                continue
+            reachable.add(name)
+            for d in index.defs[name]:
+                for node in ast.walk(d):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Name) \
+                            and node.func.id in index.defs \
+                            and node.func.id not in reachable:
+                        frontier.append(node.func.id)
+
+        seen_lines: set[tuple[int, int]] = set()
+        for name in sorted(reachable):
+            for d in index.defs[name]:
+                for node in ast.walk(d):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    hit = self._sync_kind(node, np_aliases)
+                    if hit is None:
+                        continue
+                    key = (node.lineno, node.col_offset)
+                    if key in seen_lines:
+                        continue  # defs overlap when nested
+                    seen_lines.add(key)
+                    yield Finding(
+                        self.name, module.path, node.lineno,
+                        node.col_offset,
+                        f"{hit} inside jit-reachable function "
+                        f"{name!r} forces a host sync (or a trace-time "
+                        f"concretization error); compute it with jnp "
+                        f"on-device or move it outside the jitted step",
+                    )
+
+    @staticmethod
+    def _sync_kind(call: ast.Call, np_aliases: set[str]) -> str | None:
+        dn = dotted_name(call.func)
+        if dn in ("float", "bool") and call.args:
+            if all(isinstance(a, ast.Constant) for a in call.args):
+                return None  # float("inf") etc: static config, no sync
+            return f"{dn}() on a traced value"
+        if dn in ("jax.device_get", "device_get"):
+            return "jax.device_get()"
+        if dn is not None and "." in dn:
+            head, _, method = dn.rpartition(".")
+            if method == "asarray" and head.split(".")[0] in np_aliases | {"np"}:
+                return f"{dn}() (numpy materializes the device array)"
+            if method == "array" and head.split(".")[0] in np_aliases | {"np"}:
+                return f"{dn}() (numpy materializes the device array)"
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _SYNC_METHODS and not call.args:
+            return f".{call.func.attr}()"
+        return None

@@ -1,0 +1,188 @@
+"""lock-discipline — every access to a lock-guarded attribute holds it.
+
+The repo's concurrency story is a handful of small critical sections:
+the flight recorder's ring (emitters on the watchdog thread, manifest
+stampers, the train loop), the metrics registry's table (merge vs
+snapshot — the torn-sum bug PR 6 fixed), the watchdog's beat/stall
+flag pair, the JSONL logger's file handle. Each class owns a
+``self._lock``; the invariant is that an attribute *mutated* under that
+lock is never touched outside it.
+
+Inference (per class that assigns ``self.<name> = threading.Lock()`` /
+``RLock()``):
+
+- **Guarded set** = self-attributes mutated inside a ``with
+  self.<lock>:`` block in any method other than ``__init__`` —
+  mutation meaning assignment / augmented assignment / deletion,
+  a subscript store (``self._metrics[k] = v``), or a call of a known
+  mutator method (``append``, ``clear``, ``set``, ``inc``, ``write``,
+  …) on the attribute.
+- **Violation** = ANY access (read or write) to a guarded attribute
+  outside such a block — a lock-free read of merge-mutated state is
+  exactly how ``Registry.snapshot`` tore.
+
+Exemptions: ``__init__`` (single-threaded construction), and methods
+whose names end in ``_unlocked`` / ``_locked`` — the repo's documented
+convention for helpers that require the caller to hold the lock
+(``Registry._snapshot_unlocked``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, LintContext, Module, Rule, dotted_name, register
+
+#: method names that mutate their receiver (dict/list/deque/set plus
+#: the obs metric verbs and file-handle writes)
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "inc", "dec", "set", "observe", "reset", "merge_from",
+    "write", "put", "put_nowait",
+})
+
+_LOCK_CTORS = frozenset({
+    "threading.Lock", "threading.RLock", "Lock", "RLock",
+})
+
+_EXEMPT_METHODS = frozenset({"__init__", "__del__", "__repr__"})
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.X`` (one level) → ``X``."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _with_holds_lock(node: ast.With, lock_names: set[str]) -> bool:
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            expr = expr.func  # e.g. a lock factory; keep the chain
+        attr = _self_attr(expr)
+        if attr is not None and attr in lock_names:
+            return True
+    return False
+
+
+class _Access:
+    __slots__ = ("attr", "write", "locked", "node", "method")
+
+    def __init__(self, attr, write, locked, node, method):
+        self.attr = attr
+        self.write = write
+        self.locked = locked
+        self.node = node
+        self.method = method
+
+
+class _ClassScanner(ast.NodeVisitor):
+    """Collect every self-attribute access in a class body, annotated
+    with write-ness and whether a ``with self.<lock>`` encloses it."""
+
+    def __init__(self, lock_names: set[str]):
+        self.lock_names = lock_names
+        self.accesses: list[_Access] = []
+        self._method = ""
+        self._lock_depth = 0
+
+    def scan(self, cls: ast.ClassDef) -> list[_Access]:
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._method = stmt.name
+                self._lock_depth = 0
+                self.visit(stmt)
+        return self.accesses
+
+    def visit_With(self, node: ast.With):
+        held = _with_holds_lock(node, self.lock_names)
+        if held:
+            self._lock_depth += 1
+        self.generic_visit(node)
+        if held:
+            self._lock_depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    def _record(self, attr: str, write: bool, node: ast.AST):
+        if attr in self.lock_names:
+            return  # the lock itself is touched to be taken
+        self.accesses.append(_Access(
+            attr, write, self._lock_depth > 0, node, self._method))
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _self_attr(node)
+        if attr is not None:
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self._record(attr, write, node)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        attr = _self_attr(node.value)
+        if attr is not None and isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._record(attr, True, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        # self.X.mutator(...) mutates X
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in MUTATOR_METHODS:
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                self._record(attr, True, node)
+        self.generic_visit(node)
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    summary = ("an attribute mutated under self._lock is accessed "
+               "outside a with-lock block")
+
+    def check_module(self, module: Module,
+                     ctx: LintContext) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(node, module)
+
+    def _check_class(self, cls: ast.ClassDef,
+                     module: Module) -> Iterator[Finding]:
+        lock_names: set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and dotted_name(node.value.func) in _LOCK_CTORS:
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        lock_names.add(attr)
+        if not lock_names:
+            return
+
+        accesses = _ClassScanner(lock_names).scan(cls)
+        guarded = {
+            a.attr for a in accesses
+            if a.write and a.locked and a.method not in _EXEMPT_METHODS
+        }
+        if not guarded:
+            return
+        for a in accesses:
+            if a.attr not in guarded or a.locked:
+                continue
+            if a.method in _EXEMPT_METHODS \
+                    or a.method.endswith(("_unlocked", "_locked")):
+                continue
+            verb = "written" if a.write else "read"
+            yield Finding(
+                self.name, module.path, a.node.lineno, a.node.col_offset,
+                f"self.{a.attr} is {verb} in {cls.name}.{a.method} "
+                f"without holding self.{sorted(lock_names)[0]}, but is "
+                f"mutated under that lock elsewhere — a lock-free "
+                f"access can observe (or cause) a torn update; wrap it "
+                f"in `with self.{sorted(lock_names)[0]}:` or rename the "
+                f"helper *_unlocked if the caller holds the lock",
+            )

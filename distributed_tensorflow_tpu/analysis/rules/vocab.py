@@ -1,0 +1,236 @@
+"""closed-vocab — emitted names must come from the declared vocabularies.
+
+The framework keeps several CLOSED vocabularies whose whole value is
+that code, validators, and docs can never drift: the flight-recorder
+event kinds (``obs/flightrec.EVENT_KINDS`` — ``emit`` rejects unknowns
+at runtime, but only when the line actually executes), the goodput
+waste causes (``obs/goodput.WASTE_CAUSES``), the metric-name tables in
+``docs/observability.md``, and the FLOPs contract's single ×3
+multiplier site (``obs/goodput.train_mfu`` — the generalization of
+tests/test_flops_contract.py into the lint layer). This rule checks all
+of them statically, so a typo'd event kind in a rarely-taken error path
+fails CI instead of raising mid-postmortem.
+
+Checks:
+
+- ``<flightrec>.emit("<kind>", ...)`` — a literal kind must be in
+  ``EVENT_KINDS`` (receivers recognized by the repo's naming idiom:
+  ``self.flightrec`` / ``rec`` / ``recorder`` / ``default_recorder()``).
+- ``note_wasted("<cause>", ...)`` — a literal cause must be in
+  ``WASTE_CAUSES``.
+- registry registrations ``.counter/.gauge/.histogram("<name>", ...)``
+  inside the package (tools and tests excluded — smoke checks register
+  scratch names) must appear in the ``docs/observability.md`` tables;
+  names bound through module-level string constants are resolved.
+- every ``EVENT_KINDS`` entry must appear in ``docs/observability.md``
+  (the event table is part of the vocabulary's contract).
+- ``train_flops_multiplier()`` is called from exactly one site:
+  ``obs/goodput.py`` (the shared ``train_mfu``). Any other call site
+  re-applies the ×3 multiplier and double-counts MFU.
+
+Vocabularies are extracted by PARSING the framework sources (no
+imports), so the linter stays stdlib-only and lints the tree it is
+looking at.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import (
+    Finding, LintContext, Module, Rule, call_name, dotted_name, register,
+)
+
+#: the one module allowed to call train_flops_multiplier()
+MFU_SITE = "distributed_tensorflow_tpu/obs/goodput.py"
+
+_FLIGHTREC_RECEIVERS = frozenset({"flightrec", "rec", "recorder"})
+
+_DOCS_NAME_RE = re.compile(r"`([A-Za-z_][A-Za-z0-9_:]*)")
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def _load_vocab(ctx: LintContext) -> dict:
+    """Parse the framework vocabularies once per lint run."""
+    if "vocab" in ctx.scratch:
+        return ctx.scratch["vocab"]
+    vocab = {"event_kinds": None, "waste_causes": None, "docs_names": None}
+
+    src = ctx.read_repo_file("distributed_tensorflow_tpu/obs/flightrec.py")
+    if src:
+        vocab["event_kinds"] = _string_tuple_constant(src, "EVENT_KINDS")
+
+    src = ctx.read_repo_file("distributed_tensorflow_tpu/obs/goodput.py")
+    if src:
+        causes = []
+        for node in ast.parse(src).body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.startswith("WASTE_")
+                    and node.targets[0].id != "WASTE_CAUSES"
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                causes.append(node.value.value)
+        vocab["waste_causes"] = frozenset(causes) if causes else None
+
+    docs = ctx.read_repo_file("docs/observability.md")
+    if docs:
+        vocab["docs_names"] = frozenset(_DOCS_NAME_RE.findall(docs))
+
+    ctx.scratch["vocab"] = vocab
+    return vocab
+
+
+def _string_tuple_constant(src: str, name: str) -> frozenset[str] | None:
+    for node in ast.parse(src).body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            vals = [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+            return frozenset(vals)
+    return None
+
+
+def _is_flightrec_receiver(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        dn = dotted_name(node.func)
+        return dn is not None and dn.rpartition(".")[2] == "default_recorder"
+    dn = dotted_name(node)
+    if dn is None:
+        return False
+    return dn.rpartition(".")[2] in _FLIGHTREC_RECEIVERS
+
+
+def _in_package(module: Module, ctx: LintContext) -> bool:
+    p = _norm(module.path)
+    return ("distributed_tensorflow_tpu/" in p or
+            p.startswith("distributed_tensorflow_tpu")) \
+        and "/analysis/" not in p
+
+
+@register
+class ClosedVocabRule(Rule):
+    name = "closed-vocab"
+    summary = ("flight-recorder kinds, waste causes, metric names, and "
+               "the single MFU-multiplier site must match the declared "
+               "vocabularies")
+
+    def check_module(self, module: Module,
+                     ctx: LintContext) -> Iterator[Finding]:
+        vocab = _load_vocab(ctx)
+        constants = module.constant_strings()
+        sites = ctx.scratch.setdefault("mfu_sites", [])
+        in_pkg = _in_package(module, ctx)
+        if _norm(module.path).endswith("obs/flightrec.py"):
+            ctx.scratch["flightrec_module"] = module.path
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = call_name(node)
+
+            if dn is not None \
+                    and dn.rpartition(".")[2] == "train_flops_multiplier":
+                sites.append((module.path, node.lineno, node.col_offset))
+
+            # flight-recorder event kinds
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "emit" \
+                    and _is_flightrec_receiver(node.func.value) \
+                    and node.args:
+                kind = self._literal(node.args[0], constants)
+                kinds = vocab["event_kinds"]
+                if kind is not None and kinds and kind not in kinds:
+                    yield Finding(
+                        self.name, module.path, node.lineno,
+                        node.col_offset,
+                        f"flight-recorder event kind {kind!r} is not in "
+                        f"obs/flightrec.EVENT_KINDS — emit() will raise "
+                        f"at runtime; extend the closed vocabulary (and "
+                        f"the docs/observability.md event table) to add "
+                        f"a kind",
+                    )
+
+            # goodput waste causes
+            if dn is not None and dn.rpartition(".")[2] == "note_wasted" \
+                    and node.args:
+                cause = self._literal(node.args[0], constants)
+                causes = vocab["waste_causes"]
+                if cause is not None and causes and cause not in causes:
+                    yield Finding(
+                        self.name, module.path, node.lineno,
+                        node.col_offset,
+                        f"waste cause {cause!r} is not in "
+                        f"obs/goodput.WASTE_CAUSES — note_wasted() will "
+                        f"raise at runtime",
+                    )
+
+            # metric registrations vs the docs tables (package only)
+            if in_pkg and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("counter", "gauge", "histogram") \
+                    and node.args:
+                mname = self._literal(node.args[0], constants)
+                docs = vocab["docs_names"]
+                if mname is not None and docs and mname not in docs:
+                    yield Finding(
+                        self.name, module.path, node.lineno,
+                        node.col_offset,
+                        f"metric {mname!r} is registered in the package "
+                        f"but absent from docs/observability.md — the "
+                        f"metric tables are the closed vocabulary; "
+                        f"document the metric (or fix the name)",
+                    )
+
+    @staticmethod
+    def _literal(node: ast.AST, constants: dict[str, str]) -> str | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return constants.get(node.id)
+        return None
+
+    def finalize(self, ctx: LintContext) -> Iterator[Finding]:
+        vocab = _load_vocab(ctx)
+
+        # single ×3 multiplier site
+        sites = ctx.scratch.get("mfu_sites", [])
+        goodput_sites = [s for s in sites if _norm(s[0]).endswith(MFU_SITE)]
+        for path, line, col in sites:
+            if not _norm(path).endswith(MFU_SITE):
+                yield Finding(
+                    self.name, path, line, col,
+                    "train_flops_multiplier() called outside "
+                    "obs/goodput.py — the fwd+bwd ×3 multiplier has "
+                    "exactly ONE site (goodput.train_mfu); route MFU "
+                    "math through it or bench/log/scrape numbers will "
+                    "disagree",
+                )
+        for path, line, col in goodput_sites[1:]:
+            yield Finding(
+                self.name, path, line, col,
+                "train_flops_multiplier() called more than once in "
+                "obs/goodput.py — the multiplier contract is one "
+                "application per MFU computation, in train_mfu only",
+            )
+
+        # every EVENT_KIND documented
+        fr_path = ctx.scratch.get("flightrec_module")
+        kinds = vocab["event_kinds"]
+        docs = vocab["docs_names"]
+        if fr_path and kinds and docs:
+            for kind in sorted(kinds - docs):
+                yield Finding(
+                    self.name, fr_path, 1, 0,
+                    f"EVENT_KINDS entry {kind!r} is missing from the "
+                    f"docs/observability.md event table — the closed "
+                    f"vocabulary and its docs must move together",
+                )

@@ -151,10 +151,16 @@ class JsonlLogger:
         self._lock = threading.Lock()
 
     def event(self, kind: str, **fields: Any) -> None:
-        if self._fh is None:
-            return
+        if not self.enabled:
+            return  # non-chief: skip the encode entirely
         rec = {"t": round(self.clock(), 6), "event": kind, **fields}
+        # the enabled-check belongs inside the critical section: close()
+        # nulls the handle under the same lock, so an event racing a
+        # close is either fully written or cleanly dropped — never a
+        # write on a closed file (dtflint: lock-discipline)
         with self._lock:
+            if self._fh is None:
+                return
             self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
             self._fh.flush()
 
@@ -163,9 +169,10 @@ class JsonlLogger:
         self.event("snapshot", metrics=self.registry.snapshot(), **fields)
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self):
         return self

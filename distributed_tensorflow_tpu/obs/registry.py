@@ -265,9 +265,12 @@ class Registry:
             return self._sorted_unlocked()
 
     def get(self, name: str, **labels) -> _Metric | None:
-        return self._metrics.get(
-            (name, tuple(sorted(labels.items())))
-        )
+        # under the lock: merge() may be inserting adopted metrics into
+        # the table concurrently (dtflint: lock-discipline)
+        with self._lock:
+            return self._metrics.get(
+                (name, tuple(sorted(labels.items())))
+            )
 
     def total(self, name: str) -> float:
         """Sum a metric family across ALL label sets — e.g.

@@ -300,8 +300,12 @@ class Watchdog(Callback):
             "times a train step exceeded the watchdog wall budget")
 
     def on_train_start(self, trainer):
-        self._beat = self.clock()
-        self._m_stalled.set(0.0)
+        # same critical section as on_step_end/_watch: a supervised
+        # restart re-enters here while a previous attempt's poll thread
+        # may still be draining (dtflint: lock-discipline)
+        with self._lock:
+            self._beat = self.clock()
+            self._m_stalled.set(0.0)
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._watch, daemon=True, name="train-watchdog")

@@ -52,11 +52,24 @@ def read_probe_cache(ttl_s: float) -> bool | None:
     :func:`write_probe_cache`; the driver-invoked bench must not burn a
     scarce healthy window re-deriving what the watcher just measured
     (VERDICT r4 weak #1), nor hang 150 s re-discovering a dead relay.
+
+    Ownership gate (ADVICE r5): the default cache lives in
+    world-writable /tmp, so a verdict is only believed when the file is
+    owned by this uid — any other user (or stray process) writing
+    ``{"healthy": false}`` could otherwise silently pin every bench to
+    CPU for ``ttl_s`` (a poisoned DOWN is believed outright; a stale
+    HEALTHY is at least confirm-probed). Foreign-owned caches read as
+    "no cache", which falls through to a real probe.
     """
     import json
+    import os
 
     try:
         with open(probe_cache_path()) as f:
+            # fstat the open handle, not the path: no window for a swap
+            # between the ownership check and the read
+            if os.fstat(f.fileno()).st_uid != os.getuid():
+                return None
             rec = json.load(f)
         age = time.time() - float(rec["ts"])
         if 0 <= age <= ttl_s:

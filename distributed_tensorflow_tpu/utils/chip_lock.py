@@ -36,10 +36,18 @@ lease needs the same exclusion made explicit.
 from __future__ import annotations
 
 import os
+import time
 
-__all__ = ["lock_path", "lock_holder", "pin_cpu_if_locked"]
+__all__ = ["lock_path", "lock_holder", "pin_cpu_if_locked",
+           "pin_is_current", "PIN_MAX_AGE_S"]
 
 _DEFAULT_LOCK = "/tmp/dtf_chip_session.lock"
+
+#: how long a CPU-pin stamp inherited from an ANCESTOR process is still
+#: believed to describe a live session (ADVICE r5: DTF_CHIP_PINNED
+#: propagates to descendants indefinitely). Generously above the ~41-min
+#: window the on-chip tiering runs in.
+PIN_MAX_AGE_S = 3600.0
 
 
 def lock_path() -> str:
@@ -123,6 +131,29 @@ def lock_holder(_retry: bool = True) -> int | None:
     return pid
 
 
+def pin_is_current(max_age_s: float = PIN_MAX_AGE_S) -> bool:
+    """Is the inherited CPU-pin stamp still evidence of a live chip
+    session?
+
+    True when :func:`pin_cpu_if_locked` pinned THIS process (the
+    decision and its consumer share a lifetime), or when an ancestor's
+    pin is younger than ``max_age_s``. A sweep driver pinned during a
+    session that spawns a bench child hours after the session ended
+    must NOT stamp ``chip_session_live`` on that child's row (ADVICE
+    r5) — its stale stamp reads False here. A pre-timestamp stamp
+    (legacy ``DTF_CHIP_PINNED=1`` with no ``_AT``) from another process
+    is treated as stale for the same reason."""
+    if os.environ.get("DTF_CHIP_PINNED") != "1":
+        return False
+    if os.environ.get("DTF_CHIP_PINNED_PID") == str(os.getpid()):
+        return True  # we made the pin decision ourselves, this run
+    try:
+        age = time.time() - float(os.environ["DTF_CHIP_PINNED_AT"])
+    except (KeyError, ValueError):
+        return False
+    return 0 <= age <= max_age_s
+
+
 def pin_cpu_if_locked(log=None) -> bool:
     """Pin this process to the CPU backend when a live chip session owns
     the lease. Must run before the first backend init to take effect
@@ -146,8 +177,13 @@ def pin_cpu_if_locked(log=None) -> bool:
     # Record WHY this process tree is CPU-pinned, at the moment the
     # decision is made: consumers (bench.py's chip_session_live stamp)
     # must not re-probe the lock later — the session can start/stop in
-    # between and flip the answer (review r5).
+    # between and flip the answer (review r5). The deciding pid and a
+    # timestamp ride along so long-lived process trees can bound the
+    # stamp's validity (pin_is_current, ADVICE r5): the env var itself
+    # is inherited by every descendant forever.
     os.environ["DTF_CHIP_PINNED"] = "1"
+    os.environ["DTF_CHIP_PINNED_PID"] = str(os.getpid())
+    os.environ["DTF_CHIP_PINNED_AT"] = repr(time.time())
     # Children too: a fresh interpreter ignores the env pin (the axon
     # sitecustomize overrides it — see tools/chip_session.sh), so also
     # drop the bootstrap gate from anything this process spawns.

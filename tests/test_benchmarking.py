@@ -116,6 +116,38 @@ def test_probe_cache_roundtrip_and_ttl(tmp_path, monkeypatch):
     assert bm.read_probe_cache(300) is None  # unreadable
 
 
+def test_probe_cache_foreign_owner_not_trusted(tmp_path, monkeypatch):
+    """ADVICE r5: the cache default lives in world-writable /tmp, so a
+    verdict from a file this uid does not own must read as NO cache —
+    a poisoned DOWN written by another user would otherwise pin every
+    bench to CPU for the whole TTL without a single probe."""
+    monkeypatch.setenv("DTF_PROBE_CACHE", str(tmp_path / "probe.json"))
+    bm.write_probe_cache(False)
+    assert bm.read_probe_cache(300) is False  # own file: believed
+
+    # simulate "the file belongs to someone else" by shifting our
+    # apparent uid — equivalent to a poisoned file another user wrote
+    real_uid = os.getuid()
+    monkeypatch.setattr(os, "getuid", lambda: real_uid + 1)
+    assert bm.read_probe_cache(300) is None  # foreign DOWN: not believed
+    monkeypatch.setattr(os, "getuid", lambda: real_uid)
+    bm.write_probe_cache(True)
+    monkeypatch.setattr(os, "getuid", lambda: real_uid + 1)
+    assert bm.read_probe_cache(300) is None  # foreign HEALTHY: same rule
+
+
+def test_foreign_down_cache_falls_through_to_probe(probe_env, monkeypatch):
+    """The poisoned-DOWN scenario end-to-end: with a foreign-owned DOWN
+    verdict on disk, the ladder probes for real instead of pinning CPU
+    sight-unseen."""
+    calls = probe_env([True])
+    bm.write_probe_cache(False)
+    real_uid = os.getuid()
+    monkeypatch.setattr(os, "getuid", lambda: real_uid + 1)
+    assert bm.fall_back_to_cpu_if_unreachable(timeout_s=90) is False
+    assert calls == [90]  # full-budget probe ran; verdict not pre-trusted
+
+
 def test_fresh_down_cache_skips_probe_entirely(probe_env):
     calls = probe_env([True])  # would report healthy if ever consulted
     bm.write_probe_cache(False)

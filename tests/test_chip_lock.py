@@ -178,6 +178,68 @@ def test_chip_session_sh_mutual_exclusion(tmp_path):
         first.wait()
 
 
+def test_pin_stamp_records_pid_and_timestamp(tmp_path, monkeypatch):
+    """pin_cpu_if_locked must stamp WHO decided and WHEN alongside
+    DTF_CHIP_PINNED, so descendants can bound the stamp's validity
+    (ADVICE r5 — the env var itself is inherited forever)."""
+    lock = tmp_path / "chip.lock"
+    holder = _spawn_sleeper()
+    try:
+        lock.write_text(str(holder.pid))
+        monkeypatch.setenv("DTF_CHIP_LOCK", str(lock))
+        monkeypatch.delenv("DTF_CHIP_SESSION", raising=False)
+        for var in ("DTF_CHIP_PINNED", "DTF_CHIP_PINNED_PID",
+                    "DTF_CHIP_PINNED_AT"):
+            monkeypatch.delenv(var, raising=False)
+        before = time.time()
+        assert chip_lock.pin_cpu_if_locked(log=lambda s: None)
+        assert os.environ["DTF_CHIP_PINNED"] == "1"
+        assert os.environ["DTF_CHIP_PINNED_PID"] == str(os.getpid())
+        assert before <= float(os.environ["DTF_CHIP_PINNED_AT"]) <= time.time()
+        assert chip_lock.pin_is_current()  # we pinned ourselves
+    finally:
+        holder.kill()
+        holder.wait()
+        # this test mutates the global jax platform pin; restore the rig
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def test_pin_is_current_bounds_inherited_stamps(monkeypatch):
+    """An ancestor's pin stamp is believed only while fresh: a bench
+    child spawned after the session ended must not inherit the
+    chip_session_live claim indefinitely."""
+    monkeypatch.delenv("DTF_CHIP_PINNED", raising=False)
+    assert not chip_lock.pin_is_current()  # never pinned
+
+    monkeypatch.setenv("DTF_CHIP_PINNED", "1")
+    monkeypatch.setenv("DTF_CHIP_PINNED_PID", str(os.getpid()))
+    monkeypatch.delenv("DTF_CHIP_PINNED_AT", raising=False)
+    assert chip_lock.pin_is_current()  # own-pid stamp: always current
+
+    other_pid = str(os.getpid() + 1)
+    monkeypatch.setenv("DTF_CHIP_PINNED_PID", other_pid)
+    monkeypatch.setenv("DTF_CHIP_PINNED_AT", repr(time.time()))
+    assert chip_lock.pin_is_current()  # fresh ancestor stamp
+
+    monkeypatch.setenv(
+        "DTF_CHIP_PINNED_AT",
+        repr(time.time() - chip_lock.PIN_MAX_AGE_S - 60),
+    )
+    assert not chip_lock.pin_is_current()  # stale ancestor stamp
+
+    monkeypatch.setenv("DTF_CHIP_PINNED_AT",
+                       repr(time.time() + 7200))  # clock skew: future
+    assert not chip_lock.pin_is_current()
+
+    # legacy stamp (no timestamp) from another process: treated stale
+    monkeypatch.delenv("DTF_CHIP_PINNED_AT", raising=False)
+    assert not chip_lock.pin_is_current()
+    monkeypatch.setenv("DTF_CHIP_PINNED_AT", "yesterday-ish")
+    assert not chip_lock.pin_is_current()
+
+
 def test_unheld_flock_sidecar_means_stale(tmp_path, monkeypatch):
     # SIGKILL'd session (or pid recycled to an unrelated live process):
     # the flock sidecar exists but nobody holds the kernel lock, so the

@@ -1,0 +1,421 @@
+"""dtflint — every rule: positive fixture (detected, right file:line,
+right rule id), negative fixture (clean code passes), suppression
+fixture (marker silences it); plus the CLI exit-code contract and the
+shipped-tree-is-clean acceptance gate."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from distributed_tensorflow_tpu.analysis import (
+    RULES, Finding, lint_paths, lint_sources,
+)
+from distributed_tensorflow_tpu.analysis import fixtures
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "tools", "dtf_lint.py")
+
+ALL_RULES = sorted(RULES)
+
+
+def lint_snippet(src, path="snippet.py", rules=None):
+    return lint_sources({path: textwrap.dedent(src)}, rules=rules)
+
+
+# ---- the shipped fixture corpus ----------------------------------------
+
+
+def test_every_rule_ships_all_three_fixtures():
+    for rule in ALL_RULES:
+        assert rule in fixtures.POSITIVE, rule
+        assert rule in fixtures.NEGATIVE, rule
+        assert rule in fixtures.SUPPRESSED, rule
+
+
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_positive_fixture_fires_at_marked_line(rule):
+    src = fixtures.POSITIVE[rule]
+    want_line = fixtures.expected_line(src)
+    found = lint_sources({f"pos_{rule}.py": src})
+    assert found, f"{rule}: positive fixture produced nothing"
+    assert all(f.rule == rule for f in found), found
+    assert any(f.line == want_line for f in found), (
+        f"{rule}: fired at {[f.line for f in found]}, want {want_line}")
+    # findings carry the path they were given (file:line anchoring)
+    assert all(f.path == f"pos_{rule}.py" for f in found)
+
+
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_negative_fixture_is_clean(rule):
+    found = lint_sources({"neg.py": fixtures.NEGATIVE[rule]})
+    assert found == [], [f.format() for f in found]
+
+
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_suppression_comment_silences(rule):
+    found = lint_sources({"sup.py": fixtures.SUPPRESSED[rule]})
+    assert found == [], [f.format() for f in found]
+
+
+def test_file_level_suppression():
+    src = ("# dtflint: disable-file=exception-hygiene\n"
+           + fixtures.POSITIVE["exception-hygiene"])
+    assert lint_sources({"f.py": src}) == []
+
+
+def test_self_check_green():
+    assert fixtures.self_check() == []
+
+
+# ---- rule-specific behaviors beyond the basic corpus -------------------
+
+
+def test_host_sync_step_name_convention():
+    # train_step is jitted by a factory in ANOTHER module; the naming
+    # convention must make it reachable without a local jax.jit
+    found = lint_snippet(
+        """
+        import numpy as onp
+
+        def train_step(state, batch):
+            host = onp.asarray(batch["x"])
+            return state, {"x": host}
+        """,
+        rules=["host-sync-in-step"],
+    )
+    assert len(found) == 1 and found[0].rule == "host-sync-in-step"
+    assert "asarray" in found[0].message
+
+
+def test_host_sync_transitive_helper_and_item():
+    found = lint_snippet(
+        """
+        import jax
+
+        def helper(x):
+            return x.mean().item()
+
+        @jax.jit
+        def decode(tokens):
+            return helper(tokens)
+
+        fast = jax.jit(decode)
+        """,
+        rules=["host-sync-in-step"],
+    )
+    # helper is reachable from the jitted decode
+    assert [f.rule for f in found] == ["host-sync-in-step"]
+    assert ".item()" in found[0].message
+
+
+def test_host_sync_float_on_constant_is_static_config():
+    found = lint_snippet(
+        """
+        import jax
+
+        @jax.jit
+        def train_step(state, batch):
+            eps = float("1e-6")
+            return state, eps
+        """,
+        rules=["host-sync-in-step"],
+    )
+    assert found == []
+
+
+def test_donation_framework_factory_convention():
+    # jit_prefill's donate_argnums lives in serve/decode.py — the rule
+    # must know the factory contract without seeing that module
+    found = lint_snippet(
+        """
+        from distributed_tensorflow_tpu.serve import decode as decode_lib
+
+        class Eng:
+            def __init__(self, model):
+                self._prefill = decode_lib.jit_prefill(model)
+
+            def bad(self, params, cache, toks):
+                logits, new_cache = self._prefill(params, cache, 0, toks, 3)
+                stale = cache.k  # the donated pytree
+                return logits, stale
+        """,
+        rules=["donation-after-use"],
+    )
+    assert len(found) == 1
+    assert "'cache'" in found[0].message
+
+
+def test_donation_same_line_rebind_is_clean():
+    found = lint_snippet(
+        """
+        import jax
+
+        def _step(s, b):
+            return s
+
+        step = jax.jit(_step, donate_argnums=(0,))
+
+        class T:
+            def fit(self, batch):
+                self.state, metrics = step(self.state, batch)
+                return self.state
+        """,
+        rules=["donation-after-use"],
+    )
+    assert found == []
+
+
+def test_lock_discipline_prefix_registry_get_regression():
+    # the exact pre-fix Registry.get shape: lock-free dict read while
+    # merge() inserts under the lock (fixed in this PR)
+    found = lint_snippet(
+        """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._metrics = {}
+                self._lock = threading.Lock()
+
+            def register(self, key, m):
+                with self._lock:
+                    self._metrics[key] = m
+
+            def get(self, key):
+                return self._metrics.get(key)
+        """,
+        rules=["lock-discipline"],
+    )
+    assert len(found) == 1 and "_metrics" in found[0].message
+
+
+def test_lock_discipline_unlocked_helper_convention():
+    found = lint_snippet(
+        """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._metrics = {}
+                self._lock = threading.Lock()
+
+            def register(self, key, m):
+                with self._lock:
+                    self._metrics[key] = m
+
+            def _dump_unlocked(self):
+                return dict(self._metrics)
+
+            def dump(self):
+                with self._lock:
+                    return self._dump_unlocked()
+        """,
+        rules=["lock-discipline"],
+    )
+    assert found == []
+
+
+def test_vocab_metric_name_must_be_documented():
+    path = "distributed_tensorflow_tpu/serve/fake_engine.py"
+    found = lint_sources({path: textwrap.dedent(
+        """
+        class E:
+            def __init__(self, r):
+                self._m = r.counter("serve_undocumented_total", "nope")
+        """
+    )}, rules=["closed-vocab"])
+    assert len(found) == 1 and "docs/observability.md" in found[0].message
+    # the same registration OUTSIDE the package (tools, tests) is fine:
+    # smoke checks register scratch names
+    assert lint_sources({"tools/fake_check.py": textwrap.dedent(
+        """
+        def main(r):
+            r.counter("scratch_smoke_total", "x").inc()
+        """
+    )}, rules=["closed-vocab"]) == []
+
+
+def test_vocab_single_mfu_multiplier_site():
+    src = """
+    from distributed_tensorflow_tpu.utils import flops as flops_lib
+
+    def my_mfu(fwd, sps):
+        return fwd * flops_lib.train_flops_multiplier() * sps
+    """
+    found = lint_sources(
+        {"tools/fake_bench.py": textwrap.dedent(src)},
+        rules=["closed-vocab"])
+    assert len(found) == 1 and "ONE site" in found[0].message
+    # the real site is allowed
+    assert lint_sources(
+        {"distributed_tensorflow_tpu/obs/goodput.py": textwrap.dedent(src)},
+        rules=["closed-vocab"]) == []
+
+
+def test_vocab_waste_cause():
+    found = lint_snippet(
+        """
+        from distributed_tensorflow_tpu.obs import goodput
+
+        def lose_time(reg):
+            goodput.note_wasted("bikeshedding", 1.0, registry=reg)
+        """,
+        rules=["closed-vocab"],
+    )
+    assert len(found) == 1 and "WASTE_CAUSES" in found[0].message
+
+
+def test_exception_seam_narrow_silent_flagged():
+    seam = "distributed_tensorflow_tpu/resilience/fake_seam.py"
+    src = """
+    def restore(path):
+        try:
+            return open(path).read()
+        except OSError:
+            pass
+    """
+    found = lint_sources({seam: textwrap.dedent(src)},
+                         rules=["exception-hygiene"])
+    assert len(found) == 1 and "seam" in found[0].message
+    # identical code outside the seams is accepted (best-effort cleanup)
+    assert lint_sources({"distributed_tensorflow_tpu/utils/fake.py":
+                         textwrap.dedent(src)},
+                        rules=["exception-hygiene"]) == []
+
+
+def test_donation_taint_never_crosses_scope_boundaries():
+    # a closure's same-named variable is a DIFFERENT binding, and line
+    # order says nothing about execution order across scopes: exactly
+    # one finding (the inner use-after-donate), nothing on the outer
+    # call that textually follows it
+    found = lint_snippet(
+        """
+        import jax
+
+        def _step(s, b):
+            return s
+
+        step = jax.jit(_step, donate_argnums=(0,))
+
+        def outer(state, batch):
+            def inner(state, batch):
+                new = step(state, batch)
+                print(state.params)
+                return new
+            return inner(state, batch)
+        """,
+        rules=["donation-after-use"],
+    )
+    assert len(found) == 1, [f.format() for f in found]
+    assert found[0].line == 12  # the inner print, once
+
+
+def test_suppression_markers_inside_strings_are_inert():
+    # a disable-file marker in a DOCSTRING must not disarm the rule —
+    # only real comment tokens count (the silent-rot hole otherwise)
+    src = (
+        '"""docs quoting the syntax: # dtflint: disable-file=lock-discipline"""\n'
+        + fixtures.POSITIVE["lock-discipline"]
+    )
+    found = lint_sources({"doc.py": src})
+    assert [f.rule for f in found] == ["lock-discipline"]
+
+
+def test_cli_is_stdlib_only():
+    """The linter must run without the framework: no jax, no numpy, no
+    distributed_tensorflow_tpu package import (whose __init__ pulls
+    both and runs the chip-lock pin side effect)."""
+    code = (
+        "import sys, runpy\n"
+        f"sys.argv = ['dtf_lint.py', '--list-rules']\n"
+        "try:\n"
+        f"    runpy.run_path({LINT!r}, run_name='__main__')\n"
+        "except SystemExit as e:\n"
+        "    assert (e.code or 0) == 0, e.code\n"
+        "for mod in ('jax', 'numpy', 'distributed_tensorflow_tpu'):\n"
+        "    assert mod not in sys.modules, f'linter imported {mod}'\n"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_finding_format_and_json():
+    f = Finding("closed-vocab", "a/b.py", 12, 4, "boom")
+    assert f.format() == "a/b.py:12:4: closed-vocab: boom"
+    assert f.to_json() == {"rule": "closed-vocab", "path": "a/b.py",
+                           "line": 12, "col": 4, "message": "boom"}
+
+
+def test_unknown_rule_name_raises():
+    with pytest.raises(KeyError):
+        lint_snippet("x = 1", rules=["no-such-rule"])
+
+
+# ---- CLI exit-code contract + acceptance gate --------------------------
+
+
+def _run_cli(*args, cwd=REPO):
+    return subprocess.run([sys.executable, LINT, *args],
+                          capture_output=True, text=True, timeout=120,
+                          cwd=cwd)
+
+
+def test_cli_flags_injected_fixture_with_rule_and_location(tmp_path):
+    """The acceptance contract: inject any shipped positive fixture into
+    a linted tree → non-zero exit naming the rule id and file:line."""
+    pkg = tmp_path / "victim"
+    pkg.mkdir()
+    (pkg / "clean.py").write_text("x = 1\n")
+    for rule, src in fixtures.POSITIVE.items():
+        bad = pkg / f"bad_{rule.replace('-', '_')}.py"
+        bad.write_text(src)
+        want_line = fixtures.expected_line(src)
+        proc = _run_cli("--strict", str(pkg))
+        assert proc.returncode == 1, (rule, proc.stdout, proc.stderr)
+        assert f"{bad}:{want_line}" in proc.stdout, (rule, proc.stdout)
+        assert f" {rule}: " in proc.stdout, (rule, proc.stdout)
+        bad.unlink()
+    proc = _run_cli("--strict", str(pkg))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_json_output(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(fixtures.POSITIVE["exception-hygiene"])
+    proc = _run_cli("--json", str(bad))
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload and payload[0]["rule"] == "exception-hygiene"
+    assert payload[0]["line"] == fixtures.expected_line(
+        fixtures.POSITIVE["exception-hygiene"])
+
+
+def test_cli_usage_errors():
+    assert _run_cli().returncode == 2  # no paths
+    assert _run_cli("--rules", "bogus", "tools").returncode == 2
+    assert _run_cli("/no/such/path").returncode == 2
+
+
+def test_cli_self_check_green():
+    proc = _run_cli("--self-check")
+    assert proc.returncode == 0, proc.stderr
+    assert "self-check OK" in proc.stderr
+
+
+def test_shipped_tree_is_clean():
+    """The CI gate's exact invocation must pass on the shipped tree —
+    every violation the new rules found was fixed (or carries a
+    reviewed suppression)."""
+    found = lint_paths([
+        os.path.join(REPO, "distributed_tensorflow_tpu"),
+        os.path.join(REPO, "tools"),
+        os.path.join(REPO, "bench.py"),
+    ])
+    assert found == [], "\n".join(f.format() for f in found)

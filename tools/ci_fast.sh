@@ -5,12 +5,18 @@
 #      (catches package-wide import regressions, ISSUE 1)
 #   2. tools/obs_check.py      — telemetry smoke: registry → Prometheus
 #      exposition render → format lint → JSONL round-trip (ISSUE 2)
-#   3. tools/chaos_smoke.py    — resilience smoke: scheduler
+#   3. tools/dtf_lint.py       — framework-aware static analysis
+#      (ISSUE 7): --self-check first (every rule must still fire on its
+#      shipped fixtures, so the gate cannot rot silently), then the
+#      --strict tree lint (host-sync-in-step, donation-after-use,
+#      lock-discipline, closed-vocab, exception-hygiene must all be
+#      clean over the package, tools, and bench.py)
+#   4. tools/chaos_smoke.py    — resilience smoke: scheduler
 #      timeout/cancel/backpressure invariants + one SIGTERM →
 #      coordinated-save → resume subprocess round (ISSUE 3) + one
 #      supervised SIGTERM + corrupt-newest-checkpoint run that must
 #      recover via fallback restore and finish finite (ISSUE 4)
-#   4. tools/postmortem.py     — flight-recorder gate: the supervised
+#   5. tools/postmortem.py     — flight-recorder gate: the supervised
 #      round's postmortem dump must pass schema validation AND contain
 #      fault → preemption save → restart → quarantine → fallback-restore
 #      in causal order (ISSUE 6)
@@ -20,6 +26,9 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 bash tools/smoke_collect.sh "$@"
 env JAX_PLATFORMS=cpu python tools/obs_check.py >/dev/null
+env JAX_PLATFORMS=cpu python tools/dtf_lint.py --self-check
+env JAX_PLATFORMS=cpu python tools/dtf_lint.py --strict \
+  distributed_tensorflow_tpu tools bench.py
 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 env JAX_PLATFORMS=cpu python tools/postmortem.py \
   "${DTF_CHAOS_POSTMORTEM:-artifacts/chaos_postmortem.jsonl}" --quiet \

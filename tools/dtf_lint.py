@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""dtflint CLI — framework-aware static analysis for this repo.
+
+Mechanically enforces the invariants the PR 1-6 review rounds caught by
+hand (rule catalog + pre-fix examples: docs/static-analysis.md):
+
+    host-sync-in-step    no float()/bool()/.item()/np.asarray()/
+                         device_get on traced values in jit-reachable
+                         step/decode functions
+    donation-after-use   never read a pytree a donate_argnums call
+                         consumed
+    lock-discipline      lock-guarded attributes only under the lock
+    closed-vocab         flightrec kinds / waste causes / metric names
+                         / the single ×3 MFU-multiplier site
+    exception-hygiene    no bare except; no swallowed exceptions in the
+                         retry/supervisor/checkpoint seams
+
+Usage:
+    tools/dtf_lint.py [--strict] [--json] [--rules a,b] PATH [PATH...]
+    tools/dtf_lint.py --list-rules
+    tools/dtf_lint.py --self-check
+
+Exit codes: 0 clean · 1 findings (or failed self-check) · 2 usage error.
+
+``--strict`` additionally turns unparseable files into hard errors
+(default: they are reported on stderr and skipped). ``--self-check``
+proves every rule still fires on its shipped positive fixture, stays
+quiet on the negative and suppressed ones, and — run before the tree
+lint in tools/ci_fast.sh — keeps the gate from rotting silently.
+
+Suppressions: ``# dtflint: disable=<rule>[,<rule>]`` on the flagged
+line or the line above; ``# dtflint: disable-file=<rule>`` anywhere in
+the file.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Load distributed_tensorflow_tpu.analysis WITHOUT importing its
+    parent package: the parent __init__ pulls the whole framework (jax,
+    numpy, every submodule) and runs the chip-lock pin side effect —
+    the analyzer itself is stdlib-only and must stay runnable on a box
+    with neither accelerator stack installed. The package only uses
+    intra-package relative imports, so it loads cleanly under an
+    alias."""
+    name = "dtf_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(_REPO, "distributed_tensorflow_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dtf_lint.py",
+        description="framework-aware static analysis (dtflint)")
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--strict", action="store_true",
+                    help="treat unparseable files as errors")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--self-check", action="store_true",
+                    help="verify every rule fires on its shipped fixtures")
+    args = ap.parse_args(argv)
+
+    analysis = _load_analysis()
+    RULES, lint_paths = analysis.RULES, analysis.lint_paths
+    fixtures = analysis.fixtures
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name:20s} {RULES[name].summary}")
+        return 0
+
+    if args.self_check:
+        failures = fixtures.self_check()
+        for f in failures:
+            print(f"SELF-CHECK FAIL: {f}", file=sys.stderr)
+        if not failures:
+            print(f"dtflint self-check OK: {len(RULES)} rules × "
+                  f"positive/negative/suppressed fixtures", file=sys.stderr)
+        return 1 if failures else 0
+
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("dtf_lint.py: error: no paths given "
+              "(or use --list-rules / --self-check)", file=sys.stderr)
+        return 2
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+
+    parse_errors: list[str] = []
+
+    def on_parse_error(path, exc):
+        parse_errors.append(f"{path}: syntax error: {exc}")
+
+    try:
+        findings = lint_paths(args.paths, rules=rules,
+                              on_parse_error=on_parse_error)
+    except (FileNotFoundError, KeyError) as e:
+        print(f"dtf_lint.py: error: {e}", file=sys.stderr)
+        return 2
+
+    for err in parse_errors:
+        print(err, file=sys.stderr)
+    if args.as_json:
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        if findings:
+            by_rule: dict[str, int] = {}
+            for f in findings:
+                by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+            summary = ", ".join(f"{n} {r}" for r, n in sorted(by_rule.items()))
+            print(f"dtflint: {len(findings)} finding(s): {summary}",
+                  file=sys.stderr)
+        else:
+            print("dtflint: clean", file=sys.stderr)
+    if args.strict and parse_errors:
+        return 1
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

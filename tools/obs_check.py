@@ -126,7 +126,8 @@ def _check_flightrec() -> list[str]:
             f"ring overflow wrong: len={len(rec)} dropped={rec.dropped} "
             f"(want 4/2)")
     try:
-        rec.emit("not_a_kind")
+        # deliberate negative: the closed vocabulary must reject this
+        rec.emit("not_a_kind")  # dtflint: disable=closed-vocab
         failures.append("emit accepted an unknown event kind")
     except ValueError:
         pass
@@ -186,7 +187,8 @@ def _check_goodput(reg) -> list[str]:
         if reg.get(goodput.WASTED_SECONDS, cause=cause) is None:
             failures.append(f"missing wasted_seconds_total{{cause={cause}}}")
     try:
-        goodput.note_wasted("procrastination", 1.0, registry=reg)
+        # deliberate negative: the cause vocabulary must reject this
+        goodput.note_wasted("procrastination", 1.0, registry=reg)  # dtflint: disable=closed-vocab
         failures.append("note_wasted accepted an unknown cause")
     except ValueError:
         pass
