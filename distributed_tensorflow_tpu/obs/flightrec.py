@@ -31,6 +31,7 @@ pure-host tests and from tools that never touch a device.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -42,9 +43,12 @@ __all__ = [
     "SCHEMA",
     "FlightRecorder",
     "default_recorder",
+    "dump_postmortem",
     "validate_dump",
     "contains_in_order",
 ]
+
+logger = logging.getLogger(__name__)
 
 #: dump header schema tag — bump when the record layout changes
 SCHEMA = "dtf-flightrec-1"
@@ -73,6 +77,14 @@ EVENT_KINDS = (
     "sup_exhausted",        # restart budget ran out        {cause, restarts}
     # fault injection (resilience/faults.py)
     "fault_fired",          # a planned fault fired         {fault, step, ...}
+    # fleet control plane (resilience/fleet.py)
+    "fleet_start",          # fleet run begins              {workers, incarnation}
+    "fleet_launch",         # worker subprocess launched    {worker, incarnation, pid}
+    "fleet_worker_dead",    # liveness/exit failure         {worker, cause, detail}
+    "fleet_gang_stop",      # gang torn down                {cause, survivors, killed}
+    "fleet_restart",        # new gang live after restart   {restart, cause, incarnation}
+    "fleet_exhausted",      # fleet restart budget ran out  {cause, restarts}
+    "fleet_done",           # every worker finished         {incarnation}
     # serving (serve/scheduler.py, serve/engine.py)
     "serve_admit",          # request placed into a slot    {uid, slot}
     "serve_evict",          # request left (any reason)     {uid, reason}
@@ -195,6 +207,24 @@ class FlightRecorder:
             n += 1
             path = os.path.join(d, f"{basename}-{n}.jsonl")
         return self.dump(path, reason=reason)
+
+
+def dump_postmortem(recorder: FlightRecorder, directory: str | None,
+                    reason: str = "") -> str | None:
+    """Best-effort ``dump_unique`` for abnormal-exit paths (Supervisor
+    exhaustion, FleetSupervisor exhaustion, …): the whole point of the
+    recorder is this moment, so a dump failure is logged — it must
+    never mask the exception the caller is about to raise. Returns the
+    dump path, or None when there is no directory or the dump failed."""
+    if not directory:
+        return None
+    try:
+        path = recorder.dump_unique(directory, reason=reason)
+    except Exception:
+        logger.exception("flight-recorder postmortem dump failed")
+        return None
+    logger.warning("flight-recorder postmortem dumped to %s", path)
+    return path
 
 
 # ---------------------------------------------------------------------------

@@ -12,7 +12,11 @@ both halves of that story:
 - the recovery machinery itself: a generic retry/backoff executor with
   seeded jitter and obs counters (retry.py), and the in-process training
   Supervisor that classifies failures and restarts `Trainer.fit` from
-  the latest *valid* checkpoint under a restart budget (supervisor.py).
+  the latest *valid* checkpoint under a restart budget (supervisor.py);
+- the cluster-level layer over both: a collective-free, heartbeat-based
+  fleet control plane that supervises worker PROCESSES and turns any
+  classified failure into a coordinated gang restart from the latest
+  common valid checkpoint (fleet.py).
 """
 
 from .faults import (  # noqa: F401
@@ -23,11 +27,34 @@ from .faults import (  # noqa: F401
     FaultClock,
     FaultPlan,
     FaultyIterator,
+    Hang,
     NaNBatch,
     Sigterm,
     TransientIOError,
     corrupt_shard,
     truncate_shard,
+)
+from .fleet import (  # noqa: F401
+    EXIT_FAILED,
+    EXIT_PREEMPTED,
+    FleetConfig,
+    FleetExhausted,
+    FleetSupervisor,
+    Heartbeat,
+    HeartbeatMonitor,
+    HeartbeatWriter,
+    WorkerDead,
+    clear_restore_step,
+    evict_steps_above,
+    heartbeat_path,
+    newest_common_valid_step,
+    newest_valid_step,
+    read_heartbeat,
+    read_incarnation,
+    read_restore_step,
+    valid_steps,
+    write_incarnation,
+    write_restore_step,
 )
 from .retry import (  # noqa: F401
     AttemptTimeout,
@@ -39,9 +66,11 @@ from .supervisor import (  # noqa: F401
     FATAL,
     POISONED,
     PREEMPTION,
+    STALLED,
     TRANSIENT,
     Supervisor,
     SupervisorConfig,
     SupervisorExhausted,
     classify_failure,
 )
+from ..train.callbacks import StalledError  # noqa: F401  (the `stalled` class)

@@ -142,6 +142,26 @@ class ClockStall:
 
 
 @dataclasses.dataclass(frozen=True)
+class Hang:
+    """Hang the host loop after step ``step`` completes: the callback
+    spins in a Python-level sleep loop forever, so heartbeats from the
+    step seam stop while the process stays alive — the
+    missed-heartbeat death the FleetSupervisor must detect, and (with
+    ``advance`` set and a FaultClock wired) the hung-step budget the
+    Watchdog's ``abort_on_stall`` converts into a classified
+    ``StalledError``. A SIGTERM only flags the PreemptionWatcher — the
+    spin never reaches the next save cadence, so only SIGKILL (the
+    fleet's gang-stop escalation) or an async abort ends it
+    (FaultCallback seam)."""
+
+    step: int
+    #: advance the plan's FaultClock by this many seconds once, just
+    #: before spinning — drives a clock-injected Watchdog over budget
+    #: deterministically
+    advance: float | None = None
+
+
+@dataclasses.dataclass(frozen=True)
 class TransientIOError:
     """Raise ``IOError`` from the data iterator fetching the ``batch``-th
     batch (1-based), ``times`` times IN TOTAL across every iterator
@@ -169,8 +189,8 @@ class CorruptCheckpoint:
     nbytes: int = 1
 
 
-Fault = (Sigterm | DataError | NaNBatch | ClockStall | TransientIOError
-         | CorruptCheckpoint)
+Fault = (Sigterm | DataError | NaNBatch | ClockStall | Hang
+         | TransientIOError | CorruptCheckpoint)
 
 
 # ---------------------------------------------------------------------------
@@ -305,6 +325,25 @@ class FaultCallback(Callback):
                     )
                 _record_fault("clock_stall", step=step, dt=fault.dt)
                 self.clock.advance(fault.dt)
+            elif isinstance(fault, Hang) and step >= fault.step:
+                fired.add(i)
+                _record_fault("hang", step=step, advance=fault.advance)
+                if fault.advance is not None:
+                    if self.clock is None:
+                        raise ValueError(
+                            "Hang(advance=...) needs "
+                            "FaultPlan.callback(clock=...)")
+                    self.clock.advance(fault.advance)
+                logger.warning("fault: hanging the host loop after step %d",
+                               step)
+                import time as time_lib
+
+                # Python-level spin: interruptible only by an async
+                # StalledError (Watchdog abort_on_stall) or SIGKILL —
+                # SIGTERM merely flags the PreemptionWatcher and the
+                # loop never reaches its next save cadence
+                while True:
+                    time_lib.sleep(0.05)
 
 
 class FaultyIterator:

@@ -1,0 +1,943 @@
+"""Fleet supervision — multi-process gang orchestration over heartbeats.
+
+The reference's control plane was `tf.train.ClusterSpec` +
+`MonitoredTrainingSession`: a chief that watched worker liveness and
+restarted the session when one died. Our elasticity model is
+checkpoint-restart (train/checkpoint.py: "TPU slices fail whole"), and
+PRs 3-6 built every *in-process* piece of it — fault injection, retry
+budgets, the in-process Supervisor, fallback restore, the flight
+recorder. This module is the missing *cluster-level* layer: a
+collective-free control plane that supervises a fleet of worker
+PROCESSES, so it runs unchanged on the CPU test rig where jaxlib has no
+multiprocess collectives: the control plane uses no collectives and no
+device code — liveness, classification, and the common-checkpoint
+computation are files, signals, and manifest reads.
+
+Protocol (docs/resilience.md "Fleet"):
+
+- **Heartbeats.** Each worker owns one heartbeat file under the fleet
+  dir and rewrites it atomically (tmp + rename — a reader never sees a
+  torn record) with a monotonically increasing ``seq`` plus
+  ``{pid, step, attempt, incarnation, phase}``. Beats come from the
+  production seams that prove real progress: the in-process
+  ``Supervisor`` beats at each attempt boundary and
+  ``train.callbacks.HeartbeatCallback`` beats from the step seam — a
+  hung loop therefore *stops beating*, which is the signal. An optional
+  pulse thread (``pulse_interval_s``) keeps ``seq`` ticking from a
+  daemon thread so the fleet can tell a live-but-stalled process
+  (seq advances, step frozen → ``stalled``) from a dead one (seq frozen
+  → ``dead``).
+- **Incarnations.** The fleet bumps an on-disk incarnation counter
+  before every (re)launch; workers read it at startup and stamp every
+  beat with it. A heartbeat from an older incarnation — freshly written
+  by a straggler the gang-stop hasn't reaped yet — is treated as
+  *absent*, never as liveness.
+- **Gang restart.** Any classified failure (missed heartbeats,
+  exit-code death, stall) tears the whole gang down: SIGTERM the
+  survivors (exercising the coordinated preemption-save path), SIGKILL
+  whatever outlives the grace period, compute the newest checkpoint
+  step EVERY worker can restore (``newest_common_valid_step``, manifest
+  verified), write it as the restore ceiling, bump the incarnation, and
+  relaunch everyone — under a restart budget with the same seeded
+  escalating backoff the in-process Supervisor uses. Exhaustion raises
+  ``FleetExhausted`` and dumps a flight-recorder postmortem.
+
+Failure classification reuses ``classify_failure``: observed failures
+are materialized as the exceptions they represent (``WorkerDead`` for
+liveness/exit deaths → ``transient``, ``StalledError`` for frozen
+steps → ``stalled``) so the fleet and the in-process Supervisor can
+never disagree about taxonomy.
+
+Clocks and sleeps are injectable (``FaultClock`` drop-in) so every
+liveness edge case — stale-but-ticking vs absent vs stale-incarnation —
+is deterministically testable without real processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal as signal_lib
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+from ..obs import flightrec as flightrec_lib
+from ..obs import goodput
+from ..obs.flightrec import FlightRecorder
+from ..obs.registry import Registry, default_registry
+from .retry import RetryPolicy
+from .supervisor import (
+    FATAL, POISONED, PREEMPTION, STALLED, TRANSIENT, classify_failure,
+)
+
+logger = logging.getLogger(__name__)
+
+#: worker exit-code protocol (tests/chaos_worker.py --fleet speaks it):
+#: 0 = reached the target step; EXIT_PREEMPTED = clean coordinated
+#: preemption save (gang-stop SIGTERM, or an injected one); EXIT_FAILED
+#: = the worker's in-process supervision exhausted — the classified
+#: cause rides in the final heartbeat.
+EXIT_PREEMPTED = 75  # EX_TEMPFAIL: try again (from a checkpoint)
+EXIT_FAILED = 76
+
+#: metric names (documented in docs/observability.md)
+FLEET_RESTARTS_TOTAL = "fleet_restarts_total"
+FLEET_WORKER_DEATHS_TOTAL = "fleet_worker_deaths_total"
+
+#: every failure class the fleet may carry / restart on
+_KNOWN_CAUSES = frozenset({TRANSIENT, POISONED, FATAL, PREEMPTION, STALLED})
+
+#: heartbeat phases a worker moves through; "train"/"done"/"preempted"/
+#: "failed" mean the attempt got past build+restore (the gate
+#: fleet_restart waits on before declaring the new gang live)
+_PAST_BUILD_PHASES = ("train", "done", "preempted", "failed")
+
+_INCARNATION_FILE = "INCARNATION"
+_RESTORE_FILE = "RESTORE_STEP"
+
+
+class WorkerDead(OSError):
+    """A fleet worker died without a classified exit: SIGKILL'd,
+    crashed, or stopped heartbeating. Subclasses OSError so
+    ``classify_failure`` maps it to ``transient`` — the process is
+    gone, the state on disk is fine, restart and resume."""
+
+
+class FleetExhausted(RuntimeError):
+    """The fleet restart budget ran out (or the failure class was not
+    restartable). ``cause`` is the classified failure class of the last
+    gang failure."""
+
+    def __init__(self, cause: str, restarts: int, detail: str = ""):
+        super().__init__(
+            f"fleet restart budget exhausted after {restarts} gang "
+            f"restart(s); last failure class {cause!r}"
+            + (f": {detail}" if detail else "")
+        )
+        self.cause = cause
+        self.restarts = restarts
+
+
+# ---------------------------------------------------------------------------
+# On-disk control files (incarnation, restore ceiling)
+# ---------------------------------------------------------------------------
+
+
+def _atomic_write(path: str, text: str) -> None:
+    """tmp + rename so a reader never sees a torn record; no fsync —
+    these files trade durability for freshness (a record lost to a
+    crash IS the signal the protocol detects)."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def heartbeat_path(fleet_dir: str, worker: int) -> str:
+    """The one heartbeat file of worker ``worker`` under the fleet dir —
+    the single definition of the layout, shared by writer and monitor."""
+    return os.path.join(
+        os.path.abspath(os.path.expanduser(fleet_dir)),
+        f"heartbeat-{worker}.json",
+    )
+
+
+def read_incarnation(fleet_dir: str) -> int:
+    """Current fleet incarnation (0 when no fleet has ever run here).
+    Workers call this at startup and stamp every heartbeat with it."""
+    path = os.path.join(
+        os.path.abspath(os.path.expanduser(fleet_dir)), _INCARNATION_FILE)
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except FileNotFoundError:
+        return 0
+    except (OSError, ValueError) as e:
+        logger.warning("unreadable incarnation file %s (%s); assuming 0",
+                       path, e)
+        return 0
+
+
+def write_incarnation(fleet_dir: str, incarnation: int) -> None:
+    d = os.path.abspath(os.path.expanduser(fleet_dir))
+    os.makedirs(d, exist_ok=True)
+    _atomic_write(os.path.join(d, _INCARNATION_FILE), f"{int(incarnation)}\n")
+
+
+def read_restore_step(fleet_dir: str) -> int | None:
+    """Restore ceiling for the current incarnation: workers restore the
+    newest valid step <= this (``init_or_restore(step=...)``), so the
+    whole gang resumes from the same — latest COMMON — checkpoint.
+    None = no ceiling (first incarnation; restore your newest)."""
+    path = os.path.join(
+        os.path.abspath(os.path.expanduser(fleet_dir)), _RESTORE_FILE)
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        logger.warning("unreadable restore-step file %s (%s); no ceiling",
+                       path, e)
+        return None
+
+
+def write_restore_step(fleet_dir: str, step: int) -> None:
+    d = os.path.abspath(os.path.expanduser(fleet_dir))
+    os.makedirs(d, exist_ok=True)
+    _atomic_write(os.path.join(d, _RESTORE_FILE), f"{int(step)}\n")
+
+
+def clear_restore_step(fleet_dir: str) -> None:
+    """Remove the restore ceiling. Every fresh fleet run starts here: a
+    ceiling left behind by a PREVIOUS run in the same workdir would
+    silently roll a longer continuation run back to an old step."""
+    path = os.path.join(
+        os.path.abspath(os.path.expanduser(fleet_dir)), _RESTORE_FILE)
+    if os.path.exists(path):
+        os.remove(path)
+
+
+# ---------------------------------------------------------------------------
+# Newest common valid checkpoint (fleet side, jax-free)
+# ---------------------------------------------------------------------------
+
+
+def valid_steps(ckpt_dir: str) -> list[int]:
+    """Every step under ``ckpt_dir`` whose MANIFEST.dtf verifies
+    (CRC-trailered read + per-shard size check — the same invariants
+    ``Checkpointer.verify_manifest`` enforces, reimplemented over
+    runtime/io so the control plane never stands up a Checkpointer or
+    an orbax manager).
+    Steps without a manifest count as valid (pre-manifest checkpoints
+    restore unchecked, by design). Ascending; bounded by the worker's
+    retention (``max_to_keep``), so verifying all of them is cheap."""
+    d = os.path.abspath(os.path.expanduser(ckpt_dir))
+    if not os.path.isdir(d):
+        return []
+    steps = sorted(
+        int(n) for n in os.listdir(d)
+        if n.isdigit() and os.path.isdir(os.path.join(d, n)))
+    return [s for s in steps if _step_dir_valid(os.path.join(d, str(s)), s)]
+
+
+def newest_valid_step(ckpt_dir: str) -> int | None:
+    """Newest restorable step under ``ckpt_dir`` (None when nothing
+    is)."""
+    steps = valid_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def _step_dir_valid(step_dir: str, step: int) -> bool:
+    manifest = os.path.join(step_dir, "MANIFEST.dtf")
+    if not os.path.exists(manifest):
+        return True  # pre-manifest checkpoint: allowed, unchecked
+    from ..runtime import io as io_lib
+
+    try:
+        entries = json.loads(io_lib.read_payload(manifest))["files"]
+        for entry in entries:
+            p = os.path.join(step_dir, entry["path"])
+            if not os.path.exists(p) or os.path.getsize(p) != entry["bytes"]:
+                logger.warning(
+                    "fleet: checkpoint step %d shard %s missing/resized; "
+                    "step not restorable", step, entry["path"])
+                return False
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        logger.warning("fleet: checkpoint step %d manifest unreadable (%s); "
+                       "step not restorable", step, e)
+        return False
+    return True
+
+
+def evict_steps_above(ckpt_dir: str, ceiling: int) -> list[int]:
+    """Move every step dir ABOVE ``ceiling`` to ``<dir>/.abandoned/`` —
+    called at a gang restart, where the whole gang rolls back to
+    ``ceiling``: anything newer is abandoned history. Left in place it
+    would (a) shadow the re-trained state at the same step numbers
+    (``Checkpointer.save`` skips steps already on disk, so a corrupt or
+    stale above-ceiling step would stay the newest forever) and (b) be
+    resurrected by a later restore — e.g. an in-process Supervisor
+    restart inside the new incarnation restoring the PREVIOUS
+    incarnation's newest step. Returns the evicted steps."""
+    d = os.path.abspath(os.path.expanduser(ckpt_dir))
+    if not os.path.isdir(d):
+        return []
+    base = os.path.join(d, ".abandoned")
+    evicted: list[int] = []
+    for name in sorted(os.listdir(d)):
+        if not (name.isdigit() and os.path.isdir(os.path.join(d, name))):
+            continue
+        step = int(name)
+        if step <= ceiling:
+            continue
+        os.makedirs(base, exist_ok=True)
+        dst = os.path.join(base, name)
+        k = 0
+        while os.path.exists(dst):
+            k += 1
+            dst = os.path.join(base, f"{name}-{k}")
+        os.rename(os.path.join(d, name), dst)
+        evicted.append(step)
+        logger.warning("fleet: abandoned above-ceiling checkpoint step %d "
+                       "-> %s", step, dst)
+    return evicted
+
+
+def newest_common_valid_step(ckpt_dirs: Sequence[str]) -> int | None:
+    """The newest step EVERY worker retains AND can verify — the gang
+    restart point. The intersection matters, not min-of-newest: a
+    worker whose retention already evicted the others' newest step must
+    not be handed a ceiling it cannot restore (it would silently
+    fresh-init at 0 while the rest of the gang resumes — the exact
+    inconsistency the ceiling exists to prevent). An empty intersection
+    pins the common step to 0: the whole gang fresh-starts, which with
+    deterministic data is correct, just maximally conservative. None
+    when no dirs given."""
+    if not ckpt_dirs:
+        return None
+    common = set(valid_steps(ckpt_dirs[0]))
+    for d in ckpt_dirs[1:]:
+        common &= set(valid_steps(d))
+    return max(common) if common else 0
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats: writer (worker side) and monitor (fleet side)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Heartbeat:
+    """One decoded heartbeat record. ``t`` is the WRITER's clock —
+    informational only; staleness is judged by the monitor observing
+    ``seq`` changes on its OWN clock, because monotonic clocks are not
+    comparable across processes."""
+
+    pid: int
+    seq: int
+    t: float
+    step: int
+    attempt: int
+    incarnation: int
+    phase: str
+    cause: str | None = None
+    restore_step: int | None = None
+    restore_fallback: bool | None = None
+
+
+def read_heartbeat(path: str) -> Heartbeat | None:
+    """Decode the heartbeat at ``path``; None when absent or unreadable
+    (an unreadable heartbeat is indistinguishable from a missing one —
+    both mean 'no proof of life')."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return Heartbeat(
+            pid=int(data["pid"]), seq=int(data["seq"]),
+            t=float(data["t"]), step=int(data.get("step", 0)),
+            attempt=int(data.get("attempt", 0)),
+            incarnation=int(data.get("incarnation", 0)),
+            phase=str(data.get("phase", "init")),
+            cause=data.get("cause"),
+            restore_step=data.get("restore_step"),
+            restore_fallback=data.get("restore_fallback"),
+        )
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        logger.warning("unreadable heartbeat %s (%s); treating as absent",
+                       path, e)
+        return None
+
+
+class HeartbeatWriter:
+    """Worker-side heartbeat emitter: every ``beat()`` bumps ``seq`` and
+    atomically rewrites the file with the latest known
+    ``{step, attempt, phase, restore...}``. Fields persist across beats,
+    so a fleet that only samples the newest record still sees the
+    restore note from an earlier one. Thread-safe (the optional pulse
+    thread and the train loop both beat)."""
+
+    def __init__(self, path: str, incarnation: int = 0,
+                 clock: Callable[[], float] = time.monotonic,
+                 pulse_interval_s: float | None = None):
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        self.path = path
+        self.incarnation = int(incarnation)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._step = 0
+        self._attempt = 0
+        self._phase = "init"
+        self._cause: str | None = None
+        self._restore: tuple[int, bool] | None = None
+        self._stop = threading.Event()
+        self._pulse: threading.Thread | None = None
+        if pulse_interval_s is not None:
+            if pulse_interval_s <= 0:
+                raise ValueError("pulse_interval_s must be positive")
+            self._pulse = threading.Thread(
+                target=self._pulse_loop, args=(pulse_interval_s,),
+                daemon=True, name="fleet-heartbeat-pulse")
+            self._pulse.start()
+
+    def beat(self, step: int | None = None, attempt: int | None = None,
+             phase: str | None = None) -> None:
+        """Write one heartbeat; omitted fields keep their last value."""
+        with self._lock:
+            if step is not None:
+                self._step = int(step)
+            if attempt is not None:
+                self._attempt = int(attempt)
+            if phase is not None:
+                self._phase = str(phase)
+            self._seq += 1
+            rec = {
+                "pid": os.getpid(), "seq": self._seq,
+                "t": float(self.clock()), "step": self._step,
+                "attempt": self._attempt, "incarnation": self.incarnation,
+                "phase": self._phase, "cause": self._cause,
+            }
+            if self._restore is not None:
+                rec["restore_step"], rec["restore_fallback"] = self._restore
+            # write INSIDE the lock: beats from the pulse thread and the
+            # train loop serialize, so seq order on disk == write order
+            _atomic_write(self.path, json.dumps(rec))
+
+    def note_restore(self, step: int, fallback: bool) -> None:
+        """Record which checkpoint this incarnation restored from — the
+        fleet relays it into its timeline as the gang's ``ckpt_restore``
+        evidence."""
+        with self._lock:
+            self._restore = (int(step), bool(fallback))
+        self.beat()
+
+    def finish(self, phase: str, cause: str | None = None) -> None:
+        """Terminal beat (``done`` / ``preempted`` / ``failed``) — the
+        record the fleet reads after the process exits."""
+        with self._lock:
+            self._cause = cause
+        self.close()
+        self.beat(phase=phase)
+
+    def _pulse_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            self.beat()
+
+    def close(self) -> None:
+        """Stop the pulse thread (idempotent; the file is left behind —
+        its staleness is the death signal)."""
+        self._stop.set()
+        if self._pulse is not None:
+            self._pulse.join(timeout=5.0)
+            self._pulse = None
+
+
+#: HeartbeatMonitor.check() statuses
+WAITING = "waiting"   # no beat yet, launch grace not exceeded
+LIVE = "live"
+DEAD = "dead"         # no (current-incarnation) beat within the budget
+STALLED_HB = "stalled"  # beats ticking, no progress past the budget
+
+#: phases after which a frozen step is expected (the process is exiting)
+_TERMINAL_PHASES = ("done", "preempted", "failed")
+
+
+class HeartbeatMonitor:
+    """Fleet-side liveness judgment for ONE worker's heartbeat file.
+
+    Staleness is measured on the MONITOR's clock from the moments it
+    *observes* the heartbeat change — never from the heartbeat's own
+    timestamp (monotonic clocks don't compare across processes). A
+    heartbeat stamped with a different incarnation is ignored entirely:
+    a straggler from the previous gang writing right up until its
+    SIGKILL must read as *absent*, not alive.
+
+    Stall = ``seq`` still ticking (the pulse thread, or any beat
+    source) while (step, attempt, phase) make NO progress past the
+    stall budget, outside the terminal phases — so a pulsed worker hung
+    in build/restore (phase ``init``) is just as detectable as one hung
+    mid-train. Size ``stall_timeout_s`` above the longest legitimate
+    restore + first-step compile.
+    """
+
+    def __init__(self, path: str, incarnation: int,
+                 clock: Callable[[], float] = time.monotonic,
+                 heartbeat_timeout_s: float = 30.0,
+                 stall_timeout_s: float = 120.0,
+                 launch_grace_s: float = 120.0):
+        if heartbeat_timeout_s <= 0 or stall_timeout_s <= 0 \
+                or launch_grace_s <= 0:
+            raise ValueError("liveness budgets must be positive")
+        self.path = path
+        self.incarnation = int(incarnation)
+        self.clock = clock
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.stall_timeout_s = stall_timeout_s
+        self.launch_grace_s = launch_grace_s
+        self.heartbeat: Heartbeat | None = None  # last ACCEPTED record
+        self._t0 = clock()
+        self._last_seq: int | None = None
+        self._t_seq = self._t0
+        self._last_progress: tuple | None = None  # (step, attempt, phase)
+        self._t_progress = self._t0
+
+    def check(self) -> str:
+        """One liveness poll: WAITING / LIVE / DEAD / STALLED_HB."""
+        now = self.clock()
+        hb = read_heartbeat(self.path)
+        if hb is not None and hb.incarnation == self.incarnation:
+            self.heartbeat = hb
+            if hb.seq != self._last_seq:
+                self._last_seq, self._t_seq = hb.seq, now
+            progress = (hb.step, hb.attempt, hb.phase)
+            if progress != self._last_progress:
+                self._last_progress, self._t_progress = progress, now
+        if self._last_seq is None:
+            # nothing (of this incarnation) ever beat: grant the launch
+            # grace — process spawn + interpreter + framework import
+            return DEAD if now - self._t0 > self.launch_grace_s else WAITING
+        if now - self._t_seq > self.heartbeat_timeout_s:
+            return DEAD
+        if (self.heartbeat is not None
+                and self.heartbeat.phase not in _TERMINAL_PHASES
+                and now - self._t_progress > self.stall_timeout_s):
+            return STALLED_HB
+        return LIVE
+
+
+# ---------------------------------------------------------------------------
+# Fleet supervisor
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    #: gang restarts allowed (launches = max_restarts + 1)
+    max_restarts: int = 3
+    #: failure classes that earn a gang restart; others raise immediately
+    restart_on: tuple[str, ...] = (TRANSIENT, POISONED, PREEMPTION, STALLED)
+    #: escalating backoff between gang restarts (seeded jitter — the
+    #: same schedule the in-process Supervisor escalates on)
+    backoff: RetryPolicy = RetryPolicy(
+        base_s=0.2, multiplier=2.0, max_backoff_s=60.0)
+    #: liveness poll cadence
+    poll_s: float = 0.25
+    #: no heartbeat within this budget after the first one → dead.
+    #: SIZE ABOVE the longest legitimate silent window between step-seam
+    #: beats (ceiling restore + first-step compile) — or give workers a
+    #: HeartbeatWriter pulse thread and let stall detection carry hangs
+    heartbeat_timeout_s: float = 30.0
+    #: heartbeats ticking but step frozen this long → stalled
+    stall_timeout_s: float = 120.0
+    #: budget for a launched worker's FIRST beat (interpreter + imports)
+    launch_grace_s: float = 120.0
+    #: SIGTERM → SIGKILL grace during a gang stop (must cover one
+    #: coordinated preemption save)
+    term_grace_s: float = 10.0
+
+    def __post_init__(self):
+        if self.max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        unknown = set(self.restart_on) - (_KNOWN_CAUSES - {FATAL})
+        if unknown:
+            raise ValueError(f"unknown restart_on classes: {sorted(unknown)}")
+        if self.poll_s <= 0 or self.term_grace_s <= 0:
+            raise ValueError("poll_s and term_grace_s must be positive")
+
+
+@dataclasses.dataclass
+class _Worker:
+    index: int
+    handle: Any                      # Popen-shaped: poll/terminate/kill/wait
+    monitor: HeartbeatMonitor
+    done: bool = False               # exited 0 this incarnation
+    ready: bool = False              # heartbeat got past build+restore
+    exit_code: int | None = None
+
+
+class FleetSupervisor:
+    """Launch, watch, and gang-restart a fleet of worker processes.
+
+    ``launch(worker_index, incarnation)`` must start worker
+    ``worker_index`` and return a process handle with the
+    ``subprocess.Popen`` control surface (``poll`` / ``terminate`` /
+    ``kill`` / ``wait`` / ``pid``) — tests drive the whole state machine
+    with fakes. Each worker heartbeats to
+    ``heartbeat_path(workdir, index)``; ``ckpt_dirs`` (one per worker,
+    optional) enables the common-checkpoint ceiling at restart.
+
+    ``clock`` and ``sleep`` are injectable (FaultClock / scripted sleeps
+    make liveness deterministic); with the default sleep the poll wait
+    is an ``Event.wait`` that ``interrupt()`` — or a SIGTERM aimed at
+    the fleet process itself — wakes immediately, so a preemption never
+    waits out a backoff interval.
+    """
+
+    def __init__(
+        self,
+        launch: Callable[[int, int], Any],
+        num_workers: int,
+        workdir: str,
+        cfg: FleetConfig = FleetConfig(),
+        ckpt_dirs: Sequence[str] | None = None,
+        registry: Registry | None = None,
+        flightrec: FlightRecorder | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] | None = None,
+        postmortem_dir: str | None = None,
+    ):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        if ckpt_dirs is not None and len(ckpt_dirs) != num_workers:
+            raise ValueError("ckpt_dirs must have one entry per worker")
+        self.launch = launch
+        self.num_workers = num_workers
+        self.workdir = os.path.abspath(os.path.expanduser(workdir))
+        self.cfg = cfg
+        self.ckpt_dirs = list(ckpt_dirs) if ckpt_dirs is not None else None
+        self.registry = registry if registry is not None else default_registry()
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
+        self.clock = clock
+        self.sleep = sleep
+        self.postmortem_dir = postmortem_dir or self.workdir
+        self._wake = threading.Event()
+        self._stop_signal: list[int] = []
+        #: gang restarts performed by the last run() (test observability)
+        self.restarts = 0
+        self.incarnation = 0
+        #: restore ceiling written for the CURRENT incarnation (None =
+        #: no ceiling; every checked-in worker must have restored it)
+        self._ceiling: int | None = None
+        self._workers: list[_Worker] = []
+        self._m_deaths = self.registry.counter(
+            FLEET_WORKER_DEATHS_TOTAL,
+            "fleet worker deaths detected (exit, missed heartbeat, stall)")
+
+    # -- interruptible waiting --------------------------------------------
+
+    def interrupt(self) -> None:
+        """Wake the in-progress (or next) poll/backoff wait immediately.
+        One-shot: the wakeup is consumed by that wait, so later waits
+        pace normally — a durable stop signal lives in ``_stop_signal``,
+        not in the event."""
+        self._wake.set()
+
+    def _wait(self, delay: float) -> None:
+        if self.sleep is not None:
+            self.sleep(delay)
+            return
+        if self._wake.wait(delay):
+            # consume the wakeup: a sticky event would turn every later
+            # poll/grace loop into a hot spin
+            self._wake.clear()
+
+    def _sigterm(self, signum, frame) -> None:
+        self._stop_signal.append(signum)
+        self._wake.set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _launch_all(self) -> None:
+        self._workers = []
+        for i in range(self.num_workers):
+            handle = self.launch(i, self.incarnation)
+            self._workers.append(_Worker(
+                index=i, handle=handle,
+                monitor=HeartbeatMonitor(
+                    heartbeat_path(self.workdir, i), self.incarnation,
+                    clock=self.clock,
+                    heartbeat_timeout_s=self.cfg.heartbeat_timeout_s,
+                    stall_timeout_s=self.cfg.stall_timeout_s,
+                    launch_grace_s=self.cfg.launch_grace_s,
+                ),
+            ))
+            self.flightrec.emit(
+                "fleet_launch", worker=i, incarnation=self.incarnation,
+                pid=getattr(handle, "pid", None))
+            logger.info("fleet: launched worker %d (incarnation %d, pid %s)",
+                        i, self.incarnation, getattr(handle, "pid", None))
+
+    def run(self) -> dict:
+        """Supervise until every worker reaches a clean ``done`` exit.
+
+        Returns ``{"restarts": n, "incarnation": k}``. Raises
+        ``FleetExhausted`` when the restart budget runs out or the
+        failure class is not restartable (postmortem dumped first).
+        """
+        os.makedirs(self.workdir, exist_ok=True)
+        # new fleet run == new incarnation: stale heartbeats from any
+        # previous fleet in this dir can never read as liveness — and no
+        # inherited restore ceiling: a previous run's RESTORE_STEP would
+        # cap this run's restores at an old step
+        self.incarnation = read_incarnation(self.workdir) + 1
+        write_incarnation(self.workdir, self.incarnation)
+        clear_restore_step(self.workdir)
+        self.restarts = 0
+        self._ceiling = None
+        main = threading.current_thread() is threading.main_thread()
+        prev_handler = (signal_lib.signal(signal_lib.SIGTERM, self._sigterm)
+                        if main else None)
+        self.flightrec.emit("fleet_start", workers=self.num_workers,
+                            incarnation=self.incarnation)
+        self._launch_all()
+        #: (restart_index, cause) whose gang-live confirmation is pending
+        pending_restart: tuple[int, str] | None = None
+        relayed = False  # restore note relayed for this incarnation
+        try:
+            while True:
+                self._wait(self.cfg.poll_s)
+                if self._stop_signal:
+                    self._preempted_teardown()
+                failure = self._poll_round(pending_restart, relayed)
+                pending_restart, relayed, failed = failure
+                if failed is not None:
+                    worker, cause, detail = failed
+                    self._m_deaths.inc()
+                    self.flightrec.emit("fleet_worker_dead", worker=worker,
+                                        cause=cause, detail=detail[:200])
+                    logger.error("fleet: worker %d dead [%s]: %s",
+                                 worker, cause, detail)
+                    self._gang_stop(cause)
+                    if cause not in self.cfg.restart_on \
+                            or self.restarts >= self.cfg.max_restarts:
+                        self.flightrec.emit("fleet_exhausted", cause=cause,
+                                            restarts=self.restarts)
+                        self._dump_postmortem(f"fleet_exhausted:{cause}")
+                        raise FleetExhausted(cause, self.restarts, detail)
+                    pending_restart = self._gang_restart(cause)
+                    relayed = False
+                elif all(w.done for w in self._workers):
+                    self.flightrec.emit("fleet_done",
+                                        incarnation=self.incarnation)
+                    logger.info("fleet: all %d workers done (incarnation %d,"
+                                " %d restart(s))", self.num_workers,
+                                self.incarnation, self.restarts)
+                    return {"restarts": self.restarts,
+                            "incarnation": self.incarnation}
+        finally:
+            # no worker may outlive its supervisor: on every normal path
+            # (done, exhausted, preempted teardown) the gang is already
+            # down, so this only fires on an unexpected escape — e.g. a
+            # launch() that raised mid-gang — where live workers would
+            # otherwise keep training, unsupervised, in this workdir
+            for w in self._workers:
+                if w.handle.poll() is None:
+                    logger.error(
+                        "fleet: killing worker %d still alive at "
+                        "supervisor exit", w.index)
+                    w.handle.kill()
+            self._reap_all()
+            if main:
+                signal_lib.signal(signal_lib.SIGTERM, prev_handler)
+            if self._stop_signal:
+                # processed a fleet-level SIGTERM: the gang is down; put
+                # the original handler back and re-deliver so the outer
+                # process sees the signal without the backoff delay
+                os.kill(os.getpid(), self._stop_signal[0])
+
+    # -- one poll round ----------------------------------------------------
+
+    def _poll_round(
+        self, pending_restart: tuple[int, str] | None, relayed: bool,
+    ) -> tuple[tuple[int, str] | None, bool,
+               tuple[int, str, str] | None]:
+        """Poll every worker once. Returns the updated
+        ``(pending_restart, relayed, failure)`` where ``failure`` is
+        ``(worker, cause, detail)`` for the first failed worker."""
+        failed: tuple[int, str, str] | None = None
+        for w in self._workers:
+            if w.done:
+                continue
+            rc = w.handle.poll()
+            status = w.monitor.check()
+            hb = w.monitor.heartbeat  # refreshed by check()
+            # relay the gang's restore evidence BEFORE fleet_restart can
+            # be emitted, so the postmortem chain reads causally:
+            # gang_stop -> ckpt_restore{fallback} -> fleet_restart
+            if (pending_restart is not None and not relayed
+                    and hb is not None and hb.restore_step is not None):
+                self.flightrec.emit(
+                    "ckpt_restore", step=hb.restore_step,
+                    fallback=bool(hb.restore_fallback), worker=w.index,
+                    relayed=True)
+                relayed = True
+            if rc is not None:
+                w.exit_code = rc
+                div = (self._restore_divergence(hb)
+                       if pending_restart is not None and not w.done
+                       else None)
+                cause_detail = self._classify_exit(w, rc, hb)
+                if cause_detail is None:
+                    if div is not None and failed is None:
+                        failed = (w.index, TRANSIENT, div)
+                    w.done = w.ready = True
+                elif failed is None:
+                    failed = (w.index, *cause_detail)
+            else:
+                if hb is not None and hb.phase in _PAST_BUILD_PHASES:
+                    if pending_restart is not None and not w.ready:
+                        div = self._restore_divergence(hb)
+                        if div is not None and failed is None:
+                            failed = (w.index, TRANSIENT, div)
+                    w.ready = True
+                if status == DEAD and failed is None:
+                    failed = (w.index,
+                              classify_failure(WorkerDead("missed heartbeats")),
+                              f"no heartbeat within "
+                              f"{w.monitor.heartbeat_timeout_s}s "
+                              f"(pid {getattr(w.handle, 'pid', None)})")
+                elif status == STALLED_HB and failed is None:
+                    # lazy: StalledError lives in train/callbacks (a
+                    # jax-importing module) — keep the hot control-plane
+                    # imports light, mirroring classify_failure itself
+                    from ..train.callbacks import StalledError
+
+                    failed = (w.index, classify_failure(StalledError()),
+                              f"heartbeats ticking but no progress past "
+                              f"{w.monitor.stall_timeout_s}s (step "
+                              f"{hb.step if hb else '?'})")
+        if (pending_restart is not None and failed is None
+                and all(w.ready or w.done for w in self._workers)):
+            restart_index, cause = pending_restart
+            self.flightrec.emit("fleet_restart", restart=restart_index,
+                                cause=cause, incarnation=self.incarnation)
+            logger.warning("fleet: gang live after restart %d (cause=%s, "
+                           "incarnation %d)", restart_index, cause,
+                           self.incarnation)
+            pending_restart = None
+        return pending_restart, relayed, failed
+
+    def _restore_divergence(self, hb: Heartbeat | None) -> str | None:
+        """The gang-consistency check behind the restore ceiling: a
+        relaunched worker that restored a DIFFERENT step than the one
+        written (e.g. its copy of that step was quarantined at read
+        time and fallback landed lower, or it fresh-inited) has
+        silently diverged from the gang. Classified transient: another
+        gang restart recomputes the intersection without the bad step
+        and converges."""
+        if self._ceiling is None or hb is None:
+            return None
+        expect = self._ceiling if self._ceiling > 0 else None  # 0 = fresh
+        if hb.restore_step != expect:
+            return (f"gang divergence: worker restored step "
+                    f"{hb.restore_step}, gang ceiling is {self._ceiling}")
+        return None
+
+    def _classify_exit(self, w: _Worker, rc: int,
+                       hb: Heartbeat | None) -> tuple[str, str] | None:
+        """Map a worker exit to (cause, detail), or None for a clean
+        'done' completion."""
+        if rc == 0:
+            if hb is not None and hb.phase == "preempted":
+                return (PREEMPTION,
+                        f"worker exited 0 after a preemption save "
+                        f"(step {hb.step})")
+            if hb is not None and hb.phase not in ("done",):
+                logger.warning(
+                    "fleet: worker %d exited 0 in phase %r; counting as "
+                    "done", w.index, hb.phase)
+            return None
+        if rc == EXIT_PREEMPTED:
+            return (PREEMPTION, "worker exited via coordinated "
+                                "preemption save")
+        if rc == EXIT_FAILED:
+            cause = hb.cause if hb is not None and hb.cause else None
+            if cause not in _KNOWN_CAUSES:
+                cause = FATAL
+            return (cause, f"worker's in-process supervision exhausted "
+                           f"[{cause}]")
+        return (classify_failure(WorkerDead(f"exit code {rc}")),
+                f"worker exited with code {rc}")
+
+    # -- gang stop / restart ----------------------------------------------
+
+    def _alive(self) -> list[_Worker]:
+        return [w for w in self._workers if w.handle.poll() is None]
+
+    def _gang_stop(self, cause: str) -> None:
+        """SIGTERM the survivors (coordinated preemption save), SIGKILL
+        whatever outlives the grace period."""
+        survivors = self._alive()
+        for w in survivors:
+            logger.warning("fleet: SIGTERM worker %d (gang stop, cause=%s)",
+                           w.index, cause)
+            w.handle.terminate()
+        deadline = self.clock() + self.cfg.term_grace_s
+        while self._alive() and self.clock() < deadline:
+            self._wait(min(self.cfg.poll_s, self.cfg.term_grace_s / 4))
+        killed = 0
+        for w in self._alive():
+            logger.error("fleet: SIGKILL worker %d (outlived the %.1fs "
+                         "gang-stop grace)", w.index, self.cfg.term_grace_s)
+            w.handle.kill()
+            killed += 1
+        self._reap_all()
+        self.flightrec.emit("fleet_gang_stop", cause=cause,
+                            survivors=len(survivors), killed=killed)
+
+    def _gang_restart(self, cause: str) -> tuple[int, str]:
+        delay = self.cfg.backoff.backoff_s(self.restarts)
+        self.restarts += 1
+        self.registry.counter(
+            FLEET_RESTARTS_TOTAL, "fleet gang restarts by failure class",
+            cause=cause,
+        ).inc()
+        logger.warning("fleet: gang restart %d/%d (cause=%s) after %.2fs "
+                       "backoff", self.restarts, self.cfg.max_restarts,
+                       cause, delay)
+        t0 = self.clock()
+        self._wait(delay)
+        slept = self.clock() - t0
+        if slept > 0:
+            # ELAPSED, not nominal: injected no-op sleeps waste nothing
+            goodput.note_wasted(goodput.WASTE_RESTART_RECOVERY, slept,
+                                registry=self.registry)
+        self._ceiling = None
+        if self.ckpt_dirs is not None:
+            common = newest_common_valid_step(self.ckpt_dirs)
+            if common is not None:
+                write_restore_step(self.workdir, common)
+                self._ceiling = common
+                for d in self.ckpt_dirs:
+                    evict_steps_above(d, common)
+                logger.warning("fleet: restore ceiling for incarnation %d "
+                               "is step %d", self.incarnation + 1, common)
+        self.incarnation += 1
+        write_incarnation(self.workdir, self.incarnation)
+        self._launch_all()
+        return (self.restarts, cause)
+
+    def _preempted_teardown(self) -> None:
+        """The fleet process itself was SIGTERMed: stop the gang (the
+        workers take their coordinated preemption saves) and surface the
+        signal to run()'s finally for re-delivery."""
+        logger.warning("fleet: SIGTERM received; stopping the gang")
+        self._gang_stop(PREEMPTION)
+        raise FleetExhausted(
+            PREEMPTION, self.restarts,
+            "fleet process preempted; gang stopped with coordinated saves")
+
+    def _reap_all(self) -> None:
+        """Wait on every worker handle. Called only after the gang is
+        terminated/killed, so the waits are short — and they must cover
+        the just-SIGKILLed children whose ``poll()`` still reads None
+        (the kernel hasn't finished tearing them down): skipping those
+        leaks one zombie per escalated gang stop."""
+        for w in self._workers:
+            try:
+                w.handle.wait(timeout=5.0)
+            except Exception as e:  # reap is best-effort bookkeeping
+                logger.warning("fleet: reaping worker %d failed: %r",
+                               w.index, e)
+
+    def _dump_postmortem(self, reason: str) -> None:
+        flightrec_lib.dump_postmortem(self.flightrec, self.postmortem_dir,
+                                      reason=reason)

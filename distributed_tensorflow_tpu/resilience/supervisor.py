@@ -19,6 +19,10 @@ Failure taxonomy (``classify_failure``, docs/resilience.md):
   *valid* checkpoint predates the poison → roll back and retry. With
   deterministic data the poison usually recurs and the restart budget
   converts it into a loud, classified failure.
+- ``stalled``    — StalledError (Watchdog ``abort_on_stall``, or a fleet
+  liveness judgment): the step stopped making progress. Host state may
+  be fine but is unprovable; roll back to the last valid checkpoint and
+  restart.
 - ``fatal``      — everything else (bugs, bad config, KeyboardInterrupt):
   re-raised immediately, never retried.
 - ``preemption`` — not an exception: `Trainer.fit` returned cleanly with
@@ -40,6 +44,9 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
+import signal as signal_lib
+import threading
 import time
 from typing import Any, Callable, Iterable, Sequence
 
@@ -54,6 +61,7 @@ logger = logging.getLogger(__name__)
 #: failure classes (classify_failure) and the preemption restart cause
 TRANSIENT = "transient"
 POISONED = "poisoned"
+STALLED = "stalled"
 FATAL = "fatal"
 PREEMPTION = "preemption"
 
@@ -63,6 +71,12 @@ RESTARTS_TOTAL = "supervisor_restarts_total"
 
 def classify_failure(exc: BaseException) -> str:
     """Map an exception out of ``Trainer.fit`` to a failure class."""
+    # lazy: train.callbacks must stay importable without resilience/
+    # (resilience/__init__ -> faults -> train.callbacks would cycle)
+    from ..train.callbacks import StalledError
+
+    if isinstance(exc, StalledError):
+        return STALLED
     if isinstance(exc, RetryExhausted):
         # see through to what the retries were absorbing
         under = exc.__cause__
@@ -96,7 +110,7 @@ class SupervisorConfig:
     #: restarts allowed (attempts = max_restarts + 1)
     max_restarts: int = 3
     #: failure classes that earn a restart; anything else re-raises
-    restart_on: tuple[str, ...] = (TRANSIENT, POISONED, PREEMPTION)
+    restart_on: tuple[str, ...] = (TRANSIENT, POISONED, PREEMPTION, STALLED)
     #: escalating backoff between attempts — reuses RetryPolicy's
     #: seeded-jitter schedule (max_attempts is ignored here; the restart
     #: budget is max_restarts above)
@@ -106,7 +120,8 @@ class SupervisorConfig:
     def __post_init__(self):
         if self.max_restarts < 0:
             raise ValueError("max_restarts must be >= 0")
-        unknown = set(self.restart_on) - {TRANSIENT, POISONED, PREEMPTION}
+        unknown = set(self.restart_on) - {TRANSIENT, POISONED, PREEMPTION,
+                                          STALLED}
         if unknown:
             raise ValueError(f"unknown restart_on classes: {sorted(unknown)}")
 
@@ -128,7 +143,16 @@ class Supervisor:
     try: a hook that raises transiently earns a restart like any other
     failure, and the hooks re-run on that next attempt — keep them
     idempotent. ``sleep`` is injectable so chaos tests run the full
-    escalation in microseconds.
+    escalation in microseconds; when NOT injected, backoff waits are an
+    interruptible ``Event.wait`` that ``interrupt()`` — or a SIGTERM —
+    wakes immediately, so a preemption is processed at once instead of
+    after up to a full backoff interval (the signal is re-delivered to
+    the pre-backoff handler once the wait returns).
+
+    ``heartbeat`` (resilience/fleet.HeartbeatWriter, optional) is the
+    fleet-liveness seam: the supervisor beats at every attempt boundary
+    with the attempt number, so the fleet control plane sees life even
+    while build/restore runs between training loops.
     """
 
     def __init__(
@@ -138,10 +162,11 @@ class Supervisor:
         cfg: SupervisorConfig = SupervisorConfig(),
         registry: Registry | None = None,
         on_restart: Sequence[Callable[[int, str], None]] = (),
-        sleep: Callable[[float], None] = time.sleep,
+        sleep: Callable[[float], None] | None = None,
         clock: Callable[[], float] = time.monotonic,
         flightrec: FlightRecorder | None = None,
         postmortem_dir: str | None = None,
+        heartbeat=None,
     ):
         self.build = build
         self.num_steps = num_steps
@@ -150,6 +175,8 @@ class Supervisor:
         self.on_restart = tuple(on_restart)
         self.sleep = sleep
         self.clock = clock
+        self.heartbeat = heartbeat
+        self._wake = threading.Event()
         self.flightrec = (flightrec if flightrec is not None
                           else flightrec_lib.default_recorder())
         #: where the exhaustion postmortem lands; defaults to the first
@@ -157,6 +184,47 @@ class Supervisor:
         self.postmortem_dir = postmortem_dir
         #: restarts performed by the last run() (observability for tests)
         self.restarts = 0
+
+    def interrupt(self) -> None:
+        """Wake the in-progress (or next) backoff wait immediately. The
+        wakeup is consumed by that one wait — never lost when it races
+        the sleep, but not sticky either: later restarts keep their
+        escalating backoff instead of degenerating into a zero-delay
+        restart storm."""
+        self._wake.set()
+
+    def _backoff_wait(self, delay: float) -> None:
+        """Sleep out one restart backoff. With an injected ``sleep`` the
+        caller owns the semantics (tests). Otherwise wait on the wake
+        event AND catch SIGTERM for the duration: during backoff no
+        attempt checkpointer is alive, so no PreemptionWatcher handler
+        is installed — without this, a preemption either kills the
+        process mid-backoff (default handler) or waits out the full
+        delay. The caught signal is re-delivered to the restored
+        handler after the wait, so its real semantics still apply —
+        just immediately."""
+        if self.sleep is not None:
+            self.sleep(delay)
+            return
+        pending: list[int] = []
+
+        def handler(signum, frame):
+            pending.append(signum)
+            self._wake.set()
+
+        main = threading.current_thread() is threading.main_thread()
+        prev = signal_lib.signal(signal_lib.SIGTERM, handler) if main else None
+        try:
+            if self._wake.wait(delay):
+                self._wake.clear()  # one-shot: later backoffs still wait
+        finally:
+            if main:
+                signal_lib.signal(signal_lib.SIGTERM, prev)
+        if pending:
+            logger.warning(
+                "supervisor: SIGTERM during restart backoff — woke early, "
+                "re-delivering to the previous handler")
+            os.kill(os.getpid(), pending[0])
 
     def run(self):
         """Supervised ``Trainer.fit``; returns the final TrainState.
@@ -177,6 +245,10 @@ class Supervisor:
             self.flightrec.emit("sup_attempt", attempt=restarts)
             try:
                 try:
+                    if self.heartbeat is not None:
+                        # fleet liveness: prove life before the (possibly
+                        # slow) hook + build + restore boundary work
+                        self.heartbeat.beat(attempt=restarts, phase="init")
                     # hooks and build are INSIDE the classified attempt:
                     # a transient failure at the restart boundary (a
                     # hook's disk work, a restore-time IO blip) earns
@@ -250,7 +322,7 @@ class Supervisor:
                 restarts, self.cfg.max_restarts, cause, delay,
             )
             t_sleep = self.clock()
-            self.sleep(delay)
+            self._backoff_wait(delay)
             # ELAPSED, not nominal: an injected no-op sleep wastes nothing
             slept = self.clock() - t_sleep
             if slept > 0:
@@ -259,14 +331,5 @@ class Supervisor:
             pending_hook = (restarts, cause)
 
     def _dump_postmortem(self, reason: str) -> None:
-        """Best-effort flight-recorder dump to the run dir — the whole
-        point of the recorder is this moment, so never let a dump
-        failure mask the SupervisorExhausted being raised."""
-        if not self.postmortem_dir:
-            return
-        try:
-            path = self.flightrec.dump_unique(self.postmortem_dir,
-                                              reason=reason)
-            logger.warning("flight-recorder postmortem dumped to %s", path)
-        except Exception:
-            logger.exception("flight-recorder postmortem dump failed")
+        flightrec_lib.dump_postmortem(self.flightrec, self.postmortem_dir,
+                                      reason=reason)

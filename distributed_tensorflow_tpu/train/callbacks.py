@@ -11,6 +11,8 @@ async (host dispatches step N+1 while N executes).
 from __future__ import annotations
 
 import logging
+import os
+import signal as signal_lib
 import threading
 import time
 from typing import Any
@@ -30,6 +32,44 @@ class Callback:
     def on_train_start(self, trainer) -> None: ...
     def on_step_end(self, trainer, step: int, metrics: dict[str, Any]) -> None: ...
     def on_train_end(self, trainer) -> None: ...
+
+
+class StalledError(RuntimeError):
+    """A train step exceeded the Watchdog wall budget with
+    ``abort_on_stall`` set. Raised *asynchronously* in the training
+    thread, so the hung attempt dies as a CLASSIFIED failure —
+    ``resilience.classify_failure`` maps it to ``stalled`` (restartable)
+    instead of the silent ``train_watchdog_stalled`` gauge being the
+    only record. Must be constructible with no arguments: the async
+    raise instantiates the class bare."""
+
+    def __init__(self, message: str = "train step exceeded the watchdog "
+                                      "wall budget"):
+        super().__init__(message)
+
+
+class HeartbeatCallback(Callback):
+    """Fleet-liveness beats from the step seam (resilience/fleet.py):
+    every completed step rewrites this worker's heartbeat file with the
+    new global step. Pure host file IO — the async dispatch-ahead loop
+    is unchanged — and because beats come from the loop itself, a hung
+    step STOPS the beats: that silence is exactly the signal the
+    FleetSupervisor's missed-heartbeat detection consumes. Beats on
+    ``on_train_start`` too, so the (possibly long) first-step compile
+    window starts with proof of life."""
+
+    def __init__(self, writer, every_n: int = 1):
+        if every_n < 1:
+            raise ValueError("every_n must be >= 1")
+        self.writer = writer
+        self.every_n = every_n
+
+    def on_train_start(self, trainer):
+        self.writer.beat(phase="train")
+
+    def on_step_end(self, trainer, step, metrics):
+        if step % self.every_n == 0:
+            self.writer.beat(step=step)
 
 
 class StopAtStep(Callback):
@@ -260,6 +300,66 @@ class NaNGuard(Callback):
             trainer.request_stop(msg)
 
 
+def _async_raise(ident: int, exc_type: type[BaseException]) -> None:
+    """Raise ``exc_type`` asynchronously in thread ``ident``
+    (PyThreadState_SetAsyncExc) — the only host-side way to abort a
+    train loop that is no longer reaching its own callbacks. Delivery
+    happens at that thread's next bytecode; a thread blocked in a C
+    call sees it when the call returns."""
+    import ctypes
+
+    n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(ident), ctypes.py_object(exc_type))
+    if n != 1:
+        logger.error(
+            "async %s delivery to thread %d failed (SetAsyncExc hit %d "
+            "threads)", exc_type.__name__, ident, n)
+
+
+def _async_cancel(ident: int) -> None:
+    """Revoke a not-yet-delivered async exception for thread ``ident``
+    (SetAsyncExc with NULL; ctypes passes None as NULL). No-op when the
+    exception already delivered."""
+    import ctypes
+
+    ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(ident), None)
+
+
+#: ``abort_on_stall`` delivery for MAIN-THREAD loops: a process signal.
+#: SetAsyncExc delivery can be lost on this CPython while the target
+#: thread blocks inside C sleeps (observed: a hung-loop spin that never
+#: received its StalledError); a signal instead wakes blocking C calls
+#: via EINTR and its Python handler runs in the main thread at the next
+#: bytecode, where it raises StalledError directly. The handler is
+#: installed once, process-wide, on first arm and STAYS installed: with
+#: no abort pending it ignores the signal, so a late delivery can never
+#: hit SIGUSR1's default action (process termination) or kill a
+#: recovered run. SetAsyncExc remains the best-effort fallback for
+#: loops driven from non-main threads.
+_STALL_SIGNAL = signal_lib.SIGUSR1
+#: ids of watchdogs with an abort pending. Plain module-level set: the
+#: mutations are GIL-atomic, and the signal handler must not take locks
+#: (it preempts arbitrary main-thread code, possibly a lock holder).
+_pending_aborts: set[int] = set()
+_stall_handler_installed = False
+
+
+def _stall_signal_handler(signum, frame):
+    if _pending_aborts:
+        _pending_aborts.clear()
+        raise StalledError()
+    logger.warning(
+        "stall-abort signal received with no abort pending; ignored")
+
+
+def _install_stall_handler() -> None:
+    """Main-thread only (signal.signal requirement); idempotent."""
+    global _stall_handler_installed
+    if not _stall_handler_installed:
+        signal_lib.signal(_STALL_SIGNAL, _stall_signal_handler)
+        _stall_handler_installed = True
+
+
 class Watchdog(Callback):
     """Host-side hung-step detector (docs/resilience.md): if no
     ``on_step_end`` arrives within ``budget_s`` wall seconds, flag the
@@ -268,17 +368,32 @@ class Watchdog(Callback):
     error. The next completed step clears the gauge (recovery), so a
     scrape sees `stalled==1` exactly while a step is overdue.
 
-    Detection only: a stuck collective (one host dead in a psum) cannot
-    be un-stuck host-side — the signal exists so the scrape surface /
-    job scheduler can decide to kill-and-restart, which the checkpoint
-    layer turns into resume-from-last-save. The monitor runs on a
-    daemon poll thread; ``clock`` is injectable so tests (and the fault
-    harness's ClockStall) can drive time deterministically.
+    Detection only by default: a stuck collective (one host dead in a
+    psum) cannot be un-stuck host-side — the signal exists so the
+    scrape surface / job scheduler can decide to kill-and-restart,
+    which the checkpoint layer turns into resume-from-last-save. With
+    ``abort_on_stall=True`` the watchdog goes one step further: on the
+    stall edge it raises ``StalledError`` in the thread that entered
+    ``on_train_start``, so a hung-but-interruptible step dies as a
+    *classified, restartable* failure (``resilience.classify_failure``
+    → ``stalled``) that the in-process Supervisor rolls back to the
+    last valid checkpoint. Delivery: when the loop runs on the MAIN
+    thread (the normal case) the abort arrives as a process signal
+    whose handler raises ``StalledError`` — this interrupts blocking C
+    sleeps via EINTR and, unlike PyThreadState_SetAsyncExc, cannot be
+    silently lost; SetAsyncExc is the best-effort fallback for loops on
+    other threads. Limitation: a thread wedged inside a C call that
+    ignores EINTR (a device wait, a stuck collective) only aborts when
+    the call returns; process-level supervision (resilience/fleet.py)
+    is the layer that handles those, by killing the process. The
+    monitor runs on a daemon poll thread; ``clock`` is injectable so
+    tests (and the fault harness's ClockStall) can drive time
+    deterministically.
     """
 
     def __init__(self, budget_s: float = 300.0, registry: Registry | None = None,
                  poll_s: float | None = None, clock=time.monotonic,
-                 flightrec=None):
+                 flightrec=None, abort_on_stall: bool = False):
         if budget_s <= 0:
             raise ValueError("budget_s must be positive")
         self.budget_s = budget_s
@@ -288,7 +403,11 @@ class Watchdog(Callback):
         self.poll_s = poll_s if poll_s is not None else max(
             min(budget_s / 4, 1.0), 0.005)
         self.clock = clock
+        self.abort_on_stall = abort_on_stall
         self._beat: float | None = None
+        self._loop_ident: int | None = None  # thread to abort on stall
+        self._abort_issued = False           # abort issued, not consumed
+        self._signal_abort = False           # deliver via signal (main thread)
         self._lock = threading.Lock()  # orders beat writes vs stall flags
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -300,11 +419,19 @@ class Watchdog(Callback):
             "times a train step exceeded the watchdog wall budget")
 
     def on_train_start(self, trainer):
+        # delivery mode decided (and the handler installed) on the loop
+        # thread, BEFORE the poll thread exists
+        self._signal_abort = (
+            self.abort_on_stall
+            and threading.current_thread() is threading.main_thread())
+        if self._signal_abort:
+            _install_stall_handler()
         # same critical section as on_step_end/_watch: a supervised
         # restart re-enters here while a previous attempt's poll thread
         # may still be draining (dtflint: lock-discipline)
         with self._lock:
             self._beat = self.clock()
+            self._loop_ident = threading.get_ident()
             self._m_stalled.set(0.0)
         self._stop.clear()
         self._thread = threading.Thread(
@@ -318,12 +445,39 @@ class Watchdog(Callback):
                                step)
                 self._m_stalled.set(0.0)
             self._beat = self.clock()
+            cancel = self._take_abort_unlocked()
+        if cancel is not None:
+            # the flagged step completed after all: progress wins — a
+            # pending (undelivered) abort must not kill the healthy run.
+            # Tiny race left: an abort delivered between the flag and
+            # this revoke still aborts, which is within semantics (that
+            # step really did exceed the budget).
+            _pending_aborts.discard(id(self))
+            if not self._signal_abort:
+                _async_cancel(cancel)
+            logger.warning("watchdog: step %d completed before the abort "
+                           "delivered; revoked", step)
 
     def on_train_end(self, trainer):
+        with self._lock:
+            cancel = self._take_abort_unlocked()
+        if cancel is not None:
+            # loop exited with the abort still undelivered: revoke so it
+            # cannot land in post-training code (final save, teardown)
+            _pending_aborts.discard(id(self))
+            if not self._signal_abort:
+                _async_cancel(cancel)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+
+    def _take_abort_unlocked(self) -> int | None:
+        """Consume the abort-in-flight marker; caller holds the lock."""
+        if not self._abort_issued:
+            return None
+        self._abort_issued = False
+        return self._loop_ident
 
     def _watch(self) -> None:
         while not self._stop.wait(self.poll_s):
@@ -341,10 +495,24 @@ class Watchdog(Callback):
                 # until a step completes
                 self._m_stalled.set(1.0)
                 self._m_stalls.inc()
+                abort_ident = (self._loop_ident if self.abort_on_stall
+                               else None)
+                if abort_ident is not None:
+                    # issue + marker in ONE critical section: a
+                    # concurrent on_step_end revoke is then strictly
+                    # before (sees no marker, nothing issued yet) or
+                    # strictly after (sees marker, revokes a real issue)
+                    self._abort_issued = True
+                    if self._signal_abort:
+                        _pending_aborts.add(id(self))
+                        os.kill(os.getpid(), _STALL_SIGNAL)
+                    else:
+                        _async_raise(abort_ident, StalledError)
             # outside the lock: the recorder has its own
             self.flightrec.emit("watchdog_stall",
                                 overdue_s=round(overdue, 3),
-                                budget_s=self.budget_s)
+                                budget_s=self.budget_s,
+                                abort=bool(abort_ident))
             logger.error(
                 "watchdog: no step completed for %.1fs "
                 "(budget %.1fs) — host loop or a collective is hung",

@@ -514,6 +514,7 @@ def init_or_restore(
     mesh: Mesh,
     rng: jax.Array,
     fallback: bool = False,
+    step: int | None = None,
     **init_kwargs,
 ):
     """The one-call init-or-restore every train script uses. Builds the
@@ -521,7 +522,9 @@ def init_or_restore(
     the latest checkpoint if one exists. Returns (state, spec_tree,
     restored_bool). ``fallback=True`` = multi-checkpoint fallback restore
     (corrupt steps quarantined, newest valid step wins) — what supervised
-    restarts use."""
+    restarts use. ``step`` caps the restore at that step (the fleet's
+    common-checkpoint ceiling, resilience/fleet.py: every gang member
+    resumes from the same step); ``step=0`` forces a fresh init."""
     from . import step as step_lib
 
     state, specs = step_lib.init_train_state(
@@ -531,7 +534,8 @@ def init_or_restore(
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
     )
-    restored = checkpointer.restore(abstract, fallback=fallback)
+    restored = (None if step == 0 else
+                checkpointer.restore(abstract, step=step, fallback=fallback))
     if restored is not None:
         return restored, specs, True
     return state, specs, False

@@ -204,14 +204,9 @@ class Trainer:
             logger.exception("emergency checkpoint at step %d failed", step)
 
     def _dump_postmortem(self, reason: str) -> None:
-        """Best-effort JSONL postmortem of the flight recorder into the
-        run dir (tools/postmortem.py renders it). Runs on the abnormal
-        exit path, so it must never raise past the original failure."""
-        if not self.postmortem_dir:
-            return
-        try:
-            path = self.flightrec.dump_unique(self.postmortem_dir,
-                                              reason=reason)
-            logger.warning("flight-recorder postmortem dumped to %s", path)
-        except Exception:
-            logger.exception("flight-recorder postmortem dump failed")
+        """Best-effort JSONL postmortem into the run dir
+        (tools/postmortem.py renders it); on the abnormal exit path it
+        must never raise past the original failure — the shared helper
+        guarantees that."""
+        flightrec_lib.dump_postmortem(self.flightrec, self.postmortem_dir,
+                                      reason=reason)

@@ -18,6 +18,15 @@ checkpoint at the restart boundary (fallback restore must quarantine it
 and land on an older valid step), and transient data faults are absorbed
 by a re-seeking ``RetryingIterator`` — one process, every recovery path.
 
+With ``--fleet`` the process is ONE WORKER of a FleetSupervisor gang
+(resilience/fleet.py): it reads the fleet incarnation and restore
+ceiling from ``--fleet-dir``, heartbeats to its per-worker file through
+the Supervisor attempt seam + HeartbeatCallback step seam, and speaks
+the fleet exit-code protocol (0 done / EXIT_PREEMPTED / EXIT_FAILED).
+Injected faults are gated on ``--fault-incarnation`` (default 1): the
+incarnation counter is the cross-process analog of the plan's
+fire-once state, so a relaunched gang does not re-injure itself.
+
 Markers on stdout (the drivers assert on these):
     CHAOS-DONE step=N        run reached the target step
     CHAOS-PREEMPTED step=K   clean PreemptionSaved exit, checkpoint at K
@@ -29,6 +38,10 @@ Markers on stdout (the drivers assert on these):
                              flight recorder dumped to P (--flightrec)
     CHAOS-GOODPUT fraction=F productive_s=P wall_s=W ok=K
                              goodput gauge vs measured wall-clock
+    FLEET-DONE step=N incarnation=K restarts=R
+                             fleet worker reached the target step
+    FLEET-PREEMPTED step=K   fleet worker exited via a preemption save
+    FLEET-FAILED cause=C     fleet worker's in-process supervision exhausted
 """
 
 import argparse
@@ -198,6 +211,125 @@ def _supervised(args, mesh, model, tx) -> int:
     return 0 if ok else 1
 
 
+def _fleet(args, mesh, model, tx) -> int:
+    """One fleet-gang worker: in-process Supervisor for transient/
+    poisoned/stalled failures, but PREEMPTION exits the process (the
+    FleetSupervisor owns process-level restarts), heartbeats through
+    both production seams, restore capped at the fleet's common-step
+    ceiling."""
+    import optax  # noqa: F401  (kept symmetric with main's imports)
+
+    from distributed_tensorflow_tpu.models import common
+    from distributed_tensorflow_tpu.resilience import (
+        FaultPlan, Hang, RetryPolicy, Sigterm, Supervisor, SupervisorConfig,
+        SupervisorExhausted, fleet as fleet_lib,
+    )
+    from distributed_tensorflow_tpu.resilience.supervisor import (
+        POISONED, STALLED, TRANSIENT,
+    )
+    from distributed_tensorflow_tpu.train import (
+        CheckpointConfig, Checkpointer, StepOptions, Trainer,
+        callbacks as cb, init_or_restore, make_train_step,
+    )
+
+    incarnation = fleet_lib.read_incarnation(args.fleet_dir)
+    writer = fleet_lib.HeartbeatWriter(
+        fleet_lib.heartbeat_path(args.fleet_dir, args.worker_index),
+        incarnation=incarnation,
+    )
+    ceiling = fleet_lib.read_restore_step(args.fleet_dir)
+    faults = []
+    if incarnation == args.fault_incarnation:
+        # the incarnation counter is the cross-process fired-state: a
+        # gang relaunched after this fault must not re-fire it
+        if args.hang_at is not None:
+            faults.append(Hang(args.hang_at))
+        if args.sigterm_at is not None:
+            faults.append(Sigterm(args.sigterm_at))
+    plan = FaultPlan(tuple(faults))
+    loss_fn = common.classification_loss_fn(model)
+
+    def batches_from(i0: int):
+        i = i0
+        while True:
+            i += 1
+            yield global_step_batch(i)
+
+    def build(restart_index: int):
+        ckpt = Checkpointer(
+            CheckpointConfig(directory=args.workdir, save_interval_steps=2,
+                             max_to_keep=10, async_save=False,
+                             preemption_check_every=1),
+            mesh,
+        )
+        state, specs, restored = init_or_restore(
+            ckpt, common.make_init_fn(model, (8,)), tx, mesh,
+            jax.random.PRNGKey(0), fallback=True,
+            # the gang ceiling binds the incarnation's FIRST restore
+            # only: an in-process restart later in the same incarnation
+            # must resume from its own newest valid step, not replay
+            # from (or re-init below) the gang restart point
+            step=ceiling if restart_index == 0 else None,
+        )
+        start = int(state.step)
+        if restored:
+            writer.note_restore(start, fallback=True)
+        trainer = Trainer(
+            make_train_step(loss_fn, tx, StepOptions()), state, mesh, specs,
+            # heartbeat FIRST: it must record the step even when
+            # CheckpointCallback raises PreemptionSaved (which skips
+            # every later callback for that step), and before the fault
+            # callback can hang the loop
+            callbacks=[cb.HeartbeatCallback(writer),
+                       cb.CheckpointCallback(ckpt), plan.callback()],
+        )
+        return trainer, plan.wrap(batches_from(start), start=start), ckpt
+
+    sup = Supervisor(
+        build, num_steps=args.steps,
+        cfg=SupervisorConfig(
+            max_restarts=args.max_restarts,
+            # PREEMPTION deliberately absent: a SIGTERM means the fleet
+            # is tearing the gang down — exit so it can relaunch us
+            restart_on=(TRANSIENT, POISONED, STALLED),
+            backoff=RetryPolicy(base_s=0.0, jitter=0.0),
+        ),
+        heartbeat=writer,
+    )
+    try:
+        state = sup.run()
+    except SupervisorExhausted as e:
+        writer.finish("failed", cause=e.cause)
+        print(f"FLEET-FAILED cause={e.cause}", flush=True)
+        return fleet_lib.EXIT_FAILED
+    except BaseException as e:
+        # non-restartable classes are RE-RAISED by the Supervisor, not
+        # wrapped: without this they'd crash rc=1 and the fleet would
+        # misclassify a deterministic fatal bug as a transient death and
+        # burn its whole gang-restart budget replaying it
+        from distributed_tensorflow_tpu.resilience import classify_failure
+
+        import traceback
+
+        traceback.print_exc()
+        cause = classify_failure(e)
+        writer.finish("failed", cause=cause)
+        print(f"FLEET-FAILED cause={cause}", flush=True)
+        return fleet_lib.EXIT_FAILED
+    if int(state.step) < args.steps:
+        writer.finish("preempted")
+        print(f"FLEET-PREEMPTED step={int(state.step)}", flush=True)
+        return fleet_lib.EXIT_PREEMPTED
+    if args.out:
+        leaves = jax.tree.leaves(jax.device_get(state.params))
+        np.savez(args.out, **{f"p{i}": np.asarray(x)
+                              for i, x in enumerate(leaves)})
+    writer.finish("done")
+    print(f"FLEET-DONE step={int(state.step)} incarnation={incarnation} "
+          f"restarts={sup.restarts}", flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("workdir", help="checkpoint directory")
@@ -222,7 +354,22 @@ def main(argv=None) -> int:
     ap.add_argument("--flightrec", default=None,
                     help="supervised mode: dump the flight recorder to this "
                          "JSONL path at the end of the run")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run as one worker of a resilience.FleetSupervisor "
+                         "gang (heartbeats, incarnation, exit-code protocol)")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="fleet control dir (INCARNATION, RESTORE_STEP, "
+                         "heartbeat files)")
+    ap.add_argument("--worker-index", type=int, default=0)
+    ap.add_argument("--hang-at", type=int, default=None,
+                    help="fleet mode: hang the host loop after this GLOBAL "
+                         "step (heartbeats stop, process stays alive)")
+    ap.add_argument("--fault-incarnation", type=int, default=1,
+                    help="fleet mode: inject faults only when the fleet "
+                         "incarnation equals this (default 1 — first launch)")
     args = ap.parse_args(argv)
+    if args.fleet and not args.fleet_dir:
+        raise SystemExit("--fleet requires --fleet-dir")
 
     import optax
 
@@ -240,6 +387,8 @@ def main(argv=None) -> int:
     model = MLP(MLPConfig(hidden_sizes=(16,), num_classes=4))
     tx = optax.adam(1e-2)
 
+    if args.fleet:
+        return _fleet(args, mesh, model, tx)
     if args.supervise:
         return _supervised(args, mesh, model, tx)
     ckpt = Checkpointer(

@@ -236,3 +236,84 @@ def test_telemetry_callback_sanitizes_metric_names():
 def test_telemetry_callback_defaults_to_process_registry():
     tc = cb.TelemetryCallback(every_n=1, clock=FakeClock())
     assert tc.registry is obs.default_registry()
+
+
+# ---------------------------------------------------------------------------
+# Watchdog abort_on_stall + fleet heartbeat seam (ISSUE 8 satellites)
+# ---------------------------------------------------------------------------
+
+
+class ManualClock:
+    """Clock that moves only when the test moves it."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_watchdog_abort_on_stall_raises_stalled_error():
+    """abort mode: the stall edge delivers a StalledError asynchronously
+    into the thread that entered on_train_start, so a hung attempt dies
+    classified (resilience maps it to 'stalled') instead of only
+    flagging a gauge."""
+    import time
+
+    reg = obs.Registry()
+    clk = ManualClock()
+    wd = cb.Watchdog(budget_s=5.0, registry=reg, poll_s=0.005, clock=clk,
+                     abort_on_stall=True)
+    t = StubTrainer()
+    wd.on_train_start(t)
+    try:
+        clk.t = 100.0  # hung step: way over budget, no on_step_end
+        with pytest.raises(cb.StalledError):
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:  # the "hung" Python loop
+                time.sleep(0.001)
+            raise AssertionError("watchdog never aborted the loop")
+    finally:
+        wd.on_train_end(t)
+    assert reg.get("train_watchdog_stalls_total").value == 1
+    assert reg.get("train_watchdog_stalled").value == 1.0
+
+
+def test_watchdog_default_never_aborts():
+    """Detection-only default: same stall, no exception — the gauge and
+    counter remain the only record."""
+    import time
+
+    reg = obs.Registry()
+    clk = ManualClock()
+    wd = cb.Watchdog(budget_s=5.0, registry=reg, poll_s=0.005, clock=clk)
+    t = StubTrainer()
+    wd.on_train_start(t)
+    try:
+        clk.t = 100.0
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if reg.get("train_watchdog_stalls_total").value:
+                break
+            time.sleep(0.001)
+        time.sleep(0.05)  # would-be delivery window: nothing may raise
+    finally:
+        wd.on_train_end(t)
+    assert reg.get("train_watchdog_stalls_total").value == 1
+
+
+def test_heartbeat_callback_beats_from_step_seam(tmp_path):
+    from distributed_tensorflow_tpu.resilience import fleet as fl
+
+    w = fl.HeartbeatWriter(str(tmp_path / "hb.json"), incarnation=1)
+    hb_cb = cb.HeartbeatCallback(w, every_n=2)
+    t = StubTrainer()
+    hb_cb.on_train_start(t)
+    hb = fl.read_heartbeat(str(tmp_path / "hb.json"))
+    assert hb.phase == "train"
+    seq0 = hb.seq
+    hb_cb.on_step_end(t, 1, {})  # off-cadence: no write
+    assert fl.read_heartbeat(str(tmp_path / "hb.json")).seq == seq0
+    hb_cb.on_step_end(t, 2, {})
+    hb = fl.read_heartbeat(str(tmp_path / "hb.json"))
+    assert hb.seq == seq0 + 1 and hb.step == 2

@@ -1,0 +1,661 @@
+"""Fleet supervision (resilience/fleet.py): heartbeat protocol,
+liveness staleness edge cases on an injected clock (stale-but-ticking vs
+absent vs previous-incarnation), exit-code classification, the gang
+restart state machine driven by scripted fake workers, restart-budget
+exhaustion with a postmortem that passes the ``--expect`` chain — and
+the subprocess E2E acceptance gate: a 2-worker gang where one worker
+hangs mid-run, is detected by missed heartbeats, and the gang-restarted
+fleet finishes with params bit-identical to an uninterrupted run."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu import resilience as rz
+from distributed_tensorflow_tpu.obs import flightrec as fr
+from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+from distributed_tensorflow_tpu.obs.registry import Registry
+from distributed_tensorflow_tpu.resilience import fleet as fl
+from distributed_tensorflow_tpu.runtime import io as io_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "chaos_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat writer / reader
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_roundtrip_and_persistence(tmp_path):
+    path = str(tmp_path / "hb.json")
+    clk = rz.FaultClock(10.0)
+    w = fl.HeartbeatWriter(path, incarnation=3, clock=clk)
+    w.beat(step=5, attempt=1, phase="train")
+    hb = fl.read_heartbeat(path)
+    assert (hb.pid, hb.seq, hb.step, hb.attempt) == (os.getpid(), 1, 5, 1)
+    assert (hb.incarnation, hb.phase, hb.t) == (3, "train", 10.0)
+    assert hb.restore_step is None
+    # fields persist across beats; seq is strictly monotonic
+    w.note_restore(4, fallback=True)
+    w.beat(step=6)
+    hb = fl.read_heartbeat(path)
+    assert hb.seq == 3 and hb.step == 6
+    assert hb.restore_step == 4 and hb.restore_fallback is True
+    w.finish("done")
+    hb = fl.read_heartbeat(path)
+    assert hb.phase == "done" and hb.restore_step == 4
+    assert not os.path.exists(path + ".tmp")  # atomic: tmp never lingers
+
+
+def test_heartbeat_reader_absent_and_garbage(tmp_path):
+    assert fl.read_heartbeat(str(tmp_path / "nope.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert fl.read_heartbeat(str(bad)) is None  # unreadable == absent
+
+
+def test_heartbeat_pulse_thread_ticks_and_stops(tmp_path):
+    path = str(tmp_path / "hb.json")
+    w = fl.HeartbeatWriter(path, incarnation=1, pulse_interval_s=0.005)
+    import time as time_lib
+
+    deadline = time_lib.monotonic() + 5.0
+    while time_lib.monotonic() < deadline:
+        hb = fl.read_heartbeat(path)
+        if hb is not None and hb.seq >= 3:
+            break
+        time_lib.sleep(0.005)
+    assert fl.read_heartbeat(path).seq >= 3, "pulse thread never beat"
+    w.close()
+    seq = fl.read_heartbeat(path).seq
+    time_lib.sleep(0.05)
+    assert fl.read_heartbeat(path).seq == seq  # stopped
+
+
+# ---------------------------------------------------------------------------
+# Liveness monitor: the staleness edge cases, on an injected clock
+# ---------------------------------------------------------------------------
+
+
+def _monitor(path, clk, incarnation=1):
+    return fl.HeartbeatMonitor(
+        path, incarnation, clock=clk,
+        heartbeat_timeout_s=5.0, stall_timeout_s=10.0, launch_grace_s=20.0)
+
+
+def test_monitor_absent_heartbeat_is_death_after_grace(tmp_path):
+    clk = rz.FaultClock()
+    m = _monitor(str(tmp_path / "hb.json"), clk)
+    assert m.check() == fl.WAITING
+    clk.advance(19.0)
+    assert m.check() == fl.WAITING  # still inside the launch grace
+    clk.advance(2.0)
+    assert m.check() == fl.DEAD
+
+
+def test_monitor_silent_heartbeat_is_death(tmp_path):
+    path = str(tmp_path / "hb.json")
+    clk = rz.FaultClock()
+    w = fl.HeartbeatWriter(path, incarnation=1, clock=clk)
+    m = _monitor(path, clk)
+    w.beat(step=1, phase="train")
+    assert m.check() == fl.LIVE
+    clk.advance(4.0)
+    assert m.check() == fl.LIVE      # within the beat budget
+    clk.advance(2.0)
+    assert m.check() == fl.DEAD      # absent: seq frozen past budget
+
+
+def test_monitor_ticking_but_frozen_step_is_stall(tmp_path):
+    path = str(tmp_path / "hb.json")
+    clk = rz.FaultClock()
+    w = fl.HeartbeatWriter(path, incarnation=1, clock=clk)
+    m = _monitor(path, clk)
+    w.beat(step=7, phase="train")
+    assert m.check() == fl.LIVE
+    for _ in range(4):               # stale-but-ticking: seq up, step frozen
+        clk.advance(3.0)
+        w.beat()                     # pulse-style beat, same step
+        status = m.check()
+    assert status == fl.STALLED_HB
+    # a step advancing clears the stall judgment
+    w.beat(step=8)
+    assert m.check() == fl.LIVE
+
+
+def test_monitor_ignores_previous_incarnation(tmp_path):
+    """A heartbeat freshly WRITTEN by a straggler of the previous
+    incarnation must read as absent — never as liveness."""
+    path = str(tmp_path / "hb.json")
+    clk = rz.FaultClock()
+    old = fl.HeartbeatWriter(path, incarnation=1, clock=clk)
+    m = _monitor(path, clk, incarnation=2)
+    for _ in range(21):
+        old.beat(step=3, phase="train")  # fresh writes, wrong incarnation
+        clk.advance(1.0)
+    assert m.check() == fl.DEAD
+    # the new incarnation checking in flips it to live
+    fl.HeartbeatWriter(path, incarnation=2, clock=clk).beat(phase="train")
+    assert m.check() == fl.LIVE
+
+
+def test_monitor_stall_judges_any_phase_progress(tmp_path):
+    """Progress = (step, attempt, phase) changing. A pulsed worker hung
+    in build/restore (phase init, seq ticking) must stall out like a
+    mid-train hang — otherwise the pulse thread makes init-phase hangs
+    permanently invisible. Attempt/phase transitions count as progress;
+    terminal phases are exempt (the process is exiting)."""
+    path = str(tmp_path / "hb.json")
+    clk = rz.FaultClock()
+    w = fl.HeartbeatWriter(path, incarnation=1, clock=clk)
+    m = _monitor(path, clk)
+    w.beat(phase="init")
+    assert m.check() == fl.LIVE      # anchors the progress clock
+    for _ in range(4):
+        clk.advance(3.0)
+        w.beat()                     # pulse: seq up, no progress
+        status = m.check()
+    assert status == fl.STALLED_HB   # init-phase hang detected
+    w.beat(attempt=1)                # a new attempt IS progress
+    assert m.check() == fl.LIVE
+    # terminal phases hold the step frozen legitimately
+    w.beat(phase="done")
+    for _ in range(5):
+        clk.advance(3.0)
+        w.beat()
+        assert m.check() == fl.LIVE
+
+
+# ---------------------------------------------------------------------------
+# Control files + common checkpoint step
+# ---------------------------------------------------------------------------
+
+
+def test_incarnation_and_restore_files(tmp_path):
+    d = str(tmp_path / "fleet")
+    assert fl.read_incarnation(d) == 0
+    assert fl.read_restore_step(d) is None
+    fl.write_incarnation(d, 4)
+    fl.write_restore_step(d, 12)
+    assert fl.read_incarnation(d) == 4
+    assert fl.read_restore_step(d) == 12
+
+
+def _fake_ckpt_step(ckpt_dir, step, nbytes=64, manifest=True):
+    d = os.path.join(ckpt_dir, str(step))
+    os.makedirs(d, exist_ok=True)
+    shard = os.path.join(d, "shard.bin")
+    with open(shard, "wb") as f:
+        f.write(os.urandom(nbytes))
+    if manifest:
+        payload = (
+            '{"step": %d, "files": [{"path": "shard.bin", "bytes": %d}]}'
+            % (step, nbytes)
+        ).encode()
+        io_lib.write_payload(os.path.join(d, "MANIFEST.dtf"), payload)
+    return shard
+
+
+def test_newest_common_valid_step(tmp_path):
+    w0, w1 = str(tmp_path / "w0"), str(tmp_path / "w1")
+    _fake_ckpt_step(w0, 2)
+    shard4 = _fake_ckpt_step(w0, 4)
+    _fake_ckpt_step(w1, 2)
+    assert fl.newest_valid_step(w0) == 4
+    assert fl.newest_common_valid_step([w0, w1]) == 2
+    # torn newest shard: size check fails, older step wins
+    with open(shard4, "r+b") as f:
+        f.truncate(10)
+    assert fl.newest_valid_step(w0) == 2
+    # pre-manifest steps count as valid (restore unchecked, by design)
+    _fake_ckpt_step(w1, 6, manifest=False)
+    assert fl.newest_valid_step(w1) == 6
+    # a worker with nothing restorable pins the gang to a fresh start
+    assert fl.newest_common_valid_step([w0, str(tmp_path / "empty")]) == 0
+    assert fl.newest_common_valid_step([]) is None
+    # retention gap: a worker retaining ONLY steps newer than the
+    # others' must not yield a ceiling it cannot restore itself — no
+    # shared step means a gang-wide fresh start, never a split gang
+    w2 = str(tmp_path / "w2")
+    _fake_ckpt_step(w2, 10)
+    assert fl.newest_common_valid_step([w0, w2]) == 0
+    assert fl.newest_common_valid_step([w1, str(tmp_path / "w3")]) == 0
+
+
+def test_restore_step_cleared_by_new_fleet_run(tmp_path):
+    """A RESTORE_STEP left by a previous fleet run must not cap a new
+    run's restores at an old step."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    fl.write_restore_step(fleet_dir, 2)  # stale ceiling from an old run
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        _beat(fleet_dir, i, incarnation, clk, step=8, phase="done")
+        p.rc = 0
+        return p
+
+    fleet, rec, reg = _mk_fleet(tmp_path, launch, clk, sc, n=1)
+    fleet.run()
+    assert fl.read_restore_step(fleet_dir) is None
+
+
+# ---------------------------------------------------------------------------
+# FleetSupervisor state machine, scripted fake workers, injected clock
+# ---------------------------------------------------------------------------
+
+
+class FakeProc:
+    """The Popen control surface the fleet drives, fully scripted."""
+
+    _next_pid = 1000
+
+    def __init__(self):
+        FakeProc._next_pid += 1
+        self.pid = FakeProc._next_pid
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        # a cooperative worker takes its preemption save and exits
+        if self.rc is None:
+            self.rc = fl.EXIT_PREEMPTED
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+class Scenario:
+    """Deterministic world driver: the fleet's injected ``sleep``
+    advances the FaultClock and fires scheduled actions, so process
+    deaths and heartbeats happen at exact simulated times."""
+
+    def __init__(self, clk):
+        self.clk = clk
+        self._events = []
+
+    def at(self, t, fn):
+        self._events.append([float(t), fn, False])
+
+    def sleep(self, s):
+        self.clk.advance(s)
+        for ev in sorted(self._events, key=lambda e: e[0]):
+            if not ev[2] and self.clk.t >= ev[0]:
+                ev[2] = True
+                ev[1]()
+
+
+def _mk_fleet(tmp_path, launch, clk, scenario, *, n=2, max_restarts=2,
+              ckpt_dirs=None):
+    rec = FlightRecorder(clock=clk)
+    reg = Registry()
+    cfg = fl.FleetConfig(
+        max_restarts=max_restarts,
+        backoff=rz.RetryPolicy(base_s=0.0, jitter=0.0),
+        poll_s=1.0, heartbeat_timeout_s=5.0, stall_timeout_s=10.0,
+        launch_grace_s=20.0, term_grace_s=4.0)
+    fleet = fl.FleetSupervisor(
+        launch, n, str(tmp_path / "fleet"), cfg, ckpt_dirs=ckpt_dirs,
+        registry=reg, flightrec=rec, clock=clk, sleep=scenario.sleep)
+    return fleet, rec, reg
+
+
+def _beat(fleet_dir, worker, incarnation, clk, *, step=None, phase="train",
+          restore=None, cause=None):
+    w = fl.HeartbeatWriter(fl.heartbeat_path(fleet_dir, worker),
+                           incarnation=incarnation, clock=clk)
+    if restore is not None:
+        w.note_restore(restore, fallback=True)
+    if cause is not None:
+        w.finish(phase, cause=cause)
+    else:
+        w.beat(step=step, phase=phase)
+
+
+def test_fleet_gang_restart_on_worker_death(tmp_path):
+    """Exit-code death of one worker → whole-gang SIGTERM, incarnation
+    bump, relaunch; the relayed restore note lands BEFORE fleet_restart
+    so the timeline reads causally."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    launches = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        launches.append((i, incarnation, p))
+        if incarnation == 2:
+            # relaunched worker: restores at the common step, finishes
+            _beat(fleet_dir, i, 2, clk, step=8, phase="done", restore=4)
+            p.rc = 0
+        return p
+
+    fleet, rec, reg = _mk_fleet(tmp_path, launch, clk, sc)
+    sc.at(1.0, lambda: _beat(fleet_dir, 0, 1, clk, step=2))
+    sc.at(1.0, lambda: _beat(fleet_dir, 1, 1, clk, step=2))
+    # worker 1 dies hard (SIGKILL-shaped rc); worker 0 stays healthy
+    sc.at(2.0, lambda: setattr(launches[1][2], "rc", -9))
+    sc.at(3.0, lambda: _beat(fleet_dir, 0, 1, clk, step=3))
+
+    out = fleet.run()
+    assert out == {"restarts": 1, "incarnation": 2}
+    assert fl.read_incarnation(fleet_dir) == 2
+    assert [(i, inc) for i, inc, _ in launches] == [
+        (0, 1), (1, 1), (0, 2), (1, 2)]
+    # the survivor got the gang-stop SIGTERM
+    assert launches[0][2].rc == fl.EXIT_PREEMPTED
+    assert fr.contains_in_order(rec.events(), [
+        ("fleet_start", {"workers": 2}),
+        ("fleet_launch", {"worker": 0, "incarnation": 1}),
+        ("fleet_worker_dead", {"worker": 1, "cause": rz.TRANSIENT}),
+        ("fleet_gang_stop", {"cause": rz.TRANSIENT}),
+        ("ckpt_restore", {"fallback": True, "relayed": True}),
+        ("fleet_restart", {"restart": 1, "cause": rz.TRANSIENT}),
+        ("fleet_done", {"incarnation": 2}),
+    ])
+    assert reg.get(fl.FLEET_RESTARTS_TOTAL, cause=rz.TRANSIENT).value == 1
+    assert reg.get(fl.FLEET_WORKER_DEATHS_TOTAL).value == 1
+
+
+def test_fleet_detects_missed_heartbeats_and_exhausts(tmp_path):
+    """A worker that stays alive but never beats is declared dead by
+    liveness; with the budget at 0 the fleet raises FleetExhausted and
+    the dumped postmortem passes the tools/postmortem.py --expect
+    chain."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    procs = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        procs.append(p)
+        return p
+
+    fleet, rec, reg = _mk_fleet(tmp_path, launch, clk, sc, max_restarts=0)
+    sc.at(1.0, lambda: _beat(fleet_dir, 0, 1, clk, step=1))
+    # worker 1: alive forever, zero beats → dead after the launch grace
+    with pytest.raises(fl.FleetExhausted) as ei:
+        fleet.run()
+    assert ei.value.cause == rz.TRANSIENT
+    assert "heartbeat" in str(ei.value)
+    assert all(p.rc is not None for p in procs)  # gang fully stopped
+    dump = os.path.join(fleet.workdir, "postmortem.jsonl")
+    assert os.path.exists(dump)
+    spec = importlib.util.spec_from_file_location(
+        "postmortem", os.path.join(REPO, "tools", "postmortem.py"))
+    pm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pm)
+    assert pm.main([dump, "--quiet", "--expect",
+                    "fleet_start,fleet_worker_dead[cause=transient],"
+                    "fleet_gang_stop,fleet_exhausted[cause=transient]"]) == 0
+
+
+def test_fleet_stall_is_classified_stalled(tmp_path):
+    """Heartbeats ticking but the step frozen → the per-process stall
+    judgment, classified through classify_failure(StalledError) =
+    'stalled'."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+
+    def launch(i, incarnation):
+        return FakeProc()
+
+    fleet, rec, reg = _mk_fleet(tmp_path, launch, clk, sc, n=1,
+                                max_restarts=0)
+    # ONE writer so seq keeps ticking (pulse-style) while the step never
+    # advances — the live-but-frozen process the stall budget exists for
+    w = fl.HeartbeatWriter(fl.heartbeat_path(fleet_dir, 0), incarnation=1,
+                           clock=clk)
+    sc.at(0.5, lambda: w.beat(step=5, phase="train"))
+    for t in range(1, 40):
+        sc.at(float(t), w.beat)
+    with pytest.raises(fl.FleetExhausted) as ei:
+        fleet.run()
+    assert ei.value.cause == rz.STALLED
+    assert fr.contains_in_order(rec.events(), [
+        ("fleet_worker_dead", {"cause": rz.STALLED}),
+        ("fleet_gang_stop", {}), ("fleet_exhausted", {"cause": rz.STALLED}),
+    ])
+
+
+def test_fleet_nonrestartable_cause_raises_without_restart(tmp_path):
+    """EXIT_FAILED with a fatal cause in the final heartbeat must not
+    burn a restart — it raises immediately."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    procs = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        procs.append(p)
+        return p
+
+    fleet, rec, reg = _mk_fleet(tmp_path, launch, clk, sc, n=1,
+                                max_restarts=5)
+    def fail():
+        _beat(fleet_dir, 0, 1, clk, phase="failed", cause=rz.FATAL)
+        procs[0].rc = fl.EXIT_FAILED
+
+    sc.at(1.0, fail)
+    with pytest.raises(fl.FleetExhausted) as ei:
+        fleet.run()
+    assert ei.value.cause == rz.FATAL
+    assert fleet.restarts == 0
+    assert len(procs) == 1  # never relaunched
+
+
+def test_fleet_spontaneous_preemption_restarts_gang(tmp_path):
+    """A worker exiting via its coordinated preemption save (rc 75, not
+    ours) is a restartable gang failure with cause=preemption."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    launches = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        launches.append((incarnation, p))
+        if incarnation == 2:
+            _beat(fleet_dir, i, 2, clk, step=8, phase="done", restore=2)
+            p.rc = 0
+        return p
+
+    fleet, rec, reg = _mk_fleet(tmp_path, launch, clk, sc, n=1)
+    sc.at(1.0, lambda: _beat(fleet_dir, 0, 1, clk, step=3))
+    sc.at(2.0, lambda: setattr(launches[0][1], "rc", fl.EXIT_PREEMPTED))
+    out = fleet.run()
+    assert out["restarts"] == 1
+    assert reg.get(fl.FLEET_RESTARTS_TOTAL, cause=rz.PREEMPTION).value == 1
+
+
+def test_fleet_writes_common_restore_ceiling(tmp_path):
+    """At a gang restart the fleet computes the newest step EVERY worker
+    can restore and writes it as the ceiling the relaunch reads."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    w0, w1 = str(tmp_path / "ck0"), str(tmp_path / "ck1")
+    _fake_ckpt_step(w0, 2)
+    _fake_ckpt_step(w0, 4)
+    _fake_ckpt_step(w0, 6)
+    _fake_ckpt_step(w1, 2)
+    _fake_ckpt_step(w1, 4)
+    launches = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        launches.append(p)
+        if incarnation == 2:
+            _beat(fleet_dir, i, 2, clk, step=8, phase="done", restore=4)
+            p.rc = 0
+        return p
+
+    fleet, rec, reg = _mk_fleet(tmp_path, launch, clk, sc, n=2,
+                                ckpt_dirs=[w0, w1])
+    sc.at(1.0, lambda: _beat(fleet_dir, 0, 1, clk, step=6))
+    sc.at(1.0, lambda: _beat(fleet_dir, 1, 1, clk, step=4))
+    sc.at(2.0, lambda: setattr(launches[1], "rc", 1))  # crash
+    fleet.run()
+    assert fl.read_restore_step(fleet_dir) == 4  # newest shared step
+    # abandoned history above the ceiling is moved aside: left in
+    # place, w0's step 6 would shadow the re-trained step 6 forever
+    # (save() skips existing step numbers)
+    assert not os.path.isdir(os.path.join(w0, "6"))
+    assert os.path.isdir(os.path.join(w0, ".abandoned", "6"))
+    assert fl.valid_steps(w0) == [2, 4]
+
+
+def test_fleet_flags_restore_divergence(tmp_path):
+    """A relaunched worker whose restore landed on a DIFFERENT step
+    than the gang ceiling (quarantined copy, fresh init) is a
+    gang-consistency failure, not a silent split gang."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    w0 = str(tmp_path / "ck0")
+    _fake_ckpt_step(w0, 4)
+    launches = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        launches.append(p)
+        if incarnation == 2:
+            # worker claims it restored step 2, but the gang ceiling is 4
+            _beat(fleet_dir, i, 2, clk, step=8, phase="train", restore=2)
+        return p
+
+    fleet, rec, reg = _mk_fleet(tmp_path, launch, clk, sc, n=1,
+                                max_restarts=1, ckpt_dirs=[w0])
+    sc.at(1.0, lambda: _beat(fleet_dir, 0, 1, clk, step=4))
+    sc.at(2.0, lambda: setattr(launches[0], "rc", -9))
+    with pytest.raises(fl.FleetExhausted) as ei:
+        fleet.run()
+    assert ei.value.cause == rz.TRANSIENT
+    assert "divergence" in str(ei.value)
+    assert fr.contains_in_order(rec.events(), [
+        ("fleet_restart", {}),  # never emitted for the diverged gang
+    ]) is False
+    assert fr.contains_in_order(rec.events(), [
+        ("fleet_worker_dead", {"cause": rz.TRANSIENT}),
+        ("fleet_gang_stop", {}),
+        ("fleet_worker_dead", {"cause": rz.TRANSIENT}),
+        ("fleet_exhausted", {}),
+    ])
+
+
+def test_fleet_interrupt_wakes_default_wait():
+    import time as time_lib
+
+    fleet = fl.FleetSupervisor(lambda i, k: FakeProc(), 1, "/tmp/unused-fleet",
+                               flightrec=FlightRecorder(), registry=Registry())
+    fleet.interrupt()
+    t0 = time_lib.monotonic()
+    fleet._wait(30.0)
+    assert time_lib.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# Subprocess E2E: missed-heartbeat death → gang restart → bit-identity
+# ---------------------------------------------------------------------------
+
+
+def _env():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the worker sets its own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _run_straight(workdir, out, timeout=240):
+    p = subprocess.run(
+        [sys.executable, WORKER, str(workdir), "--steps", "8", "--out", out],
+        capture_output=True, text=True, timeout=timeout, env=_env(),
+    )
+    assert p.returncode == 0, f"rc={p.returncode}:\n{p.stdout}\n{p.stderr}"
+    assert "CHAOS-DONE step=8" in p.stdout, p.stdout
+
+
+def test_fleet_e2e_gang_restart_bit_identical(tmp_path):
+    """THE fleet acceptance gate: worker 1 hangs mid-run (heartbeats
+    stop, process alive), the FleetSupervisor detects the death by
+    missed heartbeats, gang-restarts with a bumped incarnation from the
+    latest common valid checkpoint, and every worker's final params are
+    bit-identical to an uninterrupted same-seed run."""
+    straight_out = str(tmp_path / "straight.npz")
+    _run_straight(tmp_path / "straight_ckpt", straight_out)
+
+    fleet_dir = str(tmp_path / "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    ckpt_dirs = [str(tmp_path / f"ckpt{i}") for i in range(2)]
+    outs = [str(tmp_path / f"out{i}.npz") for i in range(2)]
+
+    def launch(i, incarnation):
+        args = [sys.executable, WORKER, ckpt_dirs[i], "--fleet",
+                "--fleet-dir", fleet_dir, "--worker-index", str(i),
+                "--steps", "8", "--out", outs[i]]
+        if i == 1:
+            args += ["--hang-at", "3"]  # gated to incarnation 1
+        log = open(os.path.join(fleet_dir, f"worker{i}-inc{incarnation}.log"),
+                   "w")
+        try:
+            return subprocess.Popen(args, stdout=log,
+                                    stderr=subprocess.STDOUT, env=_env())
+        finally:
+            log.close()
+
+    rec = FlightRecorder()
+    reg = Registry()
+    fleet = fl.FleetSupervisor(
+        launch, 2, fleet_dir,
+        fl.FleetConfig(max_restarts=2,
+                       backoff=rz.RetryPolicy(base_s=0.0, jitter=0.0),
+                       poll_s=0.2, heartbeat_timeout_s=20.0,
+                       stall_timeout_s=600.0, launch_grace_s=180.0,
+                       term_grace_s=5.0),
+        ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec)
+    out = fleet.run()
+
+    assert out["restarts"] == 1, _logs(fleet_dir)
+    assert out["incarnation"] == 2
+    assert fl.read_incarnation(fleet_dir) == 2
+    # the hung worker had saved step 2 (cadence 2, hang at 3): the
+    # common valid step the gang restarted from must honor it
+    assert fl.read_restore_step(fleet_dir) == 2
+    assert fr.contains_in_order(rec.events(), [
+        ("fleet_worker_dead", {"worker": 1, "cause": rz.TRANSIENT}),
+        ("fleet_gang_stop", {"cause": rz.TRANSIENT}),
+        ("ckpt_restore", {"fallback": True, "relayed": True}),
+        ("fleet_restart", {"restart": 1, "incarnation": 2}),
+        ("fleet_done", {}),
+    ]), rec.events()
+    assert reg.get(fl.FLEET_WORKER_DEATHS_TOTAL).value == 1
+
+    a = np.load(straight_out)
+    for o in outs:
+        b = np.load(o)
+        assert sorted(a.files) == sorted(b.files) and a.files
+        for k in a.files:
+            np.testing.assert_array_equal(a[k], b[k])  # BIT-identical
+
+
+def _logs(fleet_dir):
+    chunks = []
+    for n in sorted(os.listdir(fleet_dir)):
+        if n.endswith(".log"):
+            with open(os.path.join(fleet_dir, n)) as f:
+                chunks.append(f"--- {n} ---\n{f.read()}")
+    return "\n".join(chunks)

@@ -329,3 +329,109 @@ def test_telemetry_accumulates_across_supervised_restarts(mesh8, tmp_path):
                        cause="preemption").value == 1.0
     finally:
         signal.signal(signal.SIGTERM, orig)
+
+
+# ---------------------------------------------------------------------------
+# Stalled attempts (Watchdog abort_on_stall) + interruptible backoff
+# (ISSUE 8 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_classify_stalled():
+    assert rz.classify_failure(rz.StalledError()) == rz.STALLED
+    assert rz.STALLED in rz.SupervisorConfig().restart_on
+    rz.SupervisorConfig(restart_on=(rz.STALLED,))  # accepted explicitly
+
+
+def test_supervisor_restarts_on_stalled_attempt(mesh8, tmp_path):
+    """A hung step (Hang fault spinning the loop) is converted by the
+    Watchdog's abort_on_stall into a StalledError, classified 'stalled',
+    and restarted from the last checkpoint — the attempt finishes at the
+    target step on its second life."""
+    import threading
+
+    reg = Registry()
+    fclk = rz.FaultClock()
+    plan = rz.FaultPlan((rz.Hang(3, advance=600.0),))
+    tx = optax.sgd(0.1)
+
+    def build(restart_index):
+        ckpt = Checkpointer(
+            CheckpointConfig(directory=str(tmp_path), save_interval_steps=1,
+                             async_save=False, preemption_check_every=1),
+            mesh8, registry=reg,
+        )
+        state, specs, _ = init_or_restore(
+            ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0),
+            fallback=True,
+        )
+        start = int(state.step)
+        wd = cb.Watchdog(budget_s=300.0, registry=reg, poll_s=0.005,
+                         clock=fclk, abort_on_stall=True)
+        trainer = Trainer(
+            make_train_step(linear_loss, tx), state, mesh8, specs,
+            # checkpoint BEFORE the fault callback: step 3 is saved
+            # before the hang, so the restart resumes past it
+            callbacks=[wd, cb.CheckpointCallback(ckpt),
+                       plan.callback(clock=fclk)],
+        )
+        return trainer, _batches_from(start), ckpt
+
+    sup = rz.Supervisor(build, num_steps=6, cfg=_fast_cfg(max_restarts=2),
+                        registry=reg, sleep=lambda s: None)
+    state = sup.run()
+    assert int(state.step) == 6
+    assert sup.restarts == 1
+    assert reg.get("supervisor_restarts_total", cause=rz.STALLED).value == 1
+    assert reg.get("train_watchdog_stalls_total").value >= 1
+    assert threading.active_count() < 20  # watchdog threads joined
+
+
+def test_backoff_wait_wakes_on_sigterm_and_redelivers():
+    """SIGTERM during a restart backoff must wake the sleep immediately
+    and re-deliver to the handler that owned the signal before the
+    backoff — the preemption is processed at once, not after up to a
+    full backoff interval."""
+    import os
+    import threading
+    import time
+
+    received = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: received.append(s))
+    try:
+        sup = rz.Supervisor(lambda i: (None, [], None), num_steps=1)
+        threading.Timer(
+            0.2, lambda: os.kill(os.getpid(), signal.SIGTERM)).start()
+        t0 = time.monotonic()
+        sup._backoff_wait(60.0)
+        assert time.monotonic() - t0 < 30.0
+        deadline = time.monotonic() + 5.0
+        while not received and time.monotonic() < deadline:
+            time.sleep(0.001)  # re-delivered signal is async
+        assert received == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_backoff_wait_interrupt_not_lost_but_consumed():
+    import time
+
+    sup = rz.Supervisor(lambda i: (None, [], None), num_steps=1)
+    sup.interrupt()  # before the wait: the wakeup must not be lost
+    t0 = time.monotonic()
+    sup._backoff_wait(60.0)
+    assert time.monotonic() - t0 < 30.0
+    # ...but it is consumed: the NEXT backoff waits its delay again
+    # (a sticky event would turn every later restart into a zero-delay
+    # restart storm)
+    t0 = time.monotonic()
+    sup._backoff_wait(0.3)
+    assert time.monotonic() - t0 >= 0.25
+
+
+def test_backoff_wait_injected_sleep_bypasses_signals():
+    slept = []
+    sup = rz.Supervisor(lambda i: (None, [], None), num_steps=1,
+                        sleep=slept.append)
+    sup._backoff_wait(3.5)
+    assert slept == [3.5]

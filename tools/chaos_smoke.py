@@ -1,9 +1,9 @@
 #!/usr/bin/env python
 """Fast chaos smoke — the resilience gates quick enough for tools/ci_fast.sh.
 
-Three stages (full coverage lives in tests/test_resilience.py,
-tests/test_supervisor.py and tests/test_serve.py; this is the canary
-that the recovery machinery is wired at all):
+Four stages (full coverage lives in tests/test_resilience.py,
+tests/test_supervisor.py, tests/test_fleet.py and tests/test_serve.py;
+this is the canary that the recovery machinery is wired at all):
 
 1. **Scheduler admission invariants** (pure host, no device work):
    bounded-queue backpressure raises QueueFull, deadlines evict with
@@ -18,6 +18,12 @@ that the recovery machinery is wired at all):
    run — the in-process Supervisor restarts, fallback restore
    quarantines the corrupt step and lands on an older valid one, and the
    run must still finish at the target step with finite params.
+4. **One fleet gang-restart round** (resilience/fleet.py over two
+   chaos_worker --fleet subprocesses): worker 1 hangs mid-run, the
+   FleetSupervisor detects the death by MISSED HEARTBEATS (the process
+   is still alive), SIGTERM/SIGKILLs the gang, bumps the incarnation,
+   and relaunches from the latest common valid checkpoint — both
+   workers must finish at the target step after exactly one restart.
 
 Usage: JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 """
@@ -134,10 +140,80 @@ def supervised_recovery_round() -> None:
           f"{POSTMORTEM_ARTIFACT})")
 
 
+#: where the fleet round's flight-recorder dump lands — a stable
+#: artifact so tools/ci_fast.sh can gate on the gang-restart causal
+#: chain with tools/postmortem.py --expect
+FLEET_POSTMORTEM_ARTIFACT = os.environ.get(
+    "DTF_FLEET_POSTMORTEM",
+    os.path.join(_REPO, "artifacts", "fleet_postmortem.jsonl"),
+)
+
+#: the causal story the fleet round's timeline must tell, in order
+#: (shared with ci_fast.sh's fleet postmortem gate)
+FLEET_EXPECT = (
+    "fleet_worker_dead,fleet_gang_stop,ckpt_restore[fallback=True],"
+    "fleet_restart,fleet_done"
+)
+
+
+def fleet_round() -> None:
+    """Worker 1 hangs (heartbeats stop, process alive) → the fleet
+    detects the death by missed heartbeats, gang-stops, and relaunches
+    everyone at incarnation 2 from the latest common valid checkpoint.
+    The flight-recorder dump is left at FLEET_POSTMORTEM_ARTIFACT for
+    the ci_fast gate."""
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.obs.registry import Registry
+    from distributed_tensorflow_tpu.resilience import RetryPolicy
+    from distributed_tensorflow_tpu.resilience import fleet as fl
+
+    os.makedirs(os.path.dirname(FLEET_POSTMORTEM_ARTIFACT), exist_ok=True)
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke_fleet_") as d:
+        fleet_dir = os.path.join(d, "fleet")
+        os.makedirs(fleet_dir)
+        ckpt_dirs = [os.path.join(d, f"ckpt{i}") for i in range(2)]
+
+        def launch(i, incarnation):
+            args = [sys.executable, WORKER, ckpt_dirs[i], "--fleet",
+                    "--fleet-dir", fleet_dir, "--worker-index", str(i),
+                    "--steps", "6"]
+            if i == 1:
+                args += ["--hang-at", "3"]  # gated to incarnation 1
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            log = open(os.path.join(
+                fleet_dir, f"worker{i}-inc{incarnation}.log"), "w")
+            try:
+                return subprocess.Popen(args, stdout=log,
+                                        stderr=subprocess.STDOUT, env=env)
+            finally:
+                log.close()
+
+        rec = FlightRecorder()
+        fleet = fl.FleetSupervisor(
+            launch, 2, fleet_dir,
+            fl.FleetConfig(max_restarts=2,
+                           backoff=RetryPolicy(base_s=0.0, jitter=0.0),
+                           poll_s=0.2, heartbeat_timeout_s=20.0,
+                           stall_timeout_s=600.0, launch_grace_s=180.0,
+                           term_grace_s=5.0),
+            ckpt_dirs=ckpt_dirs, registry=Registry(), flightrec=rec)
+        out = fleet.run()
+        assert out == {"restarts": 1, "incarnation": 2}, out
+        assert fl.read_restore_step(fleet_dir) == 2, "common-step ceiling"
+        rec.dump(FLEET_POSTMORTEM_ARTIFACT, reason="chaos_smoke_fleet")
+    assert os.path.exists(FLEET_POSTMORTEM_ARTIFACT)
+    print("chaos_smoke: fleet hang -> missed-heartbeat death -> gang "
+          "restart (incarnation 2, common ckpt) -> done OK (postmortem "
+          f"at {FLEET_POSTMORTEM_ARTIFACT})")
+
+
 def main() -> int:
     scheduler_invariants()
     sigterm_resume_round()
     supervised_recovery_round()
+    fleet_round()
     return 0
 
 
