@@ -234,6 +234,81 @@ class RetryingIterator:
         return batch
 
 
+def quarantined_raw_start(start_step: int, quarantine) -> int:
+    """Raw batch index already consumed once ``start_step`` *effective*
+    (non-quarantined) batches have been delivered. With holes in the
+    stream, effective step numbering and raw ``(seed, index)`` numbering
+    diverge — this is the single translation both the filter below and
+    the blame machinery (resilience/anomaly.py) use, so they can never
+    disagree about which raw batch feeds which step."""
+    raw = int(start_step)
+    for q in sorted({int(i) for i in quarantine}):
+        if q <= raw:
+            raw += 1
+    return raw
+
+
+class QuarantineFilter:
+    """Batch stream with quarantined raw indices REMOVED: the numeric-
+    anomaly defense's data half (docs/resilience.md "Numeric anomalies").
+
+    ``make_source(raw_index)`` follows the RetryingIterator contract —
+    it returns an iterable whose first batch is raw index
+    ``raw_index + 1`` (batches are 1-based; batch i normally feeds step
+    i). Quarantined indices are skipped by *re-seeking the source
+    around them* — the bad batch is never even fetched, so a record
+    whose very decode raises (or re-poisons) cannot re-injure a
+    recovered run. Because every dataset here is a pure function of
+    ``(seed, index)``, the surviving stream — hence the training
+    trajectory — is a pure function of ``(seed, quarantine set)``:
+    same-seed recovery stays bit-identical, with the holes applied
+    identically on every incarnation.
+
+    ``start_step`` counts EFFECTIVE batches already consumed (a resumed
+    run's restored step); the raw seek position is derived via
+    ``quarantined_raw_start``. ``raw`` is the raw index of the most
+    recently delivered batch — resilience/anomaly.AnomalyPolicy reads
+    it (``index_fn=lambda: stream.raw``) to blame the exact
+    ``(seed, index)`` a non-finite step consumed, so do not interpose a
+    Prefetcher between this filter and the policy (prefetch runs the
+    cursor ahead of the step being blamed)."""
+
+    def __init__(self, make_source: Callable[[int], Iterable],
+                 quarantine: Iterable[int] = (), *, start_step: int = 0,
+                 registry=None):
+        self.make_source = make_source
+        self.quarantine = frozenset(int(i) for i in quarantine)
+        #: raw index of the last delivered batch
+        self.raw = quarantined_raw_start(start_step, self.quarantine)
+        self._it = iter(make_source(self.raw))
+        if registry is None:
+            from ..obs.registry import default_registry
+
+            registry = default_registry()
+        self._m_skipped = registry.counter(
+            "anomaly_skipped_batches_total",
+            "batches dropped by the numeric-anomaly defense",
+            cause="quarantined",
+        )
+
+    def __iter__(self) -> "QuarantineFilter":
+        return self
+
+    def __next__(self):
+        nxt = self.raw + 1
+        if nxt in self.quarantine:
+            while nxt in self.quarantine:
+                self._m_skipped.inc()
+                nxt += 1
+            # re-seek AROUND the hole: rebuild the source just past it
+            # instead of fetching-and-discarding the condemned batch
+            self._it = iter(self.make_source(nxt - 1))
+            self.raw = nxt - 1
+        batch = next(self._it)
+        self.raw += 1
+        return batch
+
+
 class Prefetcher:
     """Background-thread prefetch: keeps up to ``depth`` host batches ready.
     The Python tier of the input pipeline; the native (C++) loader in

@@ -77,6 +77,11 @@ EVENT_KINDS = (
     "sup_exhausted",        # restart budget ran out        {cause, restarts}
     # fault injection (resilience/faults.py)
     "fault_fired",          # a planned fault fired         {fault, step, ...}
+    # numeric-anomaly defense (resilience/anomaly.py)
+    "anomaly_skip",         # nonfinite step no-op'd in-graph, batch dropped
+    #                                                       {step, index, cause}
+    "anomaly_spike",        # loss spiked vs EWMA baseline  {step, index, loss, ewma}
+    "anomaly_blame",        # batch index blamed+quarantined {step, index, cause}
     # fleet control plane (resilience/fleet.py)
     "fleet_start",          # fleet run begins              {workers, incarnation}
     "fleet_launch",         # worker subprocess launched    {worker, incarnation, pid}

@@ -16,9 +16,25 @@ both halves of that story:
 - the cluster-level layer over both: a collective-free, heartbeat-based
   fleet control plane that supervises worker PROCESSES and turns any
   classified failure into a coordinated gang restart from the latest
-  common valid checkpoint (fleet.py).
+  common valid checkpoint (fleet.py);
+- the numeric-anomaly defense (anomaly.py): host policy over the
+  in-graph no-update-on-nonfinite guard — bounded batch skipping,
+  deterministic bad-batch blame (live flag or restart-time bisection),
+  and the quarantine file that steers data/pipeline.QuarantineFilter
+  around condemned indices so poisoned restarts converge.
 """
 
+from .anomaly import (  # noqa: F401
+    AnomalyConfig,
+    AnomalyPolicy,
+    SkipBudgetExhausted,
+    bisect_blame,
+    blame_hook,
+    load_quarantine,
+    quarantine_index,
+    quarantine_path,
+    read_quarantine,
+)
 from .faults import (  # noqa: F401
     ClockStall,
     CorruptCheckpoint,

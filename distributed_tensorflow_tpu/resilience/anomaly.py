@@ -1,0 +1,391 @@
+"""Numeric-anomaly defense: skip, blame, quarantine
+(docs/resilience.md "Numeric anomalies").
+
+The reference's NanTensorHook only *detects* — and detection alone
+loses: with deterministic data (batches are pure functions of
+``(seed, index)``, the property recovery relies on for bit-identical
+re-seek), a poisoned batch NaNs again on every restarted attempt until
+the restart budget burns out. This module turns detection into a
+defense with three tiers:
+
+1. **Skip** — the in-graph guard (``train/step.StepOptions(
+   skip_nonfinite=True)``) makes a non-finite step a device-side no-op:
+   the old state survives bit-identically (step counter included) and a
+   per-step ``nonfinite`` flag rides the metrics. ``AnomalyPolicy``
+   consumes the flag on the host and lets the run continue under a
+   bounded skip budget.
+2. **Blame** — every skip records the exact raw ``(seed, index)`` it
+   consumed into an atomically-written quarantine file next to the
+   checkpoints; when poisoning is only discovered late (NaNGuard
+   cadence, a poisoned restart with the guard off, a spent budget),
+   ``bisect_blame`` finds the index by bisection over deterministic
+   re-seek replay from the last-good checkpoint, and ``blame_hook``
+   runs that search at the Supervisor's ``poisoned`` restart boundary.
+3. **Quarantine** — ``data/pipeline.QuarantineFilter`` re-seeks the
+   stream *around* quarantined indices, so the surviving trajectory is
+   a pure function of ``(seed, quarantine set)``: same-seed recovery
+   stays bit-identical, and a poisoned restart provably converges (each
+   round either finishes or permanently removes one bad index) instead
+   of replaying the same batch until ``SupervisorExhausted``.
+
+Nothing here imports jax — the policy reads already-computed host
+scalars, the file format is plain JSON, and the bisection is arithmetic
+— so the module is usable from pure-host tests and tools.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Callable
+
+import numpy as np
+
+from ..obs import flightrec as flightrec_lib
+from ..obs.registry import Registry, default_registry
+from .supervisor import POISONED
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "SKIPPED_TOTAL",
+    "SPIKES_TOTAL",
+    "CAUSE_NONFINITE",
+    "CAUSE_QUARANTINED",
+    "CAUSE_BISECT",
+    "QUARANTINE_FILE",
+    "AnomalyConfig",
+    "AnomalyPolicy",
+    "SkipBudgetExhausted",
+    "quarantine_path",
+    "read_quarantine",
+    "load_quarantine",
+    "quarantine_index",
+    "bisect_blame",
+    "blame_hook",
+]
+
+#: metric names (docs/observability.md "Recovery metrics")
+SKIPPED_TOTAL = "anomaly_skipped_batches_total"
+SPIKES_TOTAL = "anomaly_spikes_total"
+
+#: blame causes recorded in the quarantine file / skip-counter labels
+CAUSE_NONFINITE = "nonfinite"    # live in-graph flag, exact index known
+CAUSE_QUARANTINED = "quarantined"  # stream re-seek around a known hole
+CAUSE_BISECT = "bisect"          # found by restart-time replay bisection
+
+#: file name next to the checkpoints (same directory the .corrupt/
+#: checkpoint quarantine lives under — one place to look after a run)
+QUARANTINE_FILE = "quarantine.json"
+
+
+class SkipBudgetExhausted(FloatingPointError):
+    """The AnomalyPolicy's skip budget ran out: too many non-finite
+    batches for "drop and continue" to be a defensible recovery. A
+    FloatingPointError subclass so ``classify_failure`` maps it to the
+    ``poisoned`` class unchanged; carries the blamed raw batch
+    ``index`` (and the step that consumed it) so restart-time blame can
+    shortcut the bisection."""
+
+    def __init__(self, step: int, index: int, budget: int):
+        super().__init__(
+            f"anomaly skip budget exhausted: non-finite step {step} "
+            f"(raw batch index {index}) would be skip #{budget + 1} "
+            f"of {budget} allowed"
+        )
+        self.step = step
+        self.index = index
+        self.budget = budget
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalyConfig:
+    #: non-finite batches the policy may skip before raising
+    #: SkipBudgetExhausted (per policy instance — i.e. per supervised
+    #: attempt when the builder constructs one per attempt)
+    skip_budget: int = 8
+    #: >0 enables the EWMA loss-spike detector: a fetched loss above
+    #: ``spike_factor × ewma`` emits ``anomaly_spike`` + counts
+    #: ``anomaly_spikes_total``. Detection only: a finite-but-spiking
+    #: step's update is already applied on device — the guard can only
+    #: veto non-finite updates — so a spike is evidence for operators
+    #: (and ``fail_on_spike``), not a skip.
+    spike_factor: float = 0.0
+    #: EWMA smoothing for the spike baseline
+    spike_ewma_alpha: float = 0.1
+    #: steps observed before the baseline is trusted (loss at init is
+    #: arbitrary; comparing against it would page on step 2)
+    spike_warmup_steps: int = 20
+    #: escalate a detected spike to FloatingPointError (the Supervisor's
+    #: ``poisoned`` path) instead of recording it
+    fail_on_spike: bool = False
+
+    def __post_init__(self):
+        if self.skip_budget < 0:
+            raise ValueError("skip_budget must be >= 0")
+        if self.spike_factor < 0:
+            raise ValueError("spike_factor must be >= 0 (0 disables)")
+
+
+# ---------------------------------------------------------------------------
+# Quarantine file: atomically-written blame record next to the checkpoints
+# ---------------------------------------------------------------------------
+
+
+def quarantine_path(directory: str) -> str:
+    return os.path.join(
+        os.path.abspath(os.path.expanduser(directory)), QUARANTINE_FILE)
+
+
+def read_quarantine(directory: str) -> dict:
+    """The full quarantine document: ``{"version": 1, "indices": [...],
+    "entries": [{index, step, cause, note, t}, ...]}``. Missing file ==
+    empty document (a fresh run has nothing quarantined)."""
+    path = quarantine_path(directory)
+    if not os.path.exists(path):
+        return {"version": 1, "indices": [], "entries": []}
+    with open(path) as f:
+        doc = json.load(f)
+    doc.setdefault("indices", [])
+    doc.setdefault("entries", [])
+    return doc
+
+
+def load_quarantine(directory: str) -> frozenset[int]:
+    """Just the condemned raw batch indices — what
+    ``data/pipeline.QuarantineFilter`` consumes."""
+    return frozenset(int(i) for i in read_quarantine(directory)["indices"])
+
+
+def quarantine_index(directory: str, index: int, *, step: int | None = None,
+                     cause: str = CAUSE_NONFINITE, note: str = "",
+                     flightrec=None) -> bool:
+    """Blame raw batch ``index``: append it to the quarantine file via
+    tmp + fsync + rename (a torn write must not look complete — the
+    file steers every future incarnation's data stream) and emit
+    ``anomaly_blame``. Returns False when the index was already
+    quarantined (idempotent: Supervisor hooks re-run on hook failure)."""
+    doc = read_quarantine(directory)
+    index = int(index)
+    if index in set(int(i) for i in doc["indices"]):
+        return False
+    doc["indices"] = sorted({*map(int, doc["indices"]), index})
+    doc["entries"].append({
+        "index": index,
+        "step": None if step is None else int(step),
+        "cause": cause,
+        "note": str(note)[:200],
+        "t": time.time(),
+    })
+    path = quarantine_path(directory)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    rec = flightrec if flightrec is not None else flightrec_lib.default_recorder()
+    rec.emit("anomaly_blame", step=step, index=index, cause=cause)
+    logger.warning(
+        "quarantined batch index %d (cause=%s, step=%s) -> %s",
+        index, cause, step, path,
+    )
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Policy: host-side consumer of the in-graph nonfinite flag
+# ---------------------------------------------------------------------------
+
+
+class AnomalyPolicy:
+    """Decides what a raised ``nonfinite`` flag means for the run.
+
+    Wire as ``Trainer(anomaly_policy=...)`` together with
+    ``StepOptions(skip_nonfinite=True)``: the loop calls ``observe``
+    after every compiled step and *does not count* steps the policy
+    skips (the device already kept the old state, so the skipped batch
+    simply vanishes from the trajectory). ``observe`` fetches the flag
+    scalar, which synchronizes the host with the just-dispatched step —
+    the cost of per-step exactness; the guard itself stays pure device
+    work, and runs that only want lazy detection use ``NaNGuard``
+    without a policy.
+
+    ``index_fn`` returns the raw ``(seed, index)`` of the batch the
+    current step consumed — ``lambda: stream.raw`` for a
+    ``QuarantineFilter`` (or ``lambda: it.index`` for a bare
+    ``RetryingIterator``). Without one the policy counts deliveries
+    itself from ``start_index``, which is only correct when no
+    quarantine holes exist mid-run.
+    """
+
+    def __init__(self, directory: str, cfg: AnomalyConfig = AnomalyConfig(),
+                 *, index_fn: Callable[[], int] | None = None,
+                 start_index: int = 0, registry: Registry | None = None,
+                 flightrec=None):
+        self.directory = directory
+        self.cfg = cfg
+        self.index_fn = index_fn
+        self.registry = registry if registry is not None else default_registry()
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
+        #: batches skipped by this policy (== budget consumed)
+        self.skipped = 0
+        self.spikes = 0
+        self._count = int(start_index)
+        self._ewma: float | None = None
+        self._seen = 0
+        self._m_skip = self.registry.counter(
+            SKIPPED_TOTAL, "batches dropped by the numeric-anomaly defense",
+            cause=CAUSE_NONFINITE)
+        self._m_spike = self.registry.counter(
+            SPIKES_TOTAL, "loss spikes detected against the EWMA baseline")
+
+    def _index(self) -> int:
+        if self.index_fn is not None:
+            return int(self.index_fn())
+        return self._count
+
+    def observe(self, step: int, metrics: dict) -> bool:
+        """Consume one step's metrics; True means the step was a
+        device-side no-op and the loop must not count it. Raises
+        ``SkipBudgetExhausted`` (a FloatingPointError → ``poisoned``)
+        when the budget is spent, after blaming the index."""
+        if "nonfinite" not in metrics:
+            raise RuntimeError(
+                "AnomalyPolicy needs the per-step 'nonfinite' flag — build "
+                "the step with StepOptions(skip_nonfinite=True)"
+            )
+        # lazy: the shared read-side contract lives next to the flag's
+        # producer; importing it at call time keeps this module free of
+        # train/ at import (resilience package init order)
+        from ..train.step import step_nonfinite
+
+        self._count += 1
+        index = self._index()
+        if step_nonfinite(metrics):
+            if self.skipped >= self.cfg.skip_budget:
+                # the index is still blamed — restart-time recovery can
+                # then re-seek around it instead of rediscovering it by
+                # bisection
+                quarantine_index(self.directory, index, step=step,
+                                 cause=CAUSE_NONFINITE,
+                                 note="skip budget exhausted",
+                                 flightrec=self.flightrec)
+                raise SkipBudgetExhausted(step, index, self.cfg.skip_budget)
+            self.skipped += 1
+            self._m_skip.inc()
+            self.flightrec.emit("anomaly_skip", step=step, index=index,
+                                cause=CAUSE_NONFINITE)
+            quarantine_index(self.directory, index, step=step,
+                             cause=CAUSE_NONFINITE, flightrec=self.flightrec)
+            logger.warning(
+                "anomaly: non-finite step %d skipped in-graph (batch index "
+                "%d quarantined; %d/%d budget used)",
+                step, index, self.skipped, self.cfg.skip_budget,
+            )
+            return True
+        if self.cfg.spike_factor > 0 and "loss" in metrics:
+            self._observe_loss(step, index,
+                               float(np.asarray(metrics["loss"])))
+        return False
+
+    def _observe_loss(self, step: int, index: int, loss: float) -> None:
+        self._seen += 1
+        ewma = self._ewma
+        if (ewma is not None and self._seen > self.cfg.spike_warmup_steps
+                and loss > self.cfg.spike_factor * ewma):
+            self.spikes += 1
+            self._m_spike.inc()
+            self.flightrec.emit("anomaly_spike", step=step, index=index,
+                                loss=round(loss, 6), ewma=round(ewma, 6))
+            logger.warning(
+                "anomaly: loss spike at step %d (loss=%g vs ewma=%g, "
+                "factor %g)", step, loss, ewma, self.cfg.spike_factor,
+            )
+            if self.cfg.fail_on_spike:
+                raise FloatingPointError(
+                    f"loss spike at step {step}: {loss:g} > "
+                    f"{self.cfg.spike_factor:g} x ewma {ewma:g}"
+                )
+            return  # a spike must not drag the baseline up toward itself
+        a = self.cfg.spike_ewma_alpha
+        self._ewma = loss if ewma is None else (1 - a) * ewma + a * loss
+
+
+# ---------------------------------------------------------------------------
+# Blame bisection: find the poisoning index by deterministic re-seek replay
+# ---------------------------------------------------------------------------
+
+
+def bisect_blame(probe: Callable[[int], bool], lo: int, hi: int) -> int | None:
+    """First effective step ``k`` in ``(lo, hi]`` whose replay poisons
+    the run, by bisection: ``probe(m)`` answers "is the state poisoned
+    after replaying effective steps ``(lo, m]`` from the last-good
+    checkpoint?" — monotone in ``m`` because non-finites propagate
+    through every optax update, which is what makes bisection sound.
+    Returns None when ``probe(hi)`` is clean (no poison in the window).
+    O(log(hi−lo)) replays, each a deterministic re-seek — no state from
+    the poisoned attempt is needed, only the checkpoint and the seed."""
+    if hi <= lo:
+        return None
+    if not probe(hi):
+        return None
+    good, bad = lo, hi
+    while bad - good > 1:
+        mid = (good + bad) // 2
+        if probe(mid):
+            bad = mid
+        else:
+            good = mid
+    return bad
+
+
+def blame_hook(directory: str, probe: Callable[[int, int], bool], *,
+               window: int, flightrec=None) -> Callable[[int, str], None]:
+    """A ``Supervisor(on_restart=...)`` hook closing the poisoned loop:
+    on a ``poisoned`` restart it bisects the window since the last-good
+    checkpoint with ``probe(last_good_step, m) -> bool`` (deterministic
+    re-seek replay — the caller owns rebuilding state + step fn), maps
+    the found *effective* step back to the raw batch index through the
+    current quarantine set, and quarantines it. The next attempt's
+    ``QuarantineFilter`` then re-seeks around the bad index: each
+    poisoned restart permanently removes one index, so the loop
+    converges instead of replaying the same batch until exhaustion.
+    Idempotent (re-runs after a hook failure re-blame the same index
+    at most once) — the Supervisor hook contract."""
+    from ..data.pipeline import quarantined_raw_start
+    from .faults import _newest_step_on_disk
+
+    rec = flightrec if flightrec is not None else flightrec_lib.default_recorder()
+
+    def hook(restart_index: int, cause: str) -> None:
+        if cause != POISONED:
+            return
+        last_good = _newest_step_on_disk(directory) or 0
+        quarantined = load_quarantine(directory)
+        step = bisect_blame(lambda m: probe(last_good, m),
+                            last_good, last_good + window)
+        if step is None:
+            logger.warning(
+                "anomaly: poisoned restart %d but replay of (%d, %d] is "
+                "clean — nothing to quarantine (transient poison?)",
+                restart_index, last_good, last_good + window,
+            )
+            return
+        # effective step -> raw index: the k-th surviving batch sits past
+        # every already-quarantined index at or before it. The skip
+        # itself is counted by the next attempt's QuarantineFilter
+        # (cause=quarantined) — blame here is an event, not a skip.
+        raw = quarantined_raw_start(step, quarantined)
+        quarantine_index(directory, raw, step=step, cause=CAUSE_BISECT,
+                         note=f"restart {restart_index} bisection over "
+                              f"({last_good}, {last_good + window}]",
+                         flightrec=rec)
+
+    return hook

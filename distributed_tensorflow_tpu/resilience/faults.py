@@ -124,10 +124,19 @@ class NaNBatch:
     """Poison the ``batch``-th batch (1-based): the first element of
     ``key``'s array (or of the first float array found) becomes NaN, so
     the step computes non-finite loss/grads — the seam for driving
-    NaNGuard and validate_before_save (FaultyIterator seam)."""
+    NaNGuard and validate_before_save (FaultyIterator seam).
+
+    ``recur=True`` models *persistently* bad data at a fixed raw index
+    — the numeric-anomaly defense's quarantine target: the fault keys
+    on the exact index (``count == batch``) instead of the one-shot
+    catch-up trigger (``count >= batch``) and never enters the plan's
+    fired set, so every re-seek, restart, and incarnation that fetches
+    that index is re-poisoned — until the quarantine-aware stream stops
+    fetching it at all (docs/resilience.md "Numeric anomalies")."""
 
     batch: int
     key: str | None = None
+    recur: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -388,9 +397,16 @@ class FaultyIterator:
                         )
         batch = next(self._it)
         for i, fault in enumerate(self.plan.faults):
-            if i in fired or not isinstance(fault, NaNBatch):
+            if not isinstance(fault, NaNBatch):
                 continue
-            if self.count >= fault.batch:
+            if fault.recur:
+                # persistent bad index: fires on EVERY fetch of exactly
+                # this index, across re-wraps and incarnations — only a
+                # quarantine hole (the stream never fetching it) ends it
+                if self.count == fault.batch:
+                    _record_fault("nan_batch", step=self.count, recur=True)
+                    batch = _poison_batch(batch, fault.key)
+            elif i not in fired and self.count >= fault.batch:
                 fired.add(i)
                 _record_fault("nan_batch", step=self.count)
                 batch = _poison_batch(batch, fault.key)

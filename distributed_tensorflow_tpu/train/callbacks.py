@@ -279,13 +279,37 @@ class TelemetryCallback(Callback):
 class NaNGuard(Callback):
     """NanTensorHook (:761): stop (or raise) when the step reports non-finite
     loss/grads. Reads the on-device `grads_finite`/`loss` signals the step
-    engine piggybacks on its output (SURVEY.md §5.5)."""
+    engine piggybacks on its output (SURVEY.md §5.5).
+
+    When the step carries the per-step ``nonfinite`` flag
+    (``StepOptions(skip_nonfinite=True)``, docs/resilience.md "Numeric
+    anomalies"), the guard reads IT on every step instead of the
+    cadence'd loss fetch: the old cadence left a non-finite step N
+    unnoticed until the next multiple of ``every_n`` — after donation
+    had already overwritten the state — so the abort was late and the
+    blamed step wrong. With the flag the abort is immediate and exact
+    (and the in-graph guard means the state it aborts with is still the
+    last healthy one). The per-step scalar fetch trades the
+    dispatch-ahead overlap for exactness — the same trade
+    ``AnomalyPolicy`` makes, which supersedes this guard when wired
+    (skipped steps never reach callbacks at all). Inside ``Trainer.fit``
+    with the guard on and NO policy, the loop itself fails fast on the
+    flag BEFORE callbacks run (a flagged no-op step must not be counted
+    — see the loop), so this branch — ``fail_fast=False`` included — is
+    reached only by custom/externally-driven loops; for
+    skip-and-continue under Trainer, wire an AnomalyPolicy."""
 
     def __init__(self, every_n: int = 10, fail_fast: bool = True):
         self.every_n = every_n
         self.fail_fast = fail_fast
 
     def on_step_end(self, trainer, step, metrics):
+        if "nonfinite" in metrics:
+            from .step import step_nonfinite
+
+            if step_nonfinite(metrics):
+                self._bad(trainer, step)
+            return
         if step % self.every_n != 0:
             return
         bad = False
@@ -294,10 +318,13 @@ class NaNGuard(Callback):
         if "loss" in metrics:
             bad |= not np.isfinite(np.asarray(metrics["loss"]))
         if bad:
-            msg = f"non-finite loss/gradients at step {step}"
-            if self.fail_fast:
-                raise FloatingPointError(msg)
-            trainer.request_stop(msg)
+            self._bad(trainer, step)
+
+    def _bad(self, trainer, step: int) -> None:
+        msg = f"non-finite loss/gradients at step {step}"
+        if self.fail_fast:
+            raise FloatingPointError(msg)
+        trainer.request_stop(msg)
 
 
 def _async_raise(ident: int, exc_type: type[BaseException]) -> None:

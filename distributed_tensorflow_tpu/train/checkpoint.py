@@ -196,12 +196,20 @@ class Checkpointer:
         return bool(np.max(flags) > 0)
 
     def _params_finite(self, state: Any) -> bool:
-        """All-finite reduce over the float leaves of state.params (or of
-        the whole tree for non-TrainState pytrees). Jitted once; identical
-        on every host, so multi-host saves stay in agreement."""
+        """All-finite reduce over the float leaves of state.params AND
+        state.opt_state (or of the whole tree for non-TrainState
+        pytrees). Optimizer state is part of the check because poisoned
+        Adam moments with still-finite params would otherwise pass,
+        become the latest checkpoint, and poison the params one step
+        after restore — a validated save that still bricks the run.
+        Jitted once; identical on every host, so multi-host saves stay
+        in agreement."""
         import jax.numpy as jnp
 
-        params = getattr(state, "params", state)
+        checked = getattr(state, "params", state)
+        opt_state = getattr(state, "opt_state", None)
+        if opt_state is not None:
+            checked = (checked, opt_state)
         if self._finite_check is None:
             def all_finite(tree):
                 leaves = [
@@ -211,7 +219,7 @@ class Checkpointer:
                 return jnp.all(jnp.stack(leaves)) if leaves else jnp.asarray(True)
 
             self._finite_check = jax.jit(all_finite)
-        return bool(jax.device_get(self._finite_check(params)))
+        return bool(jax.device_get(self._finite_check(checked)))
 
     def save(self, step: int, state: Any, force: bool = False,
              trigger: str = "cadence") -> bool:

@@ -47,6 +47,7 @@ class Trainer:
         emergency_checkpoint=None,
         flightrec=None,
         postmortem_dir: str | None = None,
+        anomaly_policy=None,
     ):
         self.mesh = mesh
         self.spec_tree = spec_tree
@@ -79,6 +80,13 @@ class Trainer:
             self.postmortem_dir = getattr(
                 getattr(self.emergency_checkpoint, "cfg", None),
                 "directory", None)
+        #: resilience/anomaly.AnomalyPolicy (duck-typed: ``observe(step,
+        #: metrics) -> bool``) — pairs with StepOptions(skip_nonfinite):
+        #: a step the policy reports as skipped was a device-side no-op,
+        #: so the loop does not count it and no callback sees it. Kept a
+        #: plain attribute (no import) so train/ never depends on
+        #: resilience/.
+        self.anomaly_policy = anomaly_policy
         if donate:
             self.step_fn = step_lib.jit_train_step(train_step, mesh, spec_tree)
         else:
@@ -136,6 +144,34 @@ class Trainer:
                 rec.emit("step_start", step=step_now + 1)
                 batch = self.put_batch(batch)
                 self.state, metrics = self.step_fn(self.state, batch)
+                if self.anomaly_policy is not None:
+                    if self.anomaly_policy.observe(step_now + 1, metrics):
+                        # the compiled step kept the old state
+                        # bit-identically (in-graph nonfinite guard): the
+                        # batch vanishes from the trajectory — not a
+                        # completed step, so neither the step mirror nor
+                        # any callback may count it. The policy already
+                        # blamed + quarantined the index and emitted
+                        # anomaly_skip (which is what resolves this
+                        # step's dangling step_start in a postmortem); a
+                        # spent skip budget raises out of observe() into
+                        # the classified-exit path below (poisoned),
+                        # with the state still clean.
+                        continue
+                elif step_lib.step_nonfinite(metrics):
+                    # guard on, no policy wired: fail fast HERE, before
+                    # the step is counted. Counting it would desync the
+                    # host mirror from the device step counter (the
+                    # guard kept state.step unchanged) and mislabel
+                    # every later checkpoint by one. The state is still
+                    # the last healthy one, so the emergency save below
+                    # lands under its true step number; the exception
+                    # classifies poisoned — the pre-guard NaNGuard
+                    # semantics, made exact and immediate.
+                    raise FloatingPointError(
+                        f"non-finite loss/gradients at step {step_now + 1}"
+                        " (in-graph guard skipped the update; wire an "
+                        "AnomalyPolicy to skip-and-continue instead)")
                 step_now += 1
                 for cb in self.callbacks:
                     cb.on_step_end(self, step_now, metrics)

@@ -171,6 +171,19 @@ class StepOptions:
     compute_grad_norm: bool = False
     check_grads_finite: bool = False
     clip_grad_norm: float | None = None  # applied here, before tx
+    # No-update-on-nonfinite (docs/resilience.md "Numeric anomalies"): when
+    # the step's loss or any gradient leaf is non-finite, the compiled step
+    # returns the OLD state bit-identically — step counter included — via a
+    # device-side select over the update (apply_if_finite-style), and
+    # reports a per-step ``nonfinite`` flag in its metrics. SAFETY is pure
+    # device work: poisoned params never exist and donation stays legal
+    # without any host check before the update. CONSUMING the flag on the
+    # host (resilience/anomaly.AnomalyPolicy skip/blame/quarantine, or the
+    # Trainer's fail-fast check when no policy is wired) fetches one
+    # scalar per step — that read trades the dispatch-ahead overlap for
+    # exactness (``step_nonfinite``). Covers both the single-batch and
+    # the grad-accumulation scan paths.
+    skip_nonfinite: bool = False
 
 
 def make_train_step(
@@ -249,6 +262,17 @@ def make_train_step(
             # grad-norm/clipping is on (VERDICT r2 Weak #4).
             metrics["grads_finite"] = jnp.isfinite(gnorm).astype(jnp.float32)
 
+        if options.skip_nonfinite:
+            # One reduce per gradient leaf + the loss: the exact
+            # apply_if_finite predicate. Computed BEFORE tx.update so the
+            # flag reflects the step's inputs, not NaNs the optimizer math
+            # may have laundered (Adam's eps can turn inf into finite).
+            finite = [jnp.isfinite(loss)] + [
+                jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)
+            ]
+            ok = jnp.all(jnp.stack(finite))
+            metrics["nonfinite"] = 1.0 - ok.astype(jnp.float32)
+
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
@@ -258,9 +282,36 @@ def make_train_step(
             model_state=model_state,
             rng=state.rng,
         )
+        if options.skip_nonfinite:
+            # Select OLD vs NEW per leaf on device: a non-finite step is a
+            # no-op — params, opt_state, model_state AND the step counter
+            # stay bit-identical, so the batch is provably droppable (the
+            # trajectory becomes a pure function of (seed, quarantine
+            # set); data/pipeline.QuarantineFilter is the other half).
+            # Leaves the candidate state shares with the old one (rng)
+            # pass through untouched — jnp.where on them would choke on
+            # non-numeric leaves like typed PRNG keys.
+            new_state = jax.tree.map(
+                lambda new, old: new if new is old else jnp.where(ok, new, old),
+                new_state, state,
+            )
         return new_state, metrics
 
     return train_step
+
+
+def step_nonfinite(metrics) -> bool:
+    """Host-side read of the per-step ``nonfinite`` flag a
+    ``skip_nonfinite`` step piggybacks on its metrics (False when the
+    flag is absent). One scalar fetch — it blocks until the step
+    completes, the one place flag exactness costs the dispatch-ahead
+    overlap. Every consumer (the Trainer loop's fail-fast check,
+    NaNGuard, AnomalyPolicy) reads through here, so the flag's encoding
+    has a single read-side contract next to its producer."""
+    import numpy as np
+
+    flag = metrics.get("nonfinite")
+    return flag is not None and float(np.asarray(flag)) != 0.0
 
 
 def make_eval_step(eval_fn):

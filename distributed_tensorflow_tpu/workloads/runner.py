@@ -72,6 +72,17 @@ class TrainSection:
     # same-step grads_finite signal for NaNGuard (train/step.py), closing
     # the one-step-delayed-loss window without debug_metrics' extra pass.
     clip_grad_norm: float = 0.0
+    # Numeric-anomaly defense (docs/resilience.md "Numeric anomalies"):
+    # the in-graph no-update-on-nonfinite guard plus the AnomalyPolicy —
+    # a non-finite batch is skipped device-side (old state survives
+    # bit-identically), blamed by raw (seed, index) into quarantine.json
+    # next to the checkpoints, and re-seeked AROUND on every later
+    # incarnation. Requires checkpoint.directory (the quarantine file
+    # lives there). Trades the dispatch-ahead overlap for the per-step
+    # flag fetch; prefetch is bypassed so the blamed index is exact.
+    anomaly_defense: bool = False
+    # non-finite batches skipped before escalating to the poisoned path
+    anomaly_skip_budget: int = 8
 
 
 @dataclasses.dataclass(frozen=True)
@@ -258,15 +269,42 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
             compute_grad_norm=cfg.train.debug_metrics,
             check_grads_finite=cfg.train.debug_metrics,
             clip_grad_norm=cfg.train.clip_grad_norm or None,
+            skip_nonfinite=cfg.train.anomaly_defense,
         ),
     )
-    trainer = Trainer(step_fn, state, mesh, specs, callbacks=callbacks)
+
+    start_step = int(state.step)
+    policy = None
+    if cfg.train.anomaly_defense:
+        if not cfg.checkpoint.directory:
+            raise ValueError(
+                "train.anomaly_defense needs checkpoint.directory — the "
+                "quarantine file lives next to the checkpoints")
+        from ..data.pipeline import QuarantineFilter
+        from ..resilience.anomaly import AnomalyConfig, AnomalyPolicy
+        from ..resilience.anomaly import load_quarantine
+
+        # no Prefetcher here: the policy blames through the stream's raw
+        # cursor, and a prefetch depth would run it ahead of the step
+        # being blamed (data/pipeline.QuarantineFilter docstring)
+        data = QuarantineFilter(
+            parts.dataset_fn, load_quarantine(cfg.checkpoint.directory),
+            start_step=start_step,
+        )
+        policy = AnomalyPolicy(
+            cfg.checkpoint.directory,
+            AnomalyConfig(skip_budget=cfg.train.anomaly_skip_budget),
+            index_fn=lambda: data.raw,
+        )
+    else:
+        data = Prefetcher(parts.dataset_fn(start_step), depth=2)
+
+    trainer = Trainer(step_fn, state, mesh, specs, callbacks=callbacks,
+                      anomaly_policy=policy)
 
     if cfg.train.eval_every > 0 and parts.eval_fn is not None:
         trainer.callbacks.append(_EvalCallback(cfg, parts))
 
-    start_step = int(state.step)
-    data = Prefetcher(parts.dataset_fn(start_step), depth=2)
     state = trainer.fit(data, num_steps=cfg.train.num_steps)
 
     eval_metrics = None

@@ -34,6 +34,10 @@ Markers on stdout (the drivers assert on these):
     CHAOS-SUPERVISED step=N restarts=R finite=F quarantined=Q ordered=O
                              supervised run finished; F/Q/O are 0/1 flags
                              (O: flight-recorder timeline causal order)
+    CHAOS-ANOMALY skipped=N quarantined=I,J refused=R
+                             numeric-anomaly defense (--anomaly): batches
+                             skipped in-graph, quarantine-file indices,
+                             R=1 if any save was refused by validation
     CHAOS-POSTMORTEM path=P events=N ordered=O
                              flight recorder dumped to P (--flightrec)
     CHAOS-GOODPUT fraction=F productive_s=P wall_s=W ok=K
@@ -88,18 +92,22 @@ def _supervised(args, mesh, model, tx) -> int:
     the restart, and the fallback restore IN CAUSAL ORDER, and the
     exported ``goodput_fraction`` gauge to equal productive-step seconds
     over total wall-clock within tolerance."""
+    import logging
     import time
 
     import optax  # noqa: F401  (kept symmetric with main's imports)
 
-    from distributed_tensorflow_tpu.data.pipeline import RetryingIterator
+    from distributed_tensorflow_tpu.data.pipeline import (
+        QuarantineFilter, RetryingIterator,
+    )
     from distributed_tensorflow_tpu.models import common
     from distributed_tensorflow_tpu.obs import flightrec as fr
     from distributed_tensorflow_tpu.obs import goodput
     from distributed_tensorflow_tpu.obs.registry import default_registry
     from distributed_tensorflow_tpu.resilience import (
-        CorruptCheckpoint, FaultPlan, RetryPolicy, Sigterm, Supervisor,
-        SupervisorConfig, TransientIOError,
+        AnomalyConfig, AnomalyPolicy, CorruptCheckpoint, FaultPlan,
+        NaNBatch, RetryPolicy, Sigterm, Supervisor, SupervisorConfig,
+        TransientIOError, load_quarantine,
     )
     from distributed_tensorflow_tpu.train import (
         CheckpointConfig, Checkpointer, StepOptions, Trainer,
@@ -113,8 +121,25 @@ def _supervised(args, mesh, model, tx) -> int:
         faults.append(TransientIOError(args.transient_io_at, times=2))
     if args.corrupt_at_restart:
         faults.append(CorruptCheckpoint(restart=1))
+    if args.nan_at is not None:
+        # recurring: the index is bad on EVERY fetch, every incarnation —
+        # only the quarantine-aware stream never fetching it ends it
+        faults.append(NaNBatch(args.nan_at, recur=True))
     plan = FaultPlan(tuple(faults))
     loss_fn = common.classification_loss_fn(model)
+
+    # "validate_before_save never refuses a save" is part of the anomaly
+    # acceptance: the in-graph guard means poisoned params never exist
+    refused = {"n": 0}
+
+    class _RefusalCounter(logging.Handler):
+        def emit(self, record):
+            if "refusing to checkpoint" in record.getMessage():
+                refused["n"] += 1
+
+    logging.getLogger(
+        "distributed_tensorflow_tpu.train.checkpoint"
+    ).addHandler(_RefusalCounter())
 
     def batches_from(i0: int):
         i = i0
@@ -133,17 +158,35 @@ def _supervised(args, mesh, model, tx) -> int:
             jax.random.PRNGKey(0), fallback=True,
         )
         start = int(state.step)
+
+        def retrying(raw):
+            return RetryingIterator(
+                lambda i: plan.wrap(batches_from(i), start=i),
+                RetryPolicy(max_attempts=4, base_s=0.0, jitter=0.0),
+                start_index=raw, sleep=lambda s: None,
+            )
+
+        policy = None
+        if args.anomaly:
+            # quarantine holes re-read from disk at every attempt
+            # boundary; the policy blames via the stream's raw cursor
+            data = QuarantineFilter(retrying, load_quarantine(args.workdir),
+                                    start_step=start)
+            policy = AnomalyPolicy(
+                args.workdir, AnomalyConfig(skip_budget=args.skip_budget),
+                index_fn=lambda: data.raw,
+            )
+        else:
+            data = retrying(start)
         trainer = Trainer(
-            make_train_step(loss_fn, tx, StepOptions()), state, mesh, specs,
+            make_train_step(loss_fn, tx,
+                            StepOptions(skip_nonfinite=args.anomaly)),
+            state, mesh, specs,
             # telemetry FIRST: maybe_save raises PreemptionSaved from
             # CheckpointCallback, skipping later callbacks for that step
             callbacks=[cb.TelemetryCallback(every_n=10 ** 6),
                        cb.CheckpointCallback(ckpt), plan.callback()],
-        )
-        data = RetryingIterator(
-            lambda i: plan.wrap(batches_from(i), start=i),
-            RetryPolicy(max_attempts=4, base_s=0.0, jitter=0.0),
-            start_index=start, sleep=lambda s: None,
+            anomaly_policy=policy,
         )
         return trainer, data, ckpt
 
@@ -180,6 +223,20 @@ def _supervised(args, mesh, model, tx) -> int:
             ("ckpt_quarantine", {}),
             ("ckpt_restore", {"fallback": True}),
         ])
+    if args.nan_at is not None and args.anomaly:
+        # the anomaly-defense causal chain: recurring bad batch fired →
+        # skipped in-graph → blamed into the quarantine file — and, when
+        # a SIGTERM also restarts the run, the recovery restores and
+        # replays around the hole (tools/chaos_smoke.py nan-blame round)
+        specs = [
+            ("fault_fired", {"fault": "nan_batch"}),
+            ("anomaly_skip", {"index": args.nan_at}),
+            ("anomaly_blame", {"index": args.nan_at}),
+        ]
+        if args.sigterm_at is not None:
+            specs += [("ckpt_save", {"trigger": "preemption"}),
+                      ("sup_restart", {}), ("ckpt_restore", {})]
+        ordered = ordered and fr.contains_in_order(events, specs)
     if args.flightrec:
         fr.default_recorder().dump(args.flightrec, reason="chaos_worker")
         print(f"CHAOS-POSTMORTEM path={args.flightrec} "
@@ -200,14 +257,24 @@ def _supervised(args, mesh, model, tx) -> int:
         f"wall_s={wall_s:.4f} ok={int(goodput_ok)}", flush=True,
     )
 
+    ok = (int(state.step) == args.steps and finite and ordered
+          and goodput_ok)
+    if args.anomaly:
+        q = sorted(load_quarantine(args.workdir))
+        m = reg.get("anomaly_skipped_batches_total", cause="nonfinite")
+        print(
+            f"CHAOS-ANOMALY skipped={int(m.value if m else 0)} "
+            f"quarantined={','.join(map(str, q)) or '-'} "
+            f"refused={refused['n']}",
+            flush=True,
+        )
+        ok = ok and refused["n"] == 0
     print(
         f"CHAOS-SUPERVISED step={int(state.step)} restarts={sup.restarts} "
         f"finite={int(finite)} quarantined={int(quarantined)} "
         f"ordered={int(ordered)}",
         flush=True,
     )
-    ok = (int(state.step) == args.steps and finite and ordered
-          and goodput_ok)
     return 0 if ok else 1
 
 
@@ -350,6 +417,18 @@ def main(argv=None) -> int:
     ap.add_argument("--transient-io-at", type=int, default=None,
                     help="supervised mode: data fetch for this GLOBAL step "
                          "raises IOError twice, then succeeds")
+    ap.add_argument("--nan-at", type=int, default=None,
+                    help="supervised mode: the batch feeding this GLOBAL "
+                         "step is NaN-poisoned on EVERY fetch (recurring "
+                         "bad index — the quarantine target)")
+    ap.add_argument("--anomaly", action="store_true",
+                    help="supervised mode: enable the numeric-anomaly "
+                         "defense (in-graph no-update-on-nonfinite guard, "
+                         "AnomalyPolicy skip budget, quarantine-aware "
+                         "stream)")
+    ap.add_argument("--skip-budget", type=int, default=4,
+                    help="anomaly mode: non-finite batches skipped before "
+                         "the poisoned escalation")
     ap.add_argument("--max-restarts", type=int, default=3)
     ap.add_argument("--flightrec", default=None,
                     help="supervised mode: dump the flight recorder to this "

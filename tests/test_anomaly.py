@@ -1,0 +1,623 @@
+"""Numeric-anomaly defense (resilience/anomaly.py + the in-graph
+no-update-on-nonfinite guard in train/step.py + the quarantine-aware
+stream in data/pipeline.py): skip, blame, quarantine — and the
+acceptance oracle that a recurring bad batch at a fixed index is
+survived with bit-identical same-seed finals and zero refused saves."""
+
+import itertools
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu import resilience as rz
+from distributed_tensorflow_tpu.data.pipeline import (
+    QuarantineFilter,
+    quarantined_raw_start,
+)
+from distributed_tensorflow_tpu.obs.flightrec import (
+    FlightRecorder,
+    contains_in_order,
+    default_recorder,
+)
+from distributed_tensorflow_tpu.obs.registry import Registry
+from distributed_tensorflow_tpu.parallel import sharding as sh
+from distributed_tensorflow_tpu.resilience import anomaly as an
+from distributed_tensorflow_tpu.train import (
+    CheckpointConfig,
+    Checkpointer,
+    StepOptions,
+    Trainer,
+    callbacks as cb,
+    init_or_restore,
+    jit_train_step,
+    make_train_step,
+)
+
+from test_step import linear_init, linear_loss, make_batch
+
+
+def _global_batch(i):
+    """The batch feeding GLOBAL step i — pure function of i (the
+    re-seek soundness contract)."""
+    return make_batch(16, seed=1000 + i)
+
+
+def _batches_from(i0):
+    i = i0
+    while True:
+        i += 1
+        yield _global_batch(i)
+
+
+def _put(batch, mesh):
+    return sh.put_host_batch(mesh, batch)
+
+
+def _state_leaves(state):
+    return [np.asarray(x) for x in jax.tree.leaves(jax.device_get(state))]
+
+
+def _poisoned(batch, rows=slice(0, 1)):
+    out = dict(batch)
+    x = batch["x"].copy()
+    x[rows] = np.nan
+    out["x"] = x
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The in-graph guard (train/step.py StepOptions.skip_nonfinite)
+# ---------------------------------------------------------------------------
+
+
+def _guarded_step(mesh, tx, accum=1):
+    from distributed_tensorflow_tpu.train import init_train_state
+
+    state, specs = init_train_state(linear_init, tx, mesh,
+                                    jax.random.PRNGKey(0))
+    step = jit_train_step(
+        make_train_step(linear_loss, tx,
+                        StepOptions(grad_accum_steps=accum,
+                                    skip_nonfinite=True)),
+        mesh, specs,
+    )
+    return state, step
+
+
+def test_guard_skips_nonfinite_single_batch(mesh8):
+    tx = optax.adam(1e-2)
+    state, step = _guarded_step(mesh8, tx)
+    state, m = step(state, _put(_global_batch(1), mesh8))
+    assert float(m["nonfinite"]) == 0.0 and int(state.step) == 1
+    snap = jax.device_get(state)  # BEFORE the call: donation invalidates
+    state, m = step(state, _put(_poisoned(_global_batch(2)), mesh8))
+    assert float(m["nonfinite"]) == 1.0
+    # the whole state — params, opt_state, model_state AND the step
+    # counter — is bit-identical to the pre-step state: the poisoned
+    # batch provably vanished from the trajectory
+    before, after = _state_leaves(snap), _state_leaves(state)
+    assert len(before) == len(after) and before
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, b)
+    assert int(state.step) == 1
+    # the run continues: a clean batch advances from the preserved state
+    state, m = step(state, _put(_global_batch(2), mesh8))
+    assert float(m["nonfinite"]) == 0.0 and int(state.step) == 2
+
+
+def test_guard_skips_nonfinite_microbatch_in_accum_scan(mesh8):
+    """ISSUE 9 satellite: ONE NaN microbatch inside a grad_accum_steps=4
+    scan must leave the returned state bit-identical to the pre-step
+    state and raise the flag — the guard covers the scan path, not just
+    the single-batch one."""
+    tx = optax.adam(1e-2)
+    state, step = _guarded_step(mesh8, tx, accum=4)
+    batch = make_batch(32, seed=7)
+    state, m = step(state, _put(batch, mesh8))
+    assert float(m["nonfinite"]) == 0.0 and int(state.step) == 1
+    snap = jax.device_get(state)
+    # poison exactly the second microbatch (rows 8..15 of the
+    # reshape(4, 8, ...) split)
+    state, m = step(state, _put(_poisoned(batch, rows=slice(8, 16)), mesh8))
+    assert float(m["nonfinite"]) == 1.0
+    for a, b in zip(_state_leaves(snap), _state_leaves(state)):
+        np.testing.assert_array_equal(a, b)
+    assert int(state.step) == 1
+    state, m = step(state, _put(batch, mesh8))
+    assert float(m["nonfinite"]) == 0.0 and int(state.step) == 2
+
+
+def test_guard_flag_absent_without_option(mesh8):
+    tx = optax.sgd(0.1)
+    from distributed_tensorflow_tpu.train import init_train_state
+
+    state, specs = init_train_state(linear_init, tx, mesh8,
+                                    jax.random.PRNGKey(0))
+    step = jit_train_step(make_train_step(linear_loss, tx), mesh8, specs)
+    _, m = step(state, _put(_global_batch(1), mesh8))
+    assert "nonfinite" not in m
+
+
+def test_guard_without_policy_fails_fast_with_clean_state(mesh8, tmp_path):
+    """skip_nonfinite WITHOUT an AnomalyPolicy must not silently count
+    the no-op step (that would desync the host mirror from the device
+    step counter and mislabel every later checkpoint by one): the loop
+    raises immediately — classified poisoned — with the state still the
+    last healthy one, so the emergency save lands under its true step."""
+    from distributed_tensorflow_tpu.train import init_train_state
+
+    tx = optax.adam(1e-2)
+    state, specs = init_train_state(linear_init, tx, mesh8,
+                                    jax.random.PRNGKey(0))
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "nf"),
+                         save_interval_steps=1, async_save=False,
+                         save_on_preemption=False),
+        mesh8,
+    )
+    trainer = Trainer(
+        make_train_step(linear_loss, tx, StepOptions(skip_nonfinite=True)),
+        state, mesh8, specs, callbacks=[cb.CheckpointCallback(ckpt)],
+    )
+
+    def data():
+        yield _global_batch(1)
+        yield _global_batch(2)
+        yield _poisoned(_global_batch(3))
+
+    try:
+        with pytest.raises(FloatingPointError, match="step 3"):
+            trainer.fit(data(), num_steps=5)
+        assert int(trainer.state.step) == 2  # guard kept the clean state
+        assert ckpt.latest_step() == 2       # nothing mislabeled as 3
+    finally:
+        ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# Quarantine-aware stream (data/pipeline.py)
+# ---------------------------------------------------------------------------
+
+
+def test_quarantined_raw_start_translation():
+    assert quarantined_raw_start(0, ()) == 0
+    assert quarantined_raw_start(5, ()) == 5
+    assert quarantined_raw_start(5, {3}) == 6
+    assert quarantined_raw_start(5, {3, 7}) == 6
+    assert quarantined_raw_start(2, {1, 2}) == 4
+    assert quarantined_raw_start(2, {2}) == 3
+
+
+def test_quarantine_filter_skips_around_holes():
+    reg = Registry()
+    # "batches" are the raw indices themselves: make_source(i) yields
+    # i+1, i+2, ... (the RetryingIterator contract)
+    builds = []
+
+    def make_source(i):
+        builds.append(i)
+        return itertools.count(i + 1)
+
+    f = QuarantineFilter(make_source, {3, 4, 8}, registry=reg)
+    assert list(itertools.islice(f, 6)) == [1, 2, 5, 6, 7, 9]
+    assert f.raw == 9
+    # the holes were re-seeked AROUND (source rebuilt past them), never
+    # fetched: builds at 0 (init), 4 (past 3-4), 8 (past 8)
+    assert builds == [0, 4, 8]
+    assert reg.get("anomaly_skipped_batches_total",
+                   cause="quarantined").value == 3.0
+
+
+def test_quarantine_filter_start_step_is_effective():
+    f = QuarantineFilter(lambda i: itertools.count(i + 1), {2},
+                         start_step=2, registry=Registry())
+    # 2 effective batches consumed == raws 1,3; the next delivery is 4
+    assert next(f) == 4
+
+
+# ---------------------------------------------------------------------------
+# Quarantine file (atomic blame record)
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_file_roundtrip_and_idempotence(tmp_path):
+    d = str(tmp_path / "run")
+    assert an.load_quarantine(d) == frozenset()
+    rec = FlightRecorder()
+    assert an.quarantine_index(d, 7, step=5, cause="nonfinite",
+                               flightrec=rec) is True
+    assert an.quarantine_index(d, 3, cause="bisect", flightrec=rec) is True
+    # idempotent: re-blaming (hook re-runs) must not duplicate
+    assert an.quarantine_index(d, 7, flightrec=rec) is False
+    assert an.load_quarantine(d) == frozenset({3, 7})
+    doc = an.read_quarantine(d)
+    assert doc["indices"] == [3, 7]
+    assert [e["cause"] for e in doc["entries"]] == ["nonfinite", "bisect"]
+    assert not (tmp_path / "run" / "quarantine.json.tmp").exists()
+    kinds = [e["kind"] for e in rec.events()]
+    assert kinds == ["anomaly_blame", "anomaly_blame"]
+
+
+# ---------------------------------------------------------------------------
+# AnomalyPolicy (host consumer of the flag)
+# ---------------------------------------------------------------------------
+
+
+def test_policy_requires_the_flag(tmp_path):
+    pol = rz.AnomalyPolicy(str(tmp_path), registry=Registry(),
+                           flightrec=FlightRecorder())
+    with pytest.raises(RuntimeError, match="skip_nonfinite"):
+        pol.observe(1, {"loss": np.float32(1.0)})
+
+
+def test_policy_skips_blames_and_exhausts(tmp_path):
+    reg, rec = Registry(), FlightRecorder()
+    idx = {"i": 0}
+    pol = rz.AnomalyPolicy(
+        str(tmp_path), rz.AnomalyConfig(skip_budget=2),
+        index_fn=lambda: idx["i"], registry=reg, flightrec=rec)
+    ok = {"nonfinite": np.float32(0.0)}
+    bad = {"nonfinite": np.float32(1.0)}
+    idx["i"] = 1
+    assert pol.observe(1, ok) is False
+    idx["i"] = 2
+    assert pol.observe(2, bad) is True
+    idx["i"] = 3
+    assert pol.observe(2, bad) is True  # retried step, next batch also bad
+    assert pol.skipped == 2
+    assert an.load_quarantine(str(tmp_path)) == frozenset({2, 3})
+    idx["i"] = 4
+    with pytest.raises(rz.SkipBudgetExhausted) as ei:
+        pol.observe(2, bad)
+    assert ei.value.index == 4
+    # the budget-buster is STILL blamed — restart recovery re-seeks
+    # around it instead of rediscovering it
+    assert an.load_quarantine(str(tmp_path)) == frozenset({2, 3, 4})
+    assert rz.classify_failure(ei.value) == rz.POISONED
+    assert reg.get("anomaly_skipped_batches_total",
+                   cause="nonfinite").value == 2.0
+    assert contains_in_order(rec.events(), [
+        ("anomaly_skip", {"index": 2}), ("anomaly_blame", {"index": 2}),
+        ("anomaly_skip", {"index": 3}), ("anomaly_blame", {"index": 3}),
+        ("anomaly_blame", {"index": 4}),
+    ])
+
+
+def test_policy_ewma_spike_detection(tmp_path):
+    reg, rec = Registry(), FlightRecorder()
+    pol = rz.AnomalyPolicy(
+        str(tmp_path),
+        rz.AnomalyConfig(spike_factor=3.0, spike_warmup_steps=3,
+                         spike_ewma_alpha=0.5),
+        registry=reg, flightrec=rec)
+    for s in range(1, 6):
+        assert pol.observe(
+            s, {"nonfinite": np.float32(0.0), "loss": np.float32(1.0)}
+        ) is False
+    assert pol.spikes == 0
+    pol.observe(6, {"nonfinite": np.float32(0.0), "loss": np.float32(50.0)})
+    assert pol.spikes == 1
+    assert reg.get("anomaly_spikes_total").value == 1.0
+    spike = [e for e in rec.events() if e["kind"] == "anomaly_spike"]
+    assert len(spike) == 1 and spike[0]["loss"] == 50.0
+    # a spike never drags the baseline toward itself: the next normal
+    # loss is not itself flagged as a dip-relative anomaly
+    pol.observe(7, {"nonfinite": np.float32(0.0), "loss": np.float32(1.0)})
+    assert pol.spikes == 1
+
+
+def test_policy_fail_on_spike(tmp_path):
+    pol = rz.AnomalyPolicy(
+        str(tmp_path),
+        rz.AnomalyConfig(spike_factor=2.0, spike_warmup_steps=1,
+                         fail_on_spike=True),
+        registry=Registry(), flightrec=FlightRecorder())
+    pol.observe(1, {"nonfinite": np.float32(0.0), "loss": np.float32(1.0)})
+    pol.observe(2, {"nonfinite": np.float32(0.0), "loss": np.float32(1.0)})
+    with pytest.raises(FloatingPointError, match="spike"):
+        pol.observe(3, {"nonfinite": np.float32(0.0),
+                        "loss": np.float32(9.0)})
+
+
+# ---------------------------------------------------------------------------
+# NaNGuard reads the per-step flag (cadence hole closed)
+# ---------------------------------------------------------------------------
+
+
+def test_nan_guard_flag_overrides_cadence():
+    class T:
+        def request_stop(self, r=""):
+            self.reason = r
+
+    guard = cb.NaNGuard(every_n=10, fail_fast=True)
+    # step 3 is NOT a cadence step — the flag is still honored
+    guard.on_step_end(T(), 3, {"nonfinite": np.float32(0.0),
+                               "loss": np.float32(np.nan)})  # flag wins: ok
+    with pytest.raises(FloatingPointError, match="step 3"):
+        guard.on_step_end(T(), 3, {"nonfinite": np.float32(1.0)})
+
+
+# ---------------------------------------------------------------------------
+# validate_before_save covers opt_state (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_validate_before_save_checks_opt_state(mesh8, tmp_path):
+    from distributed_tensorflow_tpu.train import init_train_state
+
+    tx = optax.adam(1e-2)
+    state, specs = init_train_state(linear_init, tx, mesh8,
+                                    jax.random.PRNGKey(0))
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "v"), async_save=False,
+                         save_on_preemption=False),
+        mesh8, spec_tree=specs,
+    )
+    try:
+        assert ckpt._params_finite(state) is True
+        # poisoned Adam moments, params still finite: the pre-fix check
+        # (params only) would have passed this state into `latest`
+        bad_opt = jax.tree.map(
+            lambda x: x * jnp.nan
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            state.opt_state,
+        )
+        bad = state.replace(opt_state=bad_opt)
+        assert all(np.isfinite(x).all()
+                   for x in _state_leaves(bad.params))
+        assert ckpt._params_finite(bad) is False
+        assert ckpt.save(1, bad, force=True) is False  # refused
+        assert ckpt.latest_step() is None
+        assert ckpt.save(1, state, force=True) is True
+    finally:
+        ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# Blame bisection
+# ---------------------------------------------------------------------------
+
+
+def test_bisect_blame_finds_first_poisoned_step():
+    calls = []
+
+    def probe(m):
+        calls.append(m)
+        return m >= 7  # poison propagates: replay through >=7 is bad
+
+    assert an.bisect_blame(probe, 2, 20) == 7
+    assert len(calls) <= 6  # logarithmic, not a linear scan
+    assert an.bisect_blame(lambda m: False, 2, 20) is None
+    assert an.bisect_blame(lambda m: True, 5, 5) is None
+
+
+def test_blame_hook_quarantines_raw_index(tmp_path):
+    d = tmp_path / "run"
+    (d / "3").mkdir(parents=True)  # newest step dir on disk == 3
+    an.quarantine_index(str(d), 4, cause="nonfinite",
+                        flightrec=FlightRecorder())
+    probed = []
+
+    def probe(lo, m):
+        probed.append((lo, m))
+        return m >= 5  # first poisoned EFFECTIVE step is 5
+
+    hook = rz.blame_hook(str(d), probe, window=8,
+                         flightrec=FlightRecorder())
+    hook(1, rz.TRANSIENT)  # not poisoned: no probing, no blame
+    assert not probed
+    hook(1, rz.POISONED)
+    assert all(lo == 3 for lo, _ in probed)
+    # effective step 5 behind the existing hole at raw 4 -> raw index 6
+    assert an.load_quarantine(str(d)) == frozenset({4, 6})
+
+
+# ---------------------------------------------------------------------------
+# E2E: recurring bad index under the Supervisor (the acceptance oracle)
+# ---------------------------------------------------------------------------
+
+
+def _anomaly_builder(workdir, mesh, plan, registry, *, tx, skip_budget=4,
+                     guard=True, save_every=1, extra_cbs=lambda: []):
+    """Production-shaped attempt builder with the anomaly defense wired:
+    guard-enabled step, quarantine-filtered stream re-read from disk at
+    every attempt boundary, per-attempt AnomalyPolicy blaming through
+    the stream's raw cursor."""
+
+    def build(restart_index):
+        ckpt = Checkpointer(
+            CheckpointConfig(directory=str(workdir),
+                             save_interval_steps=save_every,
+                             async_save=False, save_on_preemption=True,
+                             preemption_check_every=1),
+            mesh, registry=registry,
+        )
+        state, specs, _ = init_or_restore(
+            ckpt, linear_init, tx, mesh, jax.random.PRNGKey(0),
+            fallback=True,
+        )
+        start = int(state.step)
+        stream = QuarantineFilter(
+            lambda raw: plan.wrap(_batches_from(raw), start=raw),
+            rz.load_quarantine(str(workdir)), start_step=start,
+            registry=registry,
+        )
+        policy = rz.AnomalyPolicy(
+            str(workdir), rz.AnomalyConfig(skip_budget=skip_budget),
+            index_fn=lambda: stream.raw, registry=registry,
+        ) if guard else None
+        trainer = Trainer(
+            make_train_step(linear_loss, tx,
+                            StepOptions(skip_nonfinite=guard)),
+            state, mesh, specs,
+            callbacks=extra_cbs() + [cb.CheckpointCallback(ckpt),
+                                     plan.callback()],
+            anomaly_policy=policy,
+        )
+        return trainer, stream, ckpt
+
+    return build
+
+
+def _run_recurring_nan(workdir, mesh, registry):
+    plan = rz.FaultPlan((rz.NaNBatch(3, recur=True), rz.Sigterm(5)))
+    sup = rz.Supervisor(
+        _anomaly_builder(workdir, mesh, plan, registry, tx=optax.adam(1e-2),
+                         save_every=2),
+        num_steps=10,
+        cfg=rz.SupervisorConfig(max_restarts=3,
+                                backoff=rz.RetryPolicy(base_s=0.0,
+                                                       jitter=0.0)),
+        registry=registry, sleep=lambda s: None,
+    )
+    return sup.run(), sup
+
+
+def test_recurring_nan_skipped_quarantined_bit_identical(mesh8, tmp_path,
+                                                         caplog):
+    """THE acceptance criterion: a NaNBatch recurring at a fixed index on
+    every incarnation finishes under the Supervisor with the index
+    quarantined, final params BIT-identical across two same-seed runs —
+    and validate_before_save never refuses a save, because the in-graph
+    guard means poisoned params never exist to refuse."""
+    import logging
+
+    orig = signal.getsignal(signal.SIGTERM)
+    caplog.set_level(logging.ERROR,
+                     logger="distributed_tensorflow_tpu.train.checkpoint")
+    try:
+        reg_a, reg_b = Registry(), Registry()
+        state_a, sup_a = _run_recurring_nan(tmp_path / "a", mesh8, reg_a)
+        state_b, sup_b = _run_recurring_nan(tmp_path / "b", mesh8, reg_b)
+        assert int(state_a.step) == int(state_b.step) == 10
+        # one restart each — the SIGTERM preemption; the NaN batch cost
+        # NO restart (skipped in-graph, not aborted)
+        assert sup_a.restarts == sup_b.restarts == 1
+        for reg in (reg_a, reg_b):
+            assert reg.get("supervisor_restarts_total",
+                           cause="preemption").value == 1.0
+            assert reg.get("anomaly_skipped_batches_total",
+                           cause="nonfinite").value == 1.0
+        # the bad raw index is on file in both runs
+        assert rz.load_quarantine(str(tmp_path / "a")) == frozenset({3})
+        assert rz.load_quarantine(str(tmp_path / "b")) == frozenset({3})
+        # no save was ever refused: poisoned params never existed
+        assert not [r for r in caplog.records
+                    if "refusing to checkpoint" in r.getMessage()]
+        # bit-identical finals: the trajectory is a pure function of
+        # (seed, quarantine set)
+        pa = [np.asarray(x) for x in
+              jax.tree.leaves(jax.device_get(state_a.params))]
+        pb = [np.asarray(x) for x in
+              jax.tree.leaves(jax.device_get(state_b.params))]
+        assert pa and len(pa) == len(pb)
+        for a, b in zip(pa, pb):
+            np.testing.assert_array_equal(a, b)
+        # the flight recorder tells the whole causal story in order
+        assert contains_in_order(default_recorder().events(), [
+            ("fault_fired", {"fault": "nan_batch"}),
+            ("anomaly_skip", {"index": 3}),
+            ("anomaly_blame", {"index": 3}),
+            ("ckpt_save", {"trigger": "preemption"}),
+            ("sup_restart", {"cause": "preemption"}),
+            ("ckpt_restore", {"fallback": True}),
+        ])
+    finally:
+        signal.signal(signal.SIGTERM, orig)
+
+
+def test_skip_budget_exhausted_restart_reseeks_around_quarantine(mesh8,
+                                                                 tmp_path):
+    """Budget 0: the first non-finite flag raises SkipBudgetExhausted
+    (poisoned) with the index already blamed; the restarted attempt's
+    stream re-seeks AROUND the quarantined index and the run converges
+    — one restart, not an exhausted budget of futile replays."""
+    reg = Registry()
+    plan = rz.FaultPlan((rz.NaNBatch(4, recur=True),))
+    sup = rz.Supervisor(
+        _anomaly_builder(tmp_path / "q", mesh8, plan, reg,
+                         tx=optax.adam(1e-2), skip_budget=0),
+        num_steps=8,
+        cfg=rz.SupervisorConfig(max_restarts=2,
+                                backoff=rz.RetryPolicy(base_s=0.0,
+                                                       jitter=0.0)),
+        registry=reg, sleep=lambda s: None,
+    )
+    state = sup.run()
+    assert int(state.step) == 8
+    assert sup.restarts == 1
+    assert reg.get("supervisor_restarts_total", cause="poisoned").value == 1.0
+    assert rz.load_quarantine(str(tmp_path / "q")) == frozenset({4})
+    # the restarted stream skipped the hole (never fetched it)
+    assert reg.get("anomaly_skipped_batches_total",
+                   cause="quarantined").value >= 1.0
+    assert all(np.isfinite(x).all() for x in
+               [np.asarray(v) for v in
+                jax.tree.leaves(jax.device_get(state.params))])
+
+
+def test_guardless_poisoned_restart_converges_via_bisection(mesh8, tmp_path):
+    """Tier 2 — poisoning discovered only at abort time (no in-graph
+    guard, NaNGuard cadence detection): the Supervisor's poisoned
+    restart runs the blame hook, which bisects the window since the
+    last-good checkpoint by deterministic re-seek replay, quarantines
+    the exact index, and the next attempt finishes — today's futile
+    poisoned loop, made convergent."""
+    workdir = tmp_path / "g"
+    reg = Registry()
+    tx = optax.adam(1e-2)
+    plan = rz.FaultPlan((rz.NaNBatch(4, recur=True),))
+
+    def probe(lo, hi):
+        # deterministic re-seek replay WITHOUT the guard: restore the
+        # newest checkpoint (== lo), run effective steps (lo, hi] over
+        # the quarantine-filtered stream, report whether the end state
+        # is poisoned — NaNs propagate through every optax update, so
+        # the predicate is monotone and bisectable
+        ck = Checkpointer(
+            CheckpointConfig(directory=str(workdir),
+                             save_interval_steps=10 ** 9, async_save=False,
+                             save_on_preemption=False),
+            mesh8,
+        )
+        try:
+            state, specs, _ = init_or_restore(
+                ck, linear_init, tx, mesh8, jax.random.PRNGKey(0),
+                fallback=True)
+        finally:
+            ck.close()
+        step_fn = jit_train_step(make_train_step(linear_loss, tx), mesh8,
+                                 specs)
+        stream = QuarantineFilter(
+            lambda raw: plan.wrap(_batches_from(raw), start=raw),
+            rz.load_quarantine(str(workdir)), start_step=int(state.step),
+            registry=reg,
+        )
+        for _ in range(hi - int(state.step)):
+            state, _ = step_fn(state, _put(next(stream), mesh8))
+        return not all(
+            np.isfinite(np.asarray(x)).all()
+            for x in jax.tree.leaves(jax.device_get(state.params)))
+
+    sup = rz.Supervisor(
+        _anomaly_builder(workdir, mesh8, plan, reg, tx=tx, guard=False,
+                         extra_cbs=lambda: [cb.NaNGuard(every_n=1)]),
+        num_steps=8,
+        cfg=rz.SupervisorConfig(max_restarts=2,
+                                backoff=rz.RetryPolicy(base_s=0.0,
+                                                       jitter=0.0)),
+        registry=reg,
+        on_restart=[rz.blame_hook(str(workdir), probe, window=8)],
+        sleep=lambda s: None,
+    )
+    state = sup.run()
+    assert int(state.step) == 8
+    assert sup.restarts == 1  # ONE restart, not max_restarts of replays
+    assert reg.get("supervisor_restarts_total", cause="poisoned").value == 1.0
+    assert rz.load_quarantine(str(workdir)) == frozenset({4})
+    doc = an.read_quarantine(str(workdir))
+    assert doc["entries"][0]["cause"] == "bisect"

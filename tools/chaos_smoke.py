@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """Fast chaos smoke — the resilience gates quick enough for tools/ci_fast.sh.
 
-Four stages (full coverage lives in tests/test_resilience.py,
-tests/test_supervisor.py, tests/test_fleet.py and tests/test_serve.py;
-this is the canary that the recovery machinery is wired at all):
+Five stages (full coverage lives in tests/test_resilience.py,
+tests/test_supervisor.py, tests/test_anomaly.py, tests/test_fleet.py
+and tests/test_serve.py; this is the canary that the recovery
+machinery is wired at all):
 
 1. **Scheduler admission invariants** (pure host, no device work):
    bounded-queue backpressure raises QueueFull, deadlines evict with
@@ -18,7 +19,13 @@ this is the canary that the recovery machinery is wired at all):
    run — the in-process Supervisor restarts, fallback restore
    quarantines the corrupt step and lands on an older valid one, and the
    run must still finish at the target step with finite params.
-4. **One fleet gang-restart round** (resilience/fleet.py over two
+4. **One nan-blame round** (one chaos_worker subprocess, --supervise
+   --anomaly): a recurring NaN batch at a fixed index plus a SIGTERM —
+   the in-graph guard no-ops the poisoned step, the AnomalyPolicy skips
+   it under budget and quarantines the exact (seed, index), and the
+   preemption restart replays AROUND the hole to the target step with
+   finite params and zero refused saves.
+5. **One fleet gang-restart round** (resilience/fleet.py over two
    chaos_worker --fleet subprocesses): worker 1 hangs mid-run, the
    FleetSupervisor detects the death by MISSED HEARTBEATS (the process
    is still alive), SIGTERM/SIGKILLs the gang, bumps the incarnation,
@@ -140,6 +147,46 @@ def supervised_recovery_round() -> None:
           f"{POSTMORTEM_ARTIFACT})")
 
 
+#: where the nan-blame round's flight-recorder dump lands — a stable
+#: artifact so tools/ci_fast.sh can gate on the anomaly causal chain
+ANOMALY_POSTMORTEM_ARTIFACT = os.environ.get(
+    "DTF_ANOMALY_POSTMORTEM",
+    os.path.join(_REPO, "artifacts", "anomaly_postmortem.jsonl"),
+)
+
+#: the causal story the nan-blame round's timeline must tell, in order
+#: (shared with ci_fast.sh's anomaly postmortem gate): recurring bad
+#: batch fired → skipped in-graph → blamed into the quarantine file →
+#: the SIGTERM'd restart restores and replays around the hole
+ANOMALY_EXPECT = (
+    "fault_fired[fault=nan_batch],anomaly_skip,anomaly_blame,ckpt_restore"
+)
+
+
+def nan_blame_round() -> None:
+    """Recurring NaN at a fixed batch index + SIGTERM in ONE supervised
+    run (tests/chaos_worker.py --anomaly): the in-graph guard no-ops
+    the poisoned step (params never poisoned, so validate_before_save
+    never refuses), the AnomalyPolicy skips it under budget and blames
+    the exact (seed, index) into the quarantine file, and the
+    preemption restart resumes THROUGH the quarantine hole to the
+    target step with finite params. The dump is left at
+    ANOMALY_POSTMORTEM_ARTIFACT for the ci_fast postmortem gate."""
+    os.makedirs(os.path.dirname(ANOMALY_POSTMORTEM_ARTIFACT), exist_ok=True)
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke_nan_") as d:
+        out = _run_worker(os.path.join(d, "ckpt"), "--supervise",
+                          "--anomaly", "--steps", "8", "--nan-at", "3",
+                          "--sigterm-at", "5",
+                          "--flightrec", ANOMALY_POSTMORTEM_ARTIFACT)
+        assert "CHAOS-ANOMALY skipped=1 quarantined=3 refused=0" in out, out
+        assert "CHAOS-SUPERVISED step=8" in out, out
+        assert "finite=1" in out and "ordered=1" in out, out
+    assert os.path.exists(ANOMALY_POSTMORTEM_ARTIFACT)
+    print("chaos_smoke: recurring NaN batch -> in-graph skip -> blame + "
+          "quarantine -> restart past the hole -> finish OK (postmortem "
+          f"at {ANOMALY_POSTMORTEM_ARTIFACT})")
+
+
 #: where the fleet round's flight-recorder dump lands — a stable
 #: artifact so tools/ci_fast.sh can gate on the gang-restart causal
 #: chain with tools/postmortem.py --expect
@@ -213,6 +260,7 @@ def main() -> int:
     scheduler_invariants()
     sigterm_resume_round()
     supervised_recovery_round()
+    nan_blame_round()
     fleet_round()
     return 0
 

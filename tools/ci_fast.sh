@@ -16,14 +16,19 @@
 #      coordinated-save → resume subprocess round (ISSUE 3) + one
 #      supervised SIGTERM + corrupt-newest-checkpoint run that must
 #      recover via fallback restore and finish finite (ISSUE 4) + one
-#      fleet gang-restart round: a hung worker detected by missed
-#      heartbeats, whole-gang SIGTERM/SIGKILL, incarnation bump, and a
-#      relaunch from the latest common valid checkpoint (ISSUE 8)
+#      nan-blame round: a recurring NaN batch skipped in-graph, blamed
+#      and quarantined, with the restart replaying around the hole
+#      (ISSUE 9) + one fleet gang-restart round: a hung worker detected
+#      by missed heartbeats, whole-gang SIGTERM/SIGKILL, incarnation
+#      bump, and a relaunch from the latest common valid checkpoint
+#      (ISSUE 8)
 #   5. tools/postmortem.py     — flight-recorder gates: the supervised
 #      round's postmortem dump must pass schema validation AND contain
 #      fault → preemption save → restart → quarantine → fallback-restore
-#      in causal order (ISSUE 6), and the fleet round's dump must tell
-#      the gang-restart story — worker dead → gang stop → fallback
+#      in causal order (ISSUE 6), the nan-blame round's dump must tell
+#      the anomaly story — nan fault → in-graph skip → blame →
+#      restart restore (ISSUE 9) — and the fleet round's dump the
+#      gang-restart story — worker dead → gang stop → fallback
 #      ckpt_restore → fleet restart — in causal order (ISSUE 8)
 #
 # Usage: tools/ci_fast.sh   (extra args are passed to smoke_collect)
@@ -38,6 +43,9 @@ env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 env JAX_PLATFORMS=cpu python tools/postmortem.py \
   "${DTF_CHAOS_POSTMORTEM:-artifacts/chaos_postmortem.jsonl}" --quiet \
   --expect 'fault_fired[fault=sigterm],ckpt_save[trigger=preemption],sup_restart,fault_fired[fault=ckpt_corrupt],ckpt_quarantine,ckpt_restore[fallback=True]'
+env JAX_PLATFORMS=cpu python tools/postmortem.py \
+  "${DTF_ANOMALY_POSTMORTEM:-artifacts/anomaly_postmortem.jsonl}" --quiet \
+  --expect 'fault_fired[fault=nan_batch],anomaly_skip,anomaly_blame,ckpt_restore'
 env JAX_PLATFORMS=cpu python tools/postmortem.py \
   "${DTF_FLEET_POSTMORTEM:-artifacts/fleet_postmortem.jsonl}" --quiet \
   --expect 'fleet_worker_dead,fleet_gang_stop,ckpt_restore[fallback=True],fleet_restart,fleet_done'
