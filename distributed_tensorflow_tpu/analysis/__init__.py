@@ -1,22 +1,29 @@
 """dtflint — framework-aware static analysis for this repo.
 
 An AST-based lint layer that mechanically enforces the invariants the
-PR 1-6 review rounds caught by hand: host syncs inside jit-traced step
-functions, reuse of donated pytrees, lock-guarded state touched outside
-its lock, closed-vocabulary drift (flight-recorder kinds, metric names
-vs docs, the single ×3 MFU-multiplier site), and swallowed exceptions
-in the fault-classification seams.
+review rounds caught by hand: host syncs inside jit-traced step
+functions and reuse of donated pytrees (both resolved on the
+PROJECT-SCOPE call graph in :mod:`analysis.callgraph` — reachability
+and donating bindings follow imports across modules), lock-guarded
+state touched outside its lock, closed-vocabulary drift
+(flight-recorder kinds, metric names vs docs, the single ×3
+MFU-multiplier site), swallowed exceptions in the fault-classification
+seams, wall-clock/unseeded-randomness reads inside the deterministic
+seams (the bit-identical-replay contract), durable state written
+outside the tmp+fsync+os.replace idiom, and misshapen metric names
+(counters end ``_total``, second-valued histograms end ``_seconds``).
 
 Entry points:
 
 - ``tools/dtf_lint.py`` — the CLI (``--strict`` gates tools/ci_fast.sh;
   ``--self-check`` proves every rule still fires on its shipped
-  fixtures and that the tree is clean).
+  fixtures and that the tree is clean; ``--changed-only`` narrows
+  reporting to the git diff for the dev loop).
 - :func:`lint_paths` / :func:`lint_sources` — the library API
   (tests/test_lint.py drives the fixtures through these).
 
-Rule catalog, suppression syntax, and pre-fix examples:
-docs/static-analysis.md.
+Rule catalog, engine contract, suppression syntax, and pre-fix
+examples: docs/static-analysis.md.
 """
 
 from .core import (  # noqa: F401

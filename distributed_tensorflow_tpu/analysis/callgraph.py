@@ -1,0 +1,388 @@
+"""Project-scope call graph — the v2 engine under the jit-reachability rules.
+
+PR 7's `host-sync-in-step` and `donation-after-use` computed their call
+closure per module: a step function that calls a helper in ANOTHER
+module went blind at the module boundary, exactly where the framework
+puts its helpers (serve/decode.py jits functions that live next to the
+models, train/step.py's factories hand their products across files).
+This module builds ONE graph over every file in the lint run so
+reachability and donated-callable resolution follow calls across
+modules.
+
+What is resolved (documented approximation — this is a linter, not an
+interpreter):
+
+- **Module identity.** A file's dotted module name is derived from its
+  path: anything under ``distributed_tensorflow_tpu/`` keeps its real
+  package path (``…/serve/decode.py`` → ``distributed_tensorflow_tpu
+  .serve.decode``); anything else (tools/, tests/, in-memory fixtures)
+  is its bare stem — fixture files ``a.py``/``b.py`` resolve ``from a
+  import helper`` against each other.
+- **Imports.** ``import pkg.mod [as m]``, ``from pkg import mod [as
+  m]``, ``from pkg.mod import fn [as f]``, and relative forms
+  (``from ..ops.attention import flash_attention``) are resolved
+  against the modules *in this lint run*. Star imports and imports of
+  modules outside the run resolve to nothing (conservative).
+- **Calls.** Bare names (local def or from-imported symbol), dotted
+  names through module aliases (``sh.specs_from_path_rules``,
+  ``ops.attention.cached_attention`` — submodule chains are walked),
+  and ``self.``/``cls.`` method calls (name-union within the module).
+- **Function references.** ``functools.partial(f, …)`` targets, and
+  function refs passed to the trace-context primitives (``lax.scan`` /
+  ``cond`` / ``while_loop`` / ``vmap`` / ``grad`` / ``remat`` …) count
+  as calls from the enclosing function: their bodies run under the
+  caller's trace.
+- **Jit roots.** Functions decorated with / passed to ``jax.jit`` /
+  ``pjit`` / ``pmap`` (through ``partial`` and across modules), plus
+  the framework step-name contract (``train_step`` / ``eval_step`` /
+  ``decode_step`` / ``prefill`` — jitted by factories the scan may not
+  see).
+- **Donating symbols.** Module-level bindings of
+  ``jax.jit(…, donate_argnums=…)`` results (and the donating-factory
+  products) are importable: ``from train.step import jitted_step``
+  carries the donated positions with it.
+
+Attribute calls on *objects* (``model.apply``, ``tx.update``) stay
+unresolved — binding method receivers is whole-program analysis. The
+closure is therefore an under-approximation of true reachability and an
+over-approximation of nothing: every edge corresponds to a syntactic
+call path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import LintContext, Module, dotted_name
+
+__all__ = [
+    "CallGraph",
+    "ModuleNode",
+    "get_callgraph",
+    "module_name",
+    "JIT_WRAPPERS",
+    "STEP_FUNCTION_NAMES",
+]
+
+#: the package whose internal layout survives into module names
+PACKAGE = "distributed_tensorflow_tpu"
+
+JIT_WRAPPERS = frozenset({
+    "jit", "jax.jit", "pjit", "jax.pjit", "jax.pmap", "pmap",
+})
+
+#: functions jitted by factories in other modules — the framework's
+#: step-function naming contract (train/step.jit_train_step,
+#: serve/decode.jit_prefill / jit_decode_step)
+STEP_FUNCTION_NAMES = frozenset({
+    "train_step", "eval_step", "decode_step", "prefill",
+})
+
+#: last path component of callables whose function-ref arguments run
+#: under the caller's trace (jax.lax control flow, functional
+#: transforms) — a ref passed to these is an edge, not just a value
+_TRACE_ARG_TAKERS = frozenset({
+    "scan", "cond", "while_loop", "fori_loop", "switch", "map",
+    "vmap", "pmap", "grad", "value_and_grad", "remat", "checkpoint",
+    "named_call", "associative_scan",
+})
+
+_PARTIALS = ("partial", "functools.partial")
+
+
+def module_name(path: str) -> str:
+    """Dotted module name for a lint path (see module docstring)."""
+    p = path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [seg for seg in p.split("/") if seg and seg != "."]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    if PACKAGE in parts:
+        return ".".join(parts[parts.index(PACKAGE):])
+    return parts[-1] if parts else p
+
+
+def partial_target(call: ast.Call) -> ast.AST | None:
+    """``partial(f, …)`` / ``functools.partial(f, …)`` → f."""
+    if dotted_name(call.func) in _PARTIALS and call.args:
+        return call.args[0]
+    return None
+
+
+def unwrap_ref(node: ast.AST) -> ast.AST | None:
+    """Peel ``partial`` layers off a function reference; returns the
+    Name/Attribute underneath, or None for anything unresolvable."""
+    while isinstance(node, ast.Call):
+        inner = partial_target(node)
+        if inner is None:
+            return None
+        node = inner
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return node
+    return None
+
+
+class ModuleNode:
+    """One module's symbols: defs (name-union over every scope, as in
+    the v1 per-module index) and its resolved-to-dotted import table."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.name = module_name(module.path)
+        #: bare def name -> every def node sharing it (conservative union)
+        self.defs: dict[str, list[ast.AST]] = {}
+        #: local alias -> ("module", dotted) | ("from", base_dotted, leaf)
+        self.imports: dict[str, tuple] = {}
+        self._index()
+
+    def _index(self) -> None:
+        # a package __init__ IS its package (module_name dropped the
+        # "__init__" segment); a plain module's package is its parent
+        p = self.module.path.replace("\\", "/")
+        if p.endswith("/__init__.py") or p == "__init__.py":
+            pkg_parts = self.name.split(".")
+        else:
+            pkg_parts = self.name.split(".")[:-1]
+        for node in ast.walk(self.module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.imports[a.asname] = ("module", a.name)
+                    else:
+                        head = a.name.split(".")[0]
+                        self.imports[head] = ("module", head)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    up = len(pkg_parts) - (node.level - 1)
+                    if up < 0:
+                        continue  # escapes the lint run; unresolvable
+                    base_parts = pkg_parts[:up]
+                else:
+                    base_parts = []
+                if node.module:
+                    base_parts = base_parts + node.module.split(".")
+                base = ".".join(base_parts)
+                if not base:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = ("from", base, a.name)
+
+
+class CallGraph:
+    """The cross-module graph: nodes are ``(module_name, def_name)``
+    pairs; edges are resolved syntactic calls (see module docstring)."""
+
+    def __init__(self, modules: list[Module]):
+        self.nodes: dict[str, ModuleNode] = {}
+        for m in modules:
+            self.nodes[module_name(m.path)] = ModuleNode(m)
+        self._edge_cache: dict[tuple[str, str], frozenset] = {}
+
+    # -- name resolution ---------------------------------------------------
+
+    def _import_module(self, imp: tuple) -> str | None:
+        """An import-table entry read as a MODULE, when it names one."""
+        if imp[0] == "module":
+            return imp[1]
+        base, leaf = imp[1], imp[2]
+        cand = f"{base}.{leaf}"
+        if cand in self.nodes:
+            return cand
+        # `from pkg import mod` where pkg/__init__ isn't in the run:
+        # cand still names the module if any linted file has that name
+        return cand if any(n.startswith(cand + ".") for n in self.nodes) \
+            else None
+
+    def _import_symbol(self, imp: tuple) -> tuple[str, str] | None:
+        """An import-table entry read as a SYMBOL of a known module —
+        a def, or a module-level binding (donating callables are
+        assignments, not defs; reachability simply finds no defs for
+        them)."""
+        if imp[0] != "from":
+            return None
+        base, leaf = imp[1], imp[2]
+        if base in self.nodes:
+            return (base, leaf)
+        return None
+
+    def resolve_callable(self, mnode: ModuleNode,
+                         dn: str | None) -> tuple[str, str] | None:
+        """Resolve a dotted call/reference name inside ``mnode`` to a
+        ``(module, function)`` node, or None."""
+        if dn is None:
+            return None
+        parts = dn.split(".")
+        head = parts[0]
+        if head in ("self", "cls"):
+            if len(parts) == 2 and parts[1] in mnode.defs:
+                return (mnode.name, parts[1])
+            return None
+        if len(parts) == 1:
+            if head in mnode.defs:
+                return (mnode.name, head)
+            imp = mnode.imports.get(head)
+            return self._import_symbol(imp) if imp else None
+        imp = mnode.imports.get(head)
+        if imp is None:
+            return None
+        mod = self._import_module(imp)
+        if mod is None:
+            # `from pkg.mod import fn` used as a bare prefix can't be
+            # extended with attributes — fn.x is an object attribute
+            return None
+        i = 1
+        while i < len(parts) - 1 and f"{mod}.{parts[i]}" in self.nodes:
+            mod = f"{mod}.{parts[i]}"
+            i += 1
+        if i != len(parts) - 1:
+            return None
+        if mod in self.nodes:
+            return (mod, parts[-1])
+        return None
+
+    def resolve_ref(self, mnode: ModuleNode,
+                    node: ast.AST) -> tuple[str, str] | None:
+        """Resolve a function REFERENCE (possibly partial-wrapped)."""
+        ref = unwrap_ref(node)
+        return self.resolve_callable(mnode, dotted_name(ref)) \
+            if ref is not None else None
+
+    # -- edges and reachability --------------------------------------------
+
+    def callees(self, key: tuple[str, str]) -> frozenset:
+        """Every resolved target called (or trace-referenced) from the
+        defs of ``key`` — nested defs included, since their bodies run
+        (or are traced) under the enclosing function."""
+        cached = self._edge_cache.get(key)
+        if cached is not None:
+            return cached
+        mnode = self.nodes.get(key[0])
+        out: set[tuple[str, str]] = set()
+        if mnode is not None:
+            for d in mnode.defs.get(key[1], ()):
+                for node in ast.walk(d):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    target = self.resolve_callable(
+                        mnode, dotted_name(node.func))
+                    if target is not None:
+                        out.add(target)
+                    inner = partial_target(node)
+                    if inner is not None:
+                        target = self.resolve_ref(mnode, inner)
+                        if target is not None:
+                            out.add(target)
+                    dn = dotted_name(node.func)
+                    if dn is not None \
+                            and dn.rpartition(".")[2] in _TRACE_ARG_TAKERS:
+                        for arg in list(node.args) + [
+                                kw.value for kw in node.keywords]:
+                            target = self.resolve_ref(mnode, arg)
+                            if target is not None:
+                                out.add(target)
+        result = frozenset(out)
+        self._edge_cache[key] = result
+        return result
+
+    def jit_roots(self) -> set[tuple[str, str]]:
+        """Every function the run can prove (or the framework contract
+        declares) enters a jit trace."""
+        roots: set[tuple[str, str]] = set()
+        for mname, mnode in self.nodes.items():
+            for name, defs in mnode.defs.items():
+                if name in STEP_FUNCTION_NAMES:
+                    roots.add((mname, name))
+                for d in defs:
+                    for dec in d.decorator_list:
+                        target = dec.func if isinstance(dec, ast.Call) \
+                            else dec
+                        dn = dotted_name(target)
+                        if dn in JIT_WRAPPERS:
+                            roots.add((mname, name))
+                        elif isinstance(dec, ast.Call) and dn in _PARTIALS:
+                            inner = dec.args[0] if dec.args else None
+                            if dotted_name(inner) in JIT_WRAPPERS:
+                                roots.add((mname, name))
+            for node in ast.walk(mnode.module.tree):
+                if isinstance(node, ast.Call) \
+                        and dotted_name(node.func) in JIT_WRAPPERS \
+                        and node.args:
+                    target = self.resolve_ref(mnode, node.args[0])
+                    if target is not None:
+                        roots.add(target)
+        return roots
+
+    def reachable_from(
+        self, roots: set[tuple[str, str]],
+    ) -> dict[tuple[str, str], tuple[str, str] | None]:
+        """Transitive closure over :meth:`callees`; returns
+        ``node -> parent`` (None for roots) so rules can explain HOW a
+        cross-module function became reachable."""
+        parents: dict[tuple[str, str], tuple[str, str] | None] = {}
+        frontier = sorted(r for r in roots if r[0] in self.nodes
+                          and r[1] in self.nodes[r[0]].defs)
+        for r in frontier:
+            parents[r] = None
+        while frontier:
+            key = frontier.pop()
+            for callee in sorted(self.callees(key)):
+                if callee not in parents:
+                    parents[callee] = key
+                    frontier.append(callee)
+        return parents
+
+    def jit_reachable(
+        self,
+    ) -> dict[tuple[str, str], tuple[str, str] | None]:
+        return self.reachable_from(self.jit_roots())
+
+    # -- donating symbols --------------------------------------------------
+
+    def donator_symbols(
+        self, factory_donations: dict[str, tuple[int, ...]],
+        donated_positions,
+    ) -> dict[tuple[str, str], tuple[int, ...]]:
+        """Module-level (importable) bindings of donating callables:
+        ``step = jax.jit(_step, donate_argnums=(0,))`` and the factory
+        products. ``donated_positions`` is rules.donation's literal
+        ``donate_argnums`` extractor (kept there with its contract)."""
+        out: dict[tuple[str, str], tuple[int, ...]] = {}
+        for mname, mnode in self.nodes.items():
+            for stmt in mnode.module.tree.body:
+                if not isinstance(stmt, ast.Assign) \
+                        or not isinstance(stmt.value, ast.Call):
+                    continue
+                positions = donated_positions(stmt.value)
+                if positions is None:
+                    callee = dotted_name(stmt.value.func)
+                    if callee is not None:
+                        positions = factory_donations.get(
+                            callee.rpartition(".")[2])
+                if not positions:
+                    continue
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        out[(mname, target.id)] = positions
+        return out
+
+
+def get_callgraph(ctx: LintContext) -> CallGraph:
+    """The one graph of this lint run, built lazily over every module
+    ``core.lint_sources`` parsed and cached on the context."""
+    graph = ctx.scratch.get("callgraph")
+    if graph is None:
+        graph = CallGraph(getattr(ctx, "modules", []) or [])
+        ctx.scratch["callgraph"] = graph
+    return graph
+
+
+def iter_defs(graph: CallGraph, key: tuple[str, str]) -> Iterator[ast.AST]:
+    mnode = graph.nodes.get(key[0])
+    if mnode is not None:
+        yield from mnode.defs.get(key[1], ())

@@ -143,12 +143,18 @@ class Module:
 
 class LintContext:
     """Shared state across one lint run: the repo root (for vocabulary
-    extraction) and a free-form scratch dict project-scope rules use to
-    accumulate across modules before ``finalize``."""
+    extraction), the full parsed module set (``lint_sources`` populates
+    it before any rule runs — project-scope engines like
+    ``analysis/callgraph.py`` build over it), and a free-form scratch
+    dict rules use to accumulate across modules before ``finalize``."""
 
     def __init__(self, root: str | None = None):
         self.root = root if root is not None else repo_root()
         self.scratch: dict = {}
+        #: every Module in this lint run, set by the driver BEFORE the
+        #: first check_module call — cross-module rules see the whole
+        #: run even while being handed one module at a time
+        self.modules: list[Module] = []
 
     def read_repo_file(self, relpath: str) -> str | None:
         try:
@@ -244,6 +250,7 @@ def lint_sources(
                 on_parse_error(path, e)
             else:
                 raise
+    ctx.modules = modules  # the whole run, before any rule sees a module
     for module in modules:
         for rule in active:
             for f in rule.check_module(module, ctx):
@@ -277,6 +284,20 @@ def lint_paths(
 # ---------------------------------------------------------------------------
 # small AST helpers shared by the rules
 # ---------------------------------------------------------------------------
+
+
+def seam_match(path: str, seams: Iterable[str]) -> bool:
+    """Segment-anchored seam matching shared by the path-seam rules
+    (exception-hygiene, wall-clock-in-seam, atomic-durable-write).
+
+    A seam like ``"resilience/"`` or ``"train/step.py"`` matches when it
+    appears at a path-segment boundary — so both the repo-rooted form
+    (``distributed_tensorflow_tpu/resilience/x.py``) and a
+    package-relative lint invocation (``resilience/x.py``) hit, while
+    look-alike segments (``myresilience/``, ``latests/`` vs
+    ``tests/``) do not."""
+    p = "/" + path.replace("\\", "/").lstrip("./")
+    return any(f"/{s}" in p for s in seams)
 
 
 def dotted_name(node: ast.AST) -> str | None:

@@ -17,6 +17,32 @@ from __future__ import annotations
 
 FIRES_MARKER = "# fires-here"
 
+#: rules whose fixtures are PATH-SENSITIVE (seam rules fire only on
+#: seam paths) lint under a seam-shaped path instead of the default
+#: ``<fixture:rule:corpus>`` pseudo-path
+FIXTURE_PATHS: dict[str, str] = {
+    "wall-clock-in-seam":
+        "distributed_tensorflow_tpu/data/_fixture_{corpus}.py",
+}
+
+
+def fixture_path(rule: str, corpus: str) -> str:
+    """The path a fixture lints under (seam rules need seam paths)."""
+    tmpl = FIXTURE_PATHS.get(rule)
+    if tmpl is None:
+        return f"<fixture:{rule}:{corpus}>"
+    return tmpl.format(corpus=corpus)
+
+
+def injection_path(rule: str) -> str:
+    """Relative on-disk path at which the positive fixture must fire
+    when a tree containing it is linted (tests/test_lint.py's CLI
+    injection gate writes fixtures at these paths)."""
+    tmpl = FIXTURE_PATHS.get(rule)
+    if tmpl is None:
+        return f"bad_{rule.replace('-', '_')}.py"
+    return tmpl.format(corpus="positive")
+
 
 def expected_line(source: str) -> int:
     """1-based line carrying the ``# fires-here`` marker."""
@@ -84,6 +110,30 @@ def best_effort_cleanup(path):
         open(path).close()
     except:  # fires-here
         pass
+''',
+    "wall-clock-in-seam": '''\
+import time
+
+
+def stamp_batch(batch):
+    batch["t"] = time.time()  # fires-here
+    return batch
+''',
+    "atomic-durable-write": '''\
+import json
+import os
+
+
+def write_manifest(directory, doc):
+    path = os.path.join(directory, "MANIFEST.json")
+    with open(path, "w") as f:  # fires-here
+        json.dump(doc, f)
+''',
+    "metric-naming": '''\
+class Worker:
+    def __init__(self, registry):
+        self._m_restarts = registry.counter(  # fires-here
+            "worker_restarts", "restarts observed")
 ''',
 }
 
@@ -160,6 +210,41 @@ def best_effort_cleanup(path):
     except OSError:
         logger.exception("cleanup of %s failed", path)
 ''',
+    "wall-clock-in-seam": '''\
+import time
+
+import numpy as np
+
+
+def make_batch(seed, index, clock=time.monotonic):
+    # the sanctioned idioms: seeded generator, injectable clock seam
+    rng = np.random.RandomState((seed + index) & 0x7FFFFFFF)
+    return {"x": rng.uniform(size=(4,)), "queued_at": clock()}
+''',
+    "atomic-durable-write": '''\
+import json
+import os
+
+
+def write_manifest(directory, doc):
+    path = os.path.join(directory, "MANIFEST.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+''',
+    "metric-naming": '''\
+class Worker:
+    def __init__(self, registry):
+        self._m_restarts = registry.counter(
+            "worker_restarts_total", "restarts observed")
+        self._m_step = registry.histogram(
+            "worker_step_seconds", "wall-clock seconds per step")
+        self._m_occupancy = registry.gauge(
+            "worker_occupancy", "active slots at the last step")
+''',
 }
 
 
@@ -222,6 +307,33 @@ def best_effort_cleanup(path):
     except:  # dtflint: disable=exception-hygiene
         pass
 ''',
+    "wall-clock-in-seam": '''\
+import time
+
+
+def stamp_batch(batch):
+    # informational metadata, reviewed: not a trajectory input
+    batch["t"] = time.time()  # dtflint: disable=wall-clock-in-seam
+    return batch
+''',
+    "atomic-durable-write": '''\
+import json
+import os
+
+
+def write_manifest(directory, doc):
+    path = os.path.join(directory, "MANIFEST.json")
+    # reviewed: freshness over durability, torn records detected upstream
+    with open(path, "w") as f:  # dtflint: disable=atomic-durable-write
+        json.dump(doc, f)
+''',
+    "metric-naming": '''\
+class Worker:
+    def __init__(self, registry):
+        # legacy dashboard name, reviewed
+        self._m_restarts = registry.counter(  # dtflint: disable=metric-naming
+            "worker_restarts", "restarts observed")
+''',
 }
 
 
@@ -238,7 +350,7 @@ def self_check() -> list[str]:
                 failures.append(f"{rule}: no {name} fixture shipped")
     for rule, src in POSITIVE.items():
         want_line = expected_line(src)
-        found = lint_sources({f"<fixture:{rule}:positive>": src})
+        found = lint_sources({fixture_path(rule, "positive"): src})
         hits = [f for f in found if f.rule == rule]
         if not hits:
             failures.append(
@@ -254,13 +366,13 @@ def self_check() -> list[str]:
                     f"{rule}: positive fixture also tripped {f.rule} "
                     f"at line {f.line} — fixtures must isolate one rule")
     for rule, src in NEGATIVE.items():
-        found = lint_sources({f"<fixture:{rule}:negative>": src})
+        found = lint_sources({fixture_path(rule, "negative"): src})
         if found:
             failures.append(
                 f"{rule}: negative fixture not clean: "
                 f"{[f.format() for f in found]}")
     for rule, src in SUPPRESSED.items():
-        found = lint_sources({f"<fixture:{rule}:suppressed>": src})
+        found = lint_sources({fixture_path(rule, "suppressed"): src})
         if found:
             failures.append(
                 f"{rule}: suppression marker ignored: "

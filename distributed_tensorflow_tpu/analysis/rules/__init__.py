@@ -2,8 +2,24 @@
 
 One module per rule family; each rule's docstring is its catalog entry
 (docs/static-analysis.md mirrors them with real pre-fix examples).
+The v1 five (host-sync, donation, locks, vocab, exceptions) are joined
+by the v2 contract rules (determinism, durability, naming), and the
+reachability rules now run on the analysis/callgraph.py project-scope
+engine.
 """
 
-from . import donation, exceptions, host_sync, locks, vocab  # noqa: F401
+from . import (  # noqa: F401
+    determinism,
+    donation,
+    durability,
+    exceptions,
+    host_sync,
+    locks,
+    naming,
+    vocab,
+)
 
-__all__ = ["donation", "exceptions", "host_sync", "locks", "vocab"]
+__all__ = [
+    "determinism", "donation", "durability", "exceptions",
+    "host_sync", "locks", "naming", "vocab",
+]

@@ -1,0 +1,201 @@
+"""wall-clock-in-seam — deterministic seams may not read ambient entropy.
+
+Every resilience guarantee since PR 3 reduces to ONE invariant: the
+training trajectory is a pure function of ``(seed, index, quarantine
+set)``. That is what makes SIGTERM→restore→resume BIT-identical, what
+lets the anomaly bisector replay from the last good checkpoint and
+blame the exact raw batch, and what makes the chaos E2Es oracles rather
+than flaky approximations. A ``time.time()`` in a batch builder, an
+unseeded ``np.random.rand()`` in an augmentation, an ``os.urandom`` in
+a replay path — each silently breaks replay while every test that
+doesn't cross a restart keeps passing.
+
+Seams and tiers:
+
+- **Strict seams** (wall-clock AND unseeded randomness banned):
+  ``data/`` (batches are pure functions of ``(seed, index)`` —
+  ``pipeline.batch_rng`` is the idiom), ``train/step.py`` (the step's
+  only randomness is ``fold_in(state.rng, step)``), ``resilience/``
+  (the replay/bisection machinery itself: FaultPlan schedules, retry
+  jitter, supervisor backoff are all seeded; time flows through the
+  injectable FaultClock / ``clock=`` parameters), and
+  ``tests/chaos_worker.py`` (the bit-identity E2E oracle — a wall
+  clock read there weakens exactly what it certifies).
+- **Scaffolding seams** (unseeded randomness banned, wall-clock
+  allowed): ``tests/`` — test *data* must be reproducible, but
+  deadlines and liveness budgets are process control, not trajectory
+  inputs.
+
+What fires:
+
+- ``time.time`` / ``time.monotonic`` / ``time.perf_counter`` (and the
+  ``_ns`` variants) CALLS in a strict seam. A *reference* as an
+  injectable default (``def f(..., clock=time.monotonic)``) is the
+  sanctioned idiom and never flagged — only the ambient read is.
+- the global-state ``random`` module: ``random.random()``,
+  ``random.randint(...)``, ``random.seed(...)`` (global seeding is
+  order-dependent shared state), ``random.Random()`` with NO seed,
+  ``random.SystemRandom(...)``. ``random.Random(seed)`` is clean.
+- global-state numpy randomness: ``np.random.<fn>(...)``,
+  ``np.random.RandomState()`` / ``np.random.default_rng()`` with no
+  seed. Seeded constructors (``np.random.RandomState(seed)``,
+  ``np.random.default_rng(seed)``) are clean — methods on the
+  resulting generator are invisible to this rule by design.
+- ``os.urandom(...)`` everywhere in a seam (both tiers).
+
+``jax.random`` is exempt: its explicit-key API is the seam. Aliases
+are resolved from the module's imports (``import numpy as onp``,
+``from time import monotonic as now``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (
+    Finding, LintContext, Module, Rule, dotted_name, register, seam_match,
+)
+
+#: wall-clock AND randomness banned (segment-anchored: core.seam_match,
+#: so package-relative lint invocations like ``resilience/`` hit too)
+STRICT_SEAMS = (
+    "data/",
+    "train/step.py",
+    "resilience/",
+    "chaos_worker.py",
+)
+
+#: randomness banned, wall-clock allowed (pure test scaffolding)
+SCAFFOLDING_SEAMS = ("tests/",)
+
+_CLOCK_FNS = frozenset({
+    "time", "monotonic", "perf_counter",
+    "time_ns", "monotonic_ns", "perf_counter_ns",
+})
+
+#: np.random constructors that are clean WHEN seeded
+_SEEDED_CTORS = frozenset({"RandomState", "default_rng", "Generator"})
+
+_FIX_HINT = ("route it through an injectable clock seam "
+             "(resilience/faults.FaultClock, a clock= parameter) or a "
+             "seeded generator (data/pipeline.batch_rng, "
+             "random.Random(seed), np.random.RandomState(seed))")
+
+
+def _tier(path: str) -> str | None:
+    if seam_match(path, STRICT_SEAMS):
+        return "strict"
+    if seam_match(path, SCAFFOLDING_SEAMS):
+        return "scaffolding"
+    return None
+
+
+class _ImportMap:
+    """Local aliases of the entropy-bearing stdlib/numpy namespaces."""
+
+    def __init__(self, tree: ast.Module):
+        self.time: set[str] = set()
+        self.random: set[str] = set()
+        self.numpy: set[str] = set()
+        self.numpy_random: set[str] = set()
+        self.os: set[str] = set()
+        #: from-imported leaf alias -> canonical "module.leaf"
+        self.direct: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    if a.name == "time":
+                        self.time.add(bound)
+                    elif a.name == "random":
+                        self.random.add(bound)
+                    elif a.name == "numpy":
+                        self.numpy.add(bound)
+                    elif a.name == "numpy.random" and a.asname:
+                        self.numpy_random.add(a.asname)
+                    elif a.name == "os":
+                        self.os.add(bound)
+            elif isinstance(node, ast.ImportFrom) and not node.level:
+                if node.module in ("time", "random", "os", "numpy.random"):
+                    for a in node.names:
+                        if a.name != "*":
+                            self.direct[a.asname or a.name] = \
+                                f"{node.module}.{a.name}"
+
+
+@register
+class WallClockRule(Rule):
+    name = "wall-clock-in-seam"
+    summary = ("time.time()/unseeded random/np.random/os.urandom inside "
+               "a deterministic seam (data/, train/step.py, resilience/, "
+               "test oracles) — replay stops being bit-identical")
+
+    def check_module(self, module: Module,
+                     ctx: LintContext) -> Iterator[Finding]:
+        tier = _tier(module.path)
+        if tier is None:
+            return
+        imports = _ImportMap(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = self._entropy_kind(node, imports)
+            if hit is None:
+                continue
+            kind, what = hit
+            if kind == "clock" and tier != "strict":
+                continue  # scaffolding: deadlines are process control
+            yield Finding(
+                self.name, module.path, node.lineno, node.col_offset,
+                f"{what} inside a deterministic seam — the trajectory "
+                f"must be a pure function of (seed, index, quarantine "
+                f"set) or replay/bisection stops being bit-identical; "
+                f"{_FIX_HINT}",
+            )
+
+    @staticmethod
+    def _entropy_kind(call: ast.Call,
+                      imports: _ImportMap) -> tuple[str, str] | None:
+        dn = dotted_name(call.func)
+        if dn is None:
+            return None
+        canonical = imports.direct.get(dn, dn)
+        parts = canonical.split(".")
+        head = parts[0]
+        has_args = bool(call.args or call.keywords)
+
+        if canonical.startswith("time.") or head in imports.time:
+            leaf = parts[-1]
+            if len(parts) == 2 and leaf in _CLOCK_FNS:
+                return ("clock", f"time.{leaf}() reads the ambient wall "
+                                 f"clock")
+            return None
+        if canonical.startswith("random.") or head in imports.random:
+            if len(parts) != 2:
+                return None
+            leaf = parts[-1]
+            if leaf == "Random":
+                if has_args:
+                    return None  # seeded instance: the sanctioned idiom
+                return ("random", "random.Random() without a seed")
+            if leaf == "SystemRandom":
+                return ("random", "random.SystemRandom (OS entropy)")
+            return ("random", f"global-state random.{leaf}()")
+        np_random = (
+            (head in imports.numpy and len(parts) == 3
+             and parts[1] == "random")
+            or (head in imports.numpy_random and len(parts) == 2)
+            or (canonical.startswith("numpy.random.") and len(parts) == 3)
+        )
+        if np_random:
+            leaf = parts[-1]
+            if leaf in _SEEDED_CTORS:
+                if has_args:
+                    return None
+                return ("random", f"np.random.{leaf}() without a seed")
+            return ("random", f"global-state np.random.{leaf}()")
+        if canonical == "os.urandom" or (head in imports.os
+                                         and canonical.endswith(".urandom")):
+            return ("random", "os.urandom() (OS entropy)")
+        return None

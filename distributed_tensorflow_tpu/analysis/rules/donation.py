@@ -9,7 +9,8 @@ cache-deserialized executables the ROADMAP documents, heap corruption
 and silently-NaN params at worst. This rule makes the contract
 mechanical.
 
-Detection (intraprocedural, documented approximation):
+Detection (intraprocedural per function; donating-callable resolution
+is PROJECT-SCOPE since the v2 engine):
 
 - **Donating callables.** Any local binding of the form
   ``f = jax.jit(..., donate_argnums=...)`` (including ``self.attr``
@@ -17,7 +18,11 @@ Detection (intraprocedural, documented approximation):
   factories — ``jit_train_step`` (donates position 0, the TrainState)
   and ``jit_prefill`` / ``jit_decode_step`` (donate position 1, the
   KVCache) — whose wrapping happens in another module where a local
-  scan can't see the ``donate_argnums``.
+  scan can't see the ``donate_argnums``. Additionally, module-level
+  donating bindings are importable: ``from serve.decode import
+  jitted_step`` (or ``decode_lib.jitted_step(...)`` through a module
+  alias) carries its donated positions into the importing module via
+  the call graph (analysis/callgraph.py).
 - **Consumption.** A call to a donating callable taints the plain-name
   or ``self.attr`` argument at each donated position.
 - **Violation.** Any later read of the tainted name in the same
@@ -35,6 +40,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
+from .. import callgraph as cg
 from ..core import Finding, LintContext, Module, Rule, dotted_name, register
 
 #: framework factories that return donating callables: name -> donated
@@ -82,9 +88,11 @@ def _binding_repr(node: ast.AST) -> str | None:
 
 def _donating_call_positions(call: ast.Call,
                              donators: dict[str, tuple[int, ...]],
+                             resolver=None,
                              ) -> tuple[int, ...] | None:
     """Donated positions when ``call`` invokes a known donating
-    callable (bound name or framework factory product)."""
+    callable (bound name, framework factory product, or — through
+    ``resolver`` — an imported module-level donating binding)."""
     dn = dotted_name(call.func)
     if dn is not None and dn in donators:
         return donators[dn]
@@ -93,6 +101,8 @@ def _donating_call_positions(call: ast.Call,
         inline = _donated_positions(call.func)
         if inline:
             return inline
+    if resolver is not None:
+        return resolver(call)
     return None
 
 
@@ -129,11 +139,30 @@ class DonationRule(Rule):
 
     def check_module(self, module: Module,
                      ctx: LintContext) -> Iterator[Finding]:
+        graph = cg.get_callgraph(ctx)
+        symbols = ctx.scratch.get("donator_symbols")
+        if symbols is None:
+            symbols = graph.donator_symbols(
+                FACTORY_DONATIONS, _donated_positions)
+            ctx.scratch["donator_symbols"] = symbols
+        mnode = graph.nodes.get(cg.module_name(module.path))
+        if mnode is None or mnode.module is not module:
+            # duplicate module names in one run: fall back to a solo
+            # graph so this module still resolves its own bindings
+            graph = cg.CallGraph([module])
+            mnode = graph.nodes[cg.module_name(module.path)]
+            symbols = graph.donator_symbols(
+                FACTORY_DONATIONS, _donated_positions)
+
+        def resolver(call: ast.Call) -> tuple[int, ...] | None:
+            target = graph.resolve_callable(mnode, dotted_name(call.func))
+            return symbols.get(target) if target is not None else None
+
         donators = self._collect_donators(module.tree)
         lister = _FunctionLister()
         lister.visit(module.tree)
         for fn in lister.functions:
-            yield from self._check_function(fn, donators, module)
+            yield from self._check_function(fn, donators, module, resolver)
 
     @staticmethod
     def _collect_donators(tree: ast.Module) -> dict[str, tuple[int, ...]]:
@@ -160,7 +189,7 @@ class DonationRule(Rule):
         return donators
 
     def _check_function(self, fn, donators: dict[str, tuple[int, ...]],
-                        module: Module) -> Iterator[Finding]:
+                        module: Module, resolver=None) -> Iterator[Finding]:
         # events per line: (kind, repr, node); processed line-by-line as
         # uses -> consumes -> rebinds so same-line rebinding stays clean
         consumes: dict[int, list[tuple[str, str]]] = {}
@@ -169,7 +198,7 @@ class DonationRule(Rule):
 
         for node in _scope_walk(fn):
             if isinstance(node, ast.Call):
-                positions = _donating_call_positions(node, donators)
+                positions = _donating_call_positions(node, donators, resolver)
                 if positions:
                     callee = dotted_name(node.func) or "<jitted>"
                     for pos in positions:

@@ -1,0 +1,185 @@
+"""atomic-durable-write — durable state is written tmp+fsync+os.replace.
+
+Recovery walks a chain of on-disk evidence: checkpoint shards and
+MANIFEST.dtf (runtime/io.py CRC-verified payloads), quarantine.json
+(the trajectory's hole list — a torn write there and every future
+incarnation fetches a different stream), heartbeat/INCARNATION/
+RESTORE_STEP control files (resilience/fleet.py), and postmortem dumps
+(obs/flightrec.py). The framework's ONE idiom for all of them:
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(...)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+A bare ``open(path, "w")`` to durable state can be observed half
+written by a concurrent reader AND can survive a crash as a torn file
+that *looks* complete — the failure mode PR 4's manifest verifier
+exists to catch, reintroduced one layer down.
+
+Detection (heuristic, tuned to this repo's idioms):
+
+- **Where.** Truncating writes (mode ``"w"`` / ``"wb"`` / ``"w+"`` /
+  ``"x"`` …) are examined (a) in the durable-state modules —
+  train/checkpoint.py, resilience/fleet.py, resilience/anomaly.py,
+  obs/flightrec.py, runtime/io.py — and (b) anywhere else when the
+  enclosing function's source mentions a durable artifact (checkpoint/
+  ckpt/manifest/heartbeat/quarantine/incarnation/restore_step/
+  postmortem). Append-mode streams (JSONL event logs) are incremental
+  by design and exempt.
+- **Clean.** The write itself targets the tmp sibling (its path
+  expression names ``tmp`` — the repo's one spelling of the idiom)
+  AND the enclosing function calls BOTH ``os.fsync`` and
+  ``os.replace`` (or ``os.rename``). Per-WRITE, not per-function: a
+  bare ``open(path, "w")`` next to a correct atomic write of another
+  file is still a finding — co-location with one atomic write must
+  not bless a second, torn one. Delegating to a shared atomic writer
+  (runtime/io.write_payload, flightrec's dump) is naturally clean: no
+  raw ``open`` in the caller.
+- **Reviewed exceptions** use the standard suppression with a comment:
+  fleet's heartbeat ``_atomic_write`` deliberately skips fsync (a
+  record lost to a crash IS the liveness signal) and carries the
+  marker plus its justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import (
+    Finding, LintContext, Module, Rule, dotted_name, register, seam_match,
+)
+
+#: modules whose writes are durable by definition (segment-anchored —
+#: core.seam_match)
+DURABLE_MODULES = (
+    "train/checkpoint.py",
+    "resilience/fleet.py",
+    "resilience/anomaly.py",
+    "obs/flightrec.py",
+    "runtime/io.py",
+)
+
+#: a function elsewhere is IN the durable contract when its source
+#: names one of the recovery artifacts
+_DURABLE_TOKENS = re.compile(
+    r"checkpoint|ckpt|manifest|heartbeat|quarantine|incarnation"
+    r"|restore_step|postmortem",
+    re.IGNORECASE,
+)
+
+_TRUNCATING_MODES = frozenset({
+    "w", "wb", "w+", "wb+", "w+b", "x", "xb", "x+", "xb+",
+})
+
+
+def _is_durable_module(path: str) -> bool:
+    return seam_match(path, DURABLE_MODULES)
+
+
+def _write_mode(call: ast.Call) -> str | None:
+    """The literal mode of an ``open(...)`` call, when truncating."""
+    if dotted_name(call.func) not in ("open", "io.open"):
+        return None
+    mode_node = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if isinstance(mode_node, ast.Constant) and isinstance(mode_node.value, str):
+        mode = mode_node.value.replace("t", "")
+        if mode in _TRUNCATING_MODES:
+            return mode_node.value
+    return None
+
+
+class _FunctionStack(ast.NodeVisitor):
+    """(write-open call, enclosing function or None) pairs."""
+
+    def __init__(self):
+        self.hits: list[tuple[ast.Call, str, ast.AST | None]] = []
+        self._stack: list[ast.AST] = []
+
+    def visit_FunctionDef(self, node):
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        mode = _write_mode(node)
+        if mode is not None:
+            # attribute to the OUTERMOST function: the atomic idiom's
+            # fsync/replace legitimately live in the enclosing scope of
+            # a nested helper
+            self.hits.append(
+                (node, mode, self._stack[0] if self._stack else None))
+        self.generic_visit(node)
+
+
+def _fn_source(module: Module, fn: ast.AST) -> str:
+    end = getattr(fn, "end_lineno", fn.lineno)
+    return "\n".join(module.lines[fn.lineno - 1:end])
+
+
+def _has_atomic_shape(fn: ast.AST | None) -> bool:
+    if fn is None:
+        return False
+    saw_fsync = saw_replace = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn in ("os.fsync", "fsync"):
+                saw_fsync = True
+            elif dn in ("os.rename", "os.replace", "rename", "replace"):
+                saw_replace = True
+    return saw_fsync and saw_replace
+
+
+def _targets_tmp(call: ast.Call) -> bool:
+    """This WRITE opens the tmp sibling: its path expression names
+    ``tmp`` (``tmp``, ``path + ".tmp"``, ``f"{path}.tmp"`` — the one
+    spelling of the idiom in this repo). Judged per write so a bare
+    in-place open next to a correct atomic write stays a finding."""
+    if not call.args:
+        return False
+    try:
+        text = ast.unparse(call.args[0])
+    except Exception:  # pragma: no cover — unparse of any expr
+        return False
+    return "tmp" in text.lower()
+
+
+@register
+class AtomicDurableWriteRule(Rule):
+    name = "atomic-durable-write"
+    summary = ("a truncating open() on durable state (checkpoint/"
+               "manifest/heartbeat/quarantine paths) outside the "
+               "tmp+fsync+os.replace idiom")
+
+    def check_module(self, module: Module,
+                     ctx: LintContext) -> Iterator[Finding]:
+        durable_module = _is_durable_module(module.path)
+        scanner = _FunctionStack()
+        scanner.visit(module.tree)
+        for call, mode, fn in scanner.hits:
+            if not durable_module:
+                if fn is None or not _DURABLE_TOKENS.search(
+                        _fn_source(module, fn)):
+                    continue
+            if _targets_tmp(call) and _has_atomic_shape(fn):
+                continue
+            yield Finding(
+                self.name, module.path, call.lineno, call.col_offset,
+                f"open(..., {mode!r}) writes durable state in place — a "
+                f"crash (or a concurrent reader) sees a torn file that "
+                f"looks complete; write to a .tmp sibling, flush + "
+                f"os.fsync, then os.replace onto the real path "
+                f"(runtime/io.write_payload is the shared idiom)",
+            )

@@ -35,10 +35,13 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from ..core import Finding, LintContext, Module, Rule, dotted_name, register
+from ..core import (
+    Finding, LintContext, Module, Rule, dotted_name, register, seam_match,
+)
 
 #: modules where even a narrow silent handler defeats fault
-#: classification (see module docstring)
+#: classification (see module docstring; segment-anchored via
+#: core.seam_match, shared with the determinism/durability seams)
 SEAM_PATHS = (
     "resilience/",
     "train/checkpoint.py",
@@ -49,8 +52,7 @@ _BROAD = frozenset({"Exception", "BaseException"})
 
 
 def _is_seam(path: str) -> bool:
-    p = path.replace("\\", "/")
-    return any(s in p for s in SEAM_PATHS)
+    return seam_match(path, SEAM_PATHS)
 
 
 def _caught_names(node: ast.ExceptHandler) -> list[str]:

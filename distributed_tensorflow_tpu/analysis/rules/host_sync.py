@@ -9,23 +9,32 @@ error) or — worse, when it slips through on a re-traced python value —
 silently serializes dispatch with execution, the ~40x step-rate cliff
 utils/benchmarking.py documents for tunneled platforms.
 
-What counts as jit-reachable (module-local, documented approximation):
+What counts as jit-reachable (PROJECT-SCOPE since the v2 engine —
+analysis/callgraph.py holds the resolution contract):
 
 - functions decorated with ``jax.jit`` / ``jit`` / ``pjit`` /
   ``jax.pmap`` (bare or via ``functools.partial``);
-- functions passed to those wrappers anywhere in the module
-  (``step = jax.jit(train_step)``, ``jax.jit(partial(fn, model))``);
+- functions passed to those wrappers anywhere in the lint run —
+  including across modules (``jax.jit(decode_lib.prefill)``,
+  ``jax.jit(partial(prefill, model))``);
 - the framework's step-function naming convention: ``train_step`` /
   ``eval_step`` / ``decode_step`` / ``prefill``, which are jitted by
   factories in *other* modules (train/step.jit_train_step,
-  serve/decode.jit_prefill) — the module-local scan cannot see that
-  wrapping, so the names are part of the framework contract;
-- anything those functions call by bare name in the same module
-  (transitive), including nested defs (a ``lax.scan`` body is traced).
+  serve/decode.jit_prefill) — the names are part of the framework
+  contract;
+- anything those functions call transitively, across module
+  boundaries: bare names, from-imported symbols, module-alias dotted
+  calls, ``self.`` methods, ``partial`` targets, and function refs
+  passed to trace-context primitives (``lax.scan`` bodies run under
+  the caller's trace). Nested defs are scanned with their enclosing
+  function.
 
 ``float()``/``bool()`` on literal constants are ignored (static config
 arithmetic, not a sync). Numpy aliases are resolved from the module's
-imports; ``jnp.asarray`` is device-side and never flagged.
+imports; ``jnp.asarray`` is device-side and never flagged. When a
+function is reachable only through another module, the finding says
+which root reached it — cross-module reachability is exactly what the
+v1 per-module engine could not see.
 """
 
 from __future__ import annotations
@@ -33,17 +42,13 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
+from .. import callgraph as cg
 from ..core import Finding, LintContext, Module, Rule, dotted_name, register
 
-#: functions jitted by factories in other modules — the framework's
-#: step-function naming contract (see module docstring)
-STEP_FUNCTION_NAMES = frozenset({
-    "train_step", "eval_step", "decode_step", "prefill",
-})
-
-_JIT_WRAPPERS = frozenset({
-    "jit", "jax.jit", "pjit", "jax.pjit", "jax.pmap", "pmap",
-})
+#: re-exported for compatibility: the naming contract lives with the
+#: graph engine now
+STEP_FUNCTION_NAMES = cg.STEP_FUNCTION_NAMES
+_JIT_WRAPPERS = cg.JIT_WRAPPERS
 
 #: method-call syncs on any receiver
 _SYNC_METHODS = frozenset({"item"})
@@ -59,111 +64,67 @@ def _numpy_aliases(tree: ast.Module) -> set[str]:
     return aliases
 
 
-def _partial_target(call: ast.Call) -> ast.AST | None:
-    """``partial(f, ...)`` / ``functools.partial(f, ...)`` → f."""
-    if dotted_name(call.func) in ("partial", "functools.partial") and call.args:
-        return call.args[0]
-    return None
-
-
-def _wrapped_function_name(node: ast.AST) -> str | None:
-    """The bare name of the function being jit-wrapped, if resolvable."""
-    if isinstance(node, ast.Call):
-        inner = _partial_target(node)
-        if inner is not None:
-            return _wrapped_function_name(inner)
-        return None
-    if isinstance(node, ast.Name):
-        return node.id
-    return None
-
-
-class _FunctionIndex(ast.NodeVisitor):
-    """name -> FunctionDef nodes (module, class, and nested scopes; a
-    name maps to every def sharing it — conservative union)."""
-
-    def __init__(self):
-        self.defs: dict[str, list[ast.AST]] = {}
-
-    def visit_FunctionDef(self, node):
-        self.defs.setdefault(node.name, []).append(node)
-        self.generic_visit(node)
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-
 @register
 class HostSyncRule(Rule):
     name = "host-sync-in-step"
     summary = ("float()/bool()/.item()/np.asarray()/jax.device_get() "
-               "inside a jit-reachable step/decode function")
+               "inside a jit-reachable step/decode function "
+               "(reachability follows calls across modules)")
 
     def check_module(self, module: Module,
                      ctx: LintContext) -> Iterator[Finding]:
-        tree = module.tree
-        index = _FunctionIndex()
-        index.visit(tree)
-        np_aliases = _numpy_aliases(tree)
-
-        roots: set[str] = set()
-        for name, defs in index.defs.items():
-            if name in STEP_FUNCTION_NAMES:
-                roots.add(name)
-            for d in defs:
-                for dec in d.decorator_list:
-                    target = dec.func if isinstance(dec, ast.Call) else dec
-                    dn = dotted_name(target)
-                    if dn in _JIT_WRAPPERS:
-                        roots.add(name)
-                    elif isinstance(dec, ast.Call) and dn in (
-                            "partial", "functools.partial"):
-                        inner = dec.args[0] if dec.args else None
-                        if dotted_name(inner) in _JIT_WRAPPERS:
-                            roots.add(name)
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call) \
-                    and dotted_name(node.func) in _JIT_WRAPPERS and node.args:
-                wrapped = _wrapped_function_name(node.args[0])
-                if wrapped and wrapped in index.defs:
-                    roots.add(wrapped)
-
-        # transitive closure over bare-name calls within the module
-        reachable: set[str] = set()
-        frontier = sorted(roots & set(index.defs))
-        while frontier:
-            name = frontier.pop()
-            if name in reachable:
-                continue
-            reachable.add(name)
-            for d in index.defs[name]:
-                for node in ast.walk(d):
-                    if isinstance(node, ast.Call) \
-                            and isinstance(node.func, ast.Name) \
-                            and node.func.id in index.defs \
-                            and node.func.id not in reachable:
-                        frontier.append(node.func.id)
+        graph = cg.get_callgraph(ctx)
+        parents = ctx.scratch.get("host_sync_reachable")
+        if parents is None:
+            parents = graph.jit_reachable()
+            ctx.scratch["host_sync_reachable"] = parents
+        mname = cg.module_name(module.path)
+        mnode = graph.nodes.get(mname)
+        if mnode is None or mnode.module is not module:
+            # duplicate module names in one run (two files with the same
+            # stem): the graph kept one; scan the other module-locally
+            # so nothing is silently skipped
+            solo = cg.CallGraph([module])
+            mnode = solo.nodes[cg.module_name(module.path)]
+            parents = solo.jit_reachable()
+        np_aliases = _numpy_aliases(module.tree)
 
         seen_lines: set[tuple[int, int]] = set()
-        for name in sorted(reachable):
-            for d in index.defs[name]:
+        for key in sorted(parents):
+            if key[0] != mnode.name:
+                continue
+            origin = self._origin(parents, key)
+            for d in mnode.defs.get(key[1], ()):
                 for node in ast.walk(d):
                     if not isinstance(node, ast.Call):
                         continue
                     hit = self._sync_kind(node, np_aliases)
                     if hit is None:
                         continue
-                    key = (node.lineno, node.col_offset)
-                    if key in seen_lines:
+                    pos = (node.lineno, node.col_offset)
+                    if pos in seen_lines:
                         continue  # defs overlap when nested
-                    seen_lines.add(key)
+                    seen_lines.add(pos)
                     yield Finding(
                         self.name, module.path, node.lineno,
                         node.col_offset,
                         f"{hit} inside jit-reachable function "
-                        f"{name!r} forces a host sync (or a trace-time "
-                        f"concretization error); compute it with jnp "
-                        f"on-device or move it outside the jitted step",
+                        f"{key[1]!r}{origin} forces a host sync (or a "
+                        f"trace-time concretization error); compute it "
+                        f"with jnp on-device or move it outside the "
+                        f"jitted step",
                     )
+
+    @staticmethod
+    def _origin(parents, key) -> str:
+        """' (reached from X in mod)' when jit-ness arrived from another
+        module — the provenance the per-module v1 engine couldn't name."""
+        node = key
+        while parents.get(node) is not None:
+            node = parents[node]
+        if node[0] == key[0]:
+            return ""
+        return f" (reached from {node[1]!r} in {node[0]})"
 
     @staticmethod
     def _sync_kind(call: ast.Call, np_aliases: set[str]) -> str | None:

@@ -293,7 +293,11 @@ def ln_matmul(
         bias = jnp.zeros((n,), jnp.float32)
     out_dtype = jnp.dtype(out_dtype or x.dtype)
     bwd_impl = _tiling.resolve_bwd_impl(bwd_impl)
-    op = _make_op(float(eps), out_dtype.name, bool(interpret), bwd_impl)
+    # reviewed: eps/interpret are keyword-only host config (python
+    # float/bool), normalized for the op cache key before tracing ever
+    # sees them — not device values (tools/validate_fused_tpu.py jits
+    # this entry point, which is how the cross-module engine reaches it)
+    op = _make_op(float(eps), out_dtype.name, bool(interpret), bwd_impl)  # dtflint: disable=host-sync-in-step
     return op(
         x,
         gamma.reshape(1, d).astype(jnp.float32),

@@ -162,11 +162,16 @@ def load_quarantine(directory: str) -> frozenset[int]:
 
 def quarantine_index(directory: str, index: int, *, step: int | None = None,
                      cause: str = CAUSE_NONFINITE, note: str = "",
-                     flightrec=None) -> bool:
+                     flightrec=None, clock: Callable[[], float] = time.time,
+                     ) -> bool:
     """Blame raw batch ``index``: append it to the quarantine file via
     tmp + fsync + rename (a torn write must not look complete — the
     file steers every future incarnation's data stream) and emit
-    ``anomaly_blame``. Returns False when the index was already
+    ``anomaly_blame``. The entry's ``t`` stamp reads the injectable
+    ``clock`` seam (wall time by default) — informational metadata,
+    but the blame path is replayed by the bisector, so even its
+    timestamps route through a seam rather than an ambient read.
+    Returns False when the index was already
     quarantined (idempotent: Supervisor hooks re-run on hook failure)."""
     doc = read_quarantine(directory)
     index = int(index)
@@ -178,7 +183,7 @@ def quarantine_index(directory: str, index: int, *, step: int | None = None,
         "step": None if step is None else int(step),
         "cause": cause,
         "note": str(note)[:200],
-        "t": time.time(),
+        "t": clock(),
     })
     path = quarantine_path(directory)
     os.makedirs(os.path.dirname(path), exist_ok=True)

@@ -129,9 +129,12 @@ class FleetExhausted(RuntimeError):
 def _atomic_write(path: str, text: str) -> None:
     """tmp + rename so a reader never sees a torn record; no fsync —
     these files trade durability for freshness (a record lost to a
-    crash IS the signal the protocol detects)."""
+    crash IS the signal the protocol detects: a heartbeat that didn't
+    reach disk reads as a missed beat, which is the truth)."""
     tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
+    # reviewed: deliberately NOT the fsync idiom — see docstring; an
+    # fsync per beat would put a disk flush on the liveness hot path
+    with open(tmp, "w") as f:  # dtflint: disable=atomic-durable-write
         f.write(text)
     os.replace(tmp, path)
 

@@ -337,15 +337,23 @@ class Checkpointer:
 
     def save_config(self, cfg_obj: Any) -> None:
         """Serialize the run config next to checkpoints (SURVEY.md §5.6
-        reproducibility rule). Chief-only host file."""
+        reproducibility rule). Chief-only host file, written
+        tmp+fsync+rename like every other durable artifact: the config
+        is what makes a checkpoint tree reproducible, and a crash
+        mid-write must not leave a truncated config.json that parses
+        as far as it goes."""
         if cluster.is_chief():
             path = os.path.join(
                 os.path.abspath(os.path.expanduser(self.cfg.directory)),
                 "config.json",
             )
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            with open(path, "w") as f:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
                 f.write(config_lib.to_json(cfg_obj))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
 
     def wait(self) -> None:
         self.manager.wait_until_finished()
@@ -485,7 +493,10 @@ class Checkpointer:
             dst = os.path.join(base, f"{step}-{n}")
         os.rename(src, dst)
         try:
-            with open(os.path.join(dst, "QUARANTINE"), "w") as f:
+            # reviewed: the RENAME above is the quarantine; this note is
+            # best-effort human-readable context, and a torn/missing note
+            # changes no recovery decision
+            with open(os.path.join(dst, "QUARANTINE"), "w") as f:  # dtflint: disable=atomic-durable-write
                 f.write(reason + "\n")
         except OSError:  # the reason note is best-effort
             logger.exception("writing QUARANTINE note under %s failed", dst)

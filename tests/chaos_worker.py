@@ -197,9 +197,12 @@ def _supervised(args, mesh, model, tx) -> int:
         on_restart=[plan.restart_hook(args.workdir)],
         sleep=lambda s: None,
     )
-    t_run0 = time.monotonic()
+    # reviewed: measuring REAL elapsed wall time is this oracle's job —
+    # wall_s is the reference the goodput ledger is checked against, not
+    # a trajectory input (params stay bit-identical regardless)
+    t_run0 = time.monotonic()  # dtflint: disable=wall-clock-in-seam
     state = sup.run()
-    wall_s = time.monotonic() - t_run0
+    wall_s = time.monotonic() - t_run0  # dtflint: disable=wall-clock-in-seam
     leaves = [np.asarray(x) for x in
               jax.tree.leaves(jax.device_get(state.params))]
     finite = all(np.isfinite(x).all() for x in leaves)

@@ -9,6 +9,7 @@ fleet finishes with params bit-identical to an uninterrupted run."""
 
 import importlib.util
 import os
+import random
 import subprocess
 import sys
 
@@ -191,7 +192,10 @@ def _fake_ckpt_step(ckpt_dir, step, nbytes=64, manifest=True):
     os.makedirs(d, exist_ok=True)
     shard = os.path.join(d, "shard.bin")
     with open(shard, "wb") as f:
-        f.write(os.urandom(nbytes))
+        # seeded: shard content is arbitrary but must be reproducible —
+        # this helper fabricates the evidence the common-ceiling logic
+        # verifies, and a replay oracle may not consume OS entropy
+        f.write(random.Random(1000 + step).randbytes(nbytes))
     if manifest:
         payload = (
             '{"step": %d, "files": [{"path": "shard.bin", "bytes": %d}]}'

@@ -40,24 +40,28 @@ def test_every_rule_ships_all_three_fixtures():
 def test_positive_fixture_fires_at_marked_line(rule):
     src = fixtures.POSITIVE[rule]
     want_line = fixtures.expected_line(src)
-    found = lint_sources({f"pos_{rule}.py": src})
+    path = fixtures.fixture_path(rule, "positive")
+    found = lint_sources({path: src})
     assert found, f"{rule}: positive fixture produced nothing"
     assert all(f.rule == rule for f in found), found
     assert any(f.line == want_line for f in found), (
         f"{rule}: fired at {[f.line for f in found]}, want {want_line}")
     # findings carry the path they were given (file:line anchoring)
-    assert all(f.path == f"pos_{rule}.py" for f in found)
+    assert all(f.path == path for f in found)
 
 
 @pytest.mark.parametrize("rule", ALL_RULES)
 def test_negative_fixture_is_clean(rule):
-    found = lint_sources({"neg.py": fixtures.NEGATIVE[rule]})
+    found = lint_sources(
+        {fixtures.fixture_path(rule, "negative"): fixtures.NEGATIVE[rule]})
     assert found == [], [f.format() for f in found]
 
 
 @pytest.mark.parametrize("rule", ALL_RULES)
 def test_suppression_comment_silences(rule):
-    found = lint_sources({"sup.py": fixtures.SUPPRESSED[rule]})
+    found = lint_sources(
+        {fixtures.fixture_path(rule, "suppressed"):
+         fixtures.SUPPRESSED[rule]})
     assert found == [], [f.format() for f in found]
 
 
@@ -314,6 +318,395 @@ def test_donation_taint_never_crosses_scope_boundaries():
     assert found[0].line == 12  # the inner print, once
 
 
+# ---- the v2 cross-module engine (analysis/callgraph.py) ----------------
+
+
+HELPER_MOD = """
+def helper(x):
+    return x.mean().item()
+"""
+
+STEP_MOD = """
+import jax
+
+from helper_mod import helper
+
+
+@jax.jit
+def decode(tokens):
+    return helper(tokens)
+"""
+
+
+def test_cross_module_reachability_v1_provably_missed():
+    """A step fn in one module calling a host-syncing helper in
+    another: the helper module ALONE is clean (nothing jit-roots it —
+    exactly the v1 per-module blind spot), but linted together the
+    finding lands in the helper's file."""
+    alone = lint_sources({"helper_mod.py": HELPER_MOD},
+                         rules=["host-sync-in-step"])
+    assert alone == [], [f.format() for f in alone]
+
+    both = lint_sources(
+        {"helper_mod.py": HELPER_MOD, "step_mod.py": STEP_MOD},
+        rules=["host-sync-in-step"])
+    assert len(both) == 1, [f.format() for f in both]
+    assert both[0].path == "helper_mod.py"
+    assert ".item()" in both[0].message
+    # the finding explains WHERE jit-ness came from
+    assert "step_mod" in both[0].message
+
+
+def test_cross_module_jit_wrap_and_partial():
+    # jax.jit(partial(fn, model)) in one module roots fn in another,
+    # through a module alias — the serve/decode.py factory shape
+    found = lint_sources({
+        "kernels.py": """
+import numpy as np
+
+
+def prefill_impl(model, params, tokens):
+    return np.asarray(tokens)
+""",
+        "factory.py": """
+import jax
+from functools import partial
+
+import kernels
+
+
+def make(model):
+    return jax.jit(partial(kernels.prefill_impl, model),
+                   donate_argnums=(1,))
+""",
+    }, rules=["host-sync-in-step"])
+    assert len(found) == 1 and found[0].path == "kernels.py"
+    assert "asarray" in found[0].message
+
+
+def test_cross_module_donation_via_import():
+    # the donating binding lives in another module; the import carries
+    # its donate_argnums with it
+    srcs = {
+        "steplib.py": """
+import jax
+
+
+def _step(state, batch):
+    return state
+
+
+jitted_step = jax.jit(_step, donate_argnums=(0,))
+""",
+        "driver.py": """
+from steplib import jitted_step
+
+
+def run_once(state, batch):
+    new_state = jitted_step(state, batch)
+    print(state.params)
+    return new_state
+""",
+    }
+    found = lint_sources(srcs, rules=["donation-after-use"])
+    assert len(found) == 1 and found[0].path == "driver.py"
+    assert "'state'" in found[0].message
+    # module-alias call form resolves too
+    srcs["driver.py"] = """
+import steplib
+
+
+def run_once(state, batch):
+    new_state = steplib.jitted_step(state, batch)
+    print(state.params)
+    return new_state
+"""
+    found = lint_sources(srcs, rules=["donation-after-use"])
+    assert len(found) == 1 and found[0].path == "driver.py"
+
+
+def test_cross_module_relative_imports_resolve_in_package():
+    # the real package layout: a step helper under the package root,
+    # reached through `from ..ops import helpers`
+    found = lint_sources({
+        "distributed_tensorflow_tpu/ops/helpers.py": """
+def fetch_scalar(x):
+    return float(x.sum())
+""",
+        "distributed_tensorflow_tpu/serve/dec.py": """
+import jax
+
+from ..ops import helpers
+
+
+def decode_step(cache, tokens):
+    return helpers.fetch_scalar(tokens)
+""",
+    }, rules=["host-sync-in-step"])
+    assert len(found) == 1
+    assert found[0].path == "distributed_tensorflow_tpu/ops/helpers.py"
+
+
+def test_step_name_contract_still_roots_without_jit():
+    # the v1 naming-convention behavior survives the engine swap
+    found = lint_snippet(
+        """
+        import numpy as onp
+
+        def train_step(state, batch):
+            host = onp.asarray(batch["x"])
+            return state, {"x": host}
+        """,
+        rules=["host-sync-in-step"],
+    )
+    assert len(found) == 1 and "asarray" in found[0].message
+
+
+# ---- wall-clock-in-seam ------------------------------------------------
+
+
+def test_wall_clock_fires_only_in_seams():
+    src = """
+    import time
+
+    def build(index):
+        return {"t": time.monotonic()}
+    """
+    seam = lint_sources(
+        {"distributed_tensorflow_tpu/data/records2.py":
+         textwrap.dedent(src)}, rules=["wall-clock-in-seam"])
+    assert len(seam) == 1 and "wall clock" in seam[0].message
+    # identical code outside the seams: telemetry's whole job
+    assert lint_sources(
+        {"distributed_tensorflow_tpu/obs/clocky.py": textwrap.dedent(src)},
+        rules=["wall-clock-in-seam"]) == []
+
+
+def test_wall_clock_seams_are_segment_anchored():
+    src = """
+    import os
+    import time
+
+    def f():
+        return time.time(), os.urandom(4)
+    """
+    # package-relative invocation (cwd inside the package) still a seam
+    rel = lint_sources({"resilience/x.py": textwrap.dedent(src)},
+                       rules=["wall-clock-in-seam"])
+    assert len(rel) == 2, [f.format() for f in rel]
+    # look-alike segments are NOT seams: neither strict nor scaffolding
+    for path in ("myresilience/x.py", "latests/x.py", "testdata/x.py"):
+        found = lint_sources({path: textwrap.dedent(src)},
+                             rules=["wall-clock-in-seam"])
+        assert found == [], (path, [f.format() for f in found])
+
+
+def test_wall_clock_seeded_rng_and_injectable_default_clean():
+    found = lint_sources({
+        "distributed_tensorflow_tpu/data/aug2.py": """
+import time
+
+import numpy as np
+
+
+def make(seed, index, clock=time.monotonic):
+    rng = np.random.RandomState(seed + index)
+    r2 = np.random.default_rng(seed)
+    return rng.uniform(size=(2,)), r2, clock()
+""",
+    }, rules=["wall-clock-in-seam"])
+    assert found == [], [f.format() for f in found]
+
+
+def test_wall_clock_unseeded_randomness_and_aliases():
+    found = lint_sources({
+        "distributed_tensorflow_tpu/resilience/jitterbug.py": """
+import random
+from time import monotonic as now
+
+import numpy as np
+
+
+def schedule():
+    a = random.random()
+    b = np.random.default_rng()
+    c = now()
+    return a, b, c
+""",
+    }, rules=["wall-clock-in-seam"])
+    msgs = [f.message for f in found]
+    assert len(found) == 3, msgs
+    assert any("random.random" in m for m in msgs)
+    assert any("default_rng() without a seed" in m for m in msgs)
+    assert any("wall clock" in m for m in msgs)
+
+
+def test_wall_clock_test_scaffolding_tier_relaxed():
+    # tests/: deadlines are process control (clean); entropy is not
+    src = """
+    import os
+    import time
+
+    def wait_and_corrupt(path):
+        deadline = time.monotonic() + 5
+        return os.urandom(8), deadline
+    """
+    found = lint_sources({"tests/test_fake.py": textwrap.dedent(src)},
+                         rules=["wall-clock-in-seam"])
+    assert len(found) == 1 and "urandom" in found[0].message
+    # chaos_worker is the bit-identity oracle: full strictness
+    strict = lint_sources({"tests/chaos_worker.py": textwrap.dedent(src)},
+                          rules=["wall-clock-in-seam"])
+    assert len(strict) == 2, [f.format() for f in strict]
+
+
+# ---- atomic-durable-write ----------------------------------------------
+
+
+def test_durable_write_keyword_trigger_and_atomic_shape():
+    bare = """
+    import json
+    import os
+
+    def dump_quarantine(directory, doc):
+        path = os.path.join(directory, "quarantine.json")
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    """
+    found = lint_sources({"anywhere.py": textwrap.dedent(bare)},
+                         rules=["atomic-durable-write"])
+    assert len(found) == 1 and "tmp" in found[0].message
+    atomic = """
+    import json
+    import os
+
+    def dump_quarantine(directory, doc):
+        path = os.path.join(directory, "quarantine.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    """
+    assert lint_sources({"anywhere.py": textwrap.dedent(atomic)},
+                        rules=["atomic-durable-write"]) == []
+
+
+def test_durable_write_module_trigger_and_append_exempt():
+    # in a durable-state module EVERY truncating write is in scope,
+    # no keyword needed — but append-mode streams stay exempt
+    src = """
+    def note(path, text):
+        with open(path, "w") as f:
+            f.write(text)
+
+    def stream(path, text):
+        with open(path, "a") as f:
+            f.write(text)
+    """
+    found = lint_sources(
+        {"distributed_tensorflow_tpu/resilience/fleet.py":
+         textwrap.dedent(src)}, rules=["atomic-durable-write"])
+    assert len(found) == 1 and found[0].line == 3
+    # same code in a neutral module without durable keywords: clean
+    assert lint_sources({"distributed_tensorflow_tpu/utils/scratch.py":
+                         textwrap.dedent(src)},
+                        rules=["atomic-durable-write"]) == []
+
+
+def test_durable_write_judged_per_write_not_per_function():
+    # a bare in-place manifest write must NOT be blessed by a correct
+    # atomic write of a DIFFERENT file in the same function
+    src = """
+    import json
+    import os
+
+    def save_checkpoint_meta(d, manifest, extra):
+        with open(os.path.join(d, "MANIFEST.json"), "w") as f:
+            json.dump(manifest, f)
+        tmp = os.path.join(d, "extra.json") + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(extra, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(d, "extra.json"))
+    """
+    found = lint_sources({"anywhere.py": textwrap.dedent(src)},
+                         rules=["atomic-durable-write"])
+    assert len(found) == 1 and found[0].line == 6, (
+        [f.format() for f in found])
+
+
+# ---- metric-naming -----------------------------------------------------
+
+
+def test_metric_naming_counter_and_histogram_shapes():
+    found = lint_snippet(
+        """
+        def setup(r):
+            a = r.counter("serve_retries", "retries")
+            b = r.histogram("serve_wait", "queue wait in seconds")
+            c = r.gauge("serve_depth_total", "queue depth")
+            d = r.histogram("serve_lat_ms", "latency")
+        """,
+        rules=["metric-naming"],
+    )
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 4, msgs
+    assert "_total" in msgs and "_seconds" in msgs and "sub-second" in msgs
+
+
+def test_metric_naming_subsecond_token_not_just_suffix():
+    # "ms" hidden before the counter suffix must still be flagged
+    found = lint_snippet(
+        """
+        def setup(r):
+            a = r.counter("serve_lat_ms_total", "latency")
+        """,
+        rules=["metric-naming"],
+    )
+    assert len(found) == 1 and "sub-second" in found[0].message
+    # ...but ordinary words containing the letters are fine
+    clean = lint_snippet(
+        """
+        def setup(r):
+            a = r.counter("serve_status_checks_total", "status probes")
+        """,
+        rules=["metric-naming"],
+    )
+    assert clean == [], [f.format() for f in clean]
+
+
+def test_metric_naming_resolves_constants_and_accepts_clean():
+    found = lint_snippet(
+        """
+        STEPS_TOTAL = "train_widget_steps_total"
+
+        def setup(r):
+            a = r.counter(STEPS_TOTAL, "steps")
+            b = r.histogram("widget_step_seconds", "wall seconds per step")
+            c = r.gauge("widget_occupancy", "slots in use")
+        """,
+        rules=["metric-naming"],
+    )
+    assert found == [], [f.format() for f in found]
+
+
+def test_metric_naming_kind_must_match_docs_table():
+    # goodput_fraction is documented as a gauge; registering it as a
+    # counter is vocabulary drift (and a shape violation to boot)
+    found = lint_snippet(
+        """
+        def setup(r):
+            g = r.counter("goodput_fraction", "productive share")
+        """,
+        rules=["metric-naming"],
+    )
+    assert any("documents it as a gauge" in f.message for f in found), (
+        [f.format() for f in found])
+
+
 def test_suppression_markers_inside_strings_are_inert():
     # a disable-file marker in a DOCSTRING must not disarm the rule —
     # only real comment tokens count (the silent-rot hole otherwise)
@@ -369,12 +762,15 @@ def _run_cli(*args, cwd=REPO):
 
 def test_cli_flags_injected_fixture_with_rule_and_location(tmp_path):
     """The acceptance contract: inject any shipped positive fixture into
-    a linted tree → non-zero exit naming the rule id and file:line."""
+    a linted tree → non-zero exit naming the rule id and file:line.
+    Seam rules inject at their seam-shaped relative path
+    (fixtures.injection_path)."""
     pkg = tmp_path / "victim"
     pkg.mkdir()
     (pkg / "clean.py").write_text("x = 1\n")
     for rule, src in fixtures.POSITIVE.items():
-        bad = pkg / f"bad_{rule.replace('-', '_')}.py"
+        bad = pkg / fixtures.injection_path(rule)
+        bad.parent.mkdir(parents=True, exist_ok=True)
         bad.write_text(src)
         want_line = fixtures.expected_line(src)
         proc = _run_cli("--strict", str(pkg))
@@ -407,6 +803,45 @@ def test_cli_self_check_green():
     proc = _run_cli("--self-check")
     assert proc.returncode == 0, proc.stderr
     assert "self-check OK" in proc.stderr
+
+
+def test_cli_changed_only_reports_only_the_diff(tmp_path):
+    """--changed-only lints the whole tree for cross-module context but
+    reports (and exits on) only files changed vs --base — a committed
+    violation stays out of the report, an uncommitted one fails it."""
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True,
+                       env={**os.environ,
+                            "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+
+    git("init", "-q")
+    committed_bad = tmp_path / "old_violation.py"
+    committed_bad.write_text(fixtures.POSITIVE["exception-hygiene"])
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+
+    # nothing changed: fast-path success, committed violation not relinted
+    proc = _run_cli("--changed-only", "--strict", ".", cwd=tmp_path)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no python/docs files changed" in proc.stderr
+
+    # an uncommitted (untracked) violation IS reported; the committed
+    # one still is not
+    new_bad = tmp_path / "new_violation.py"
+    new_bad.write_text(fixtures.POSITIVE["lock-discipline"])
+    proc = _run_cli("--changed-only", "--strict", ".", cwd=tmp_path)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "new_violation.py" in proc.stdout
+    assert "old_violation.py" not in proc.stdout
+
+    # a bogus base ref is a usage error, not a silent full lint
+    proc = _run_cli("--changed-only", "--base", "no-such-ref", ".",
+                    cwd=tmp_path)
+    assert proc.returncode == 2
 
 
 def test_shipped_tree_is_clean():

@@ -229,7 +229,9 @@ def fleet_round() -> None:
             env = dict(os.environ)
             env.pop("XLA_FLAGS", None)
             env["JAX_PLATFORMS"] = "cpu"
-            log = open(os.path.join(
+            # reviewed: a worker's stdout log stream, not durable state —
+            # it feeds debugging, never a recovery decision
+            log = open(os.path.join(  # dtflint: disable=atomic-durable-write
                 fleet_dir, f"worker{i}-inc{incarnation}.log"), "w")
             try:
                 return subprocess.Popen(args, stdout=log,

@@ -6,11 +6,17 @@
 #   2. tools/obs_check.py      — telemetry smoke: registry → Prometheus
 #      exposition render → format lint → JSONL round-trip (ISSUE 2)
 #   3. tools/dtf_lint.py       — framework-aware static analysis
-#      (ISSUE 7): --self-check first (every rule must still fire on its
-#      shipped fixtures, so the gate cannot rot silently), then the
-#      --strict tree lint (host-sync-in-step, donation-after-use,
-#      lock-discipline, closed-vocab, exception-hygiene must all be
-#      clean over the package, tools, and bench.py)
+#      (ISSUE 7, v2 engine ISSUE 10): --self-check first (every rule —
+#      a rule with NO fixture is itself a self-check failure — must
+#      still fire on its shipped fixtures, so the gate cannot rot
+#      silently), then the --strict tree lint (host-sync-in-step and
+#      donation-after-use on the cross-module call graph, plus
+#      lock-discipline, closed-vocab, exception-hygiene,
+#      wall-clock-in-seam, atomic-durable-write, metric-naming must
+#      all be clean over the package, tools, and bench.py), then the
+#      determinism rule alone over tests/ — the chaos/replay oracles
+#      must not consume ambient entropy either (relaxed set: pure test
+#      scaffolding is exempt from everything but determinism)
 #   4. tools/chaos_smoke.py    — resilience smoke: scheduler
 #      timeout/cancel/backpressure invariants + one SIGTERM →
 #      coordinated-save → resume subprocess round (ISSUE 3) + one
@@ -39,6 +45,8 @@ env JAX_PLATFORMS=cpu python tools/obs_check.py >/dev/null
 env JAX_PLATFORMS=cpu python tools/dtf_lint.py --self-check
 env JAX_PLATFORMS=cpu python tools/dtf_lint.py --strict \
   distributed_tensorflow_tpu tools bench.py
+env JAX_PLATFORMS=cpu python tools/dtf_lint.py --strict \
+  --rules wall-clock-in-seam tests
 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 env JAX_PLATFORMS=cpu python tools/postmortem.py \
   "${DTF_CHAOS_POSTMORTEM:-artifacts/chaos_postmortem.jsonl}" --quiet \

@@ -6,17 +6,28 @@ hand (rule catalog + pre-fix examples: docs/static-analysis.md):
 
     host-sync-in-step    no float()/bool()/.item()/np.asarray()/
                          device_get on traced values in jit-reachable
-                         step/decode functions
+                         step/decode functions (reachability follows
+                         calls ACROSS modules via the v2 call graph)
     donation-after-use   never read a pytree a donate_argnums call
-                         consumed
+                         consumed (donating bindings resolve across
+                         imports)
     lock-discipline      lock-guarded attributes only under the lock
     closed-vocab         flightrec kinds / waste causes / metric names
                          / the single ×3 MFU-multiplier site
     exception-hygiene    no bare except; no swallowed exceptions in the
                          retry/supervisor/checkpoint seams
+    wall-clock-in-seam   no time.time()/unseeded randomness/os.urandom
+                         in the deterministic seams (data/,
+                         train/step.py, resilience/, test oracles)
+    atomic-durable-write durable state (checkpoint/manifest/heartbeat/
+                         quarantine paths) is written tmp+fsync+
+                         os.replace, never truncated in place
+    metric-naming        counters end _total, second-valued histograms
+                         end _seconds, kinds match the docs tables
 
 Usage:
     tools/dtf_lint.py [--strict] [--json] [--rules a,b] PATH [PATH...]
+    tools/dtf_lint.py --changed-only [--base REF] [--strict] PATH...
     tools/dtf_lint.py --list-rules
     tools/dtf_lint.py --self-check
 
@@ -28,6 +39,16 @@ proves every rule still fires on its shipped positive fixture, stays
 quiet on the negative and suppressed ones, and — run before the tree
 lint in tools/ci_fast.sh — keeps the gate from rotting silently.
 
+``--changed-only`` reports findings only for .py files that differ
+from ``--base`` (default HEAD: staged + unstaged + untracked). The
+whole given tree is still PARSED — the v2 engine's cross-module
+reachability and donator resolution need project scope — but output,
+and the exit code, cover just the changed files, PLUS any findings
+anchored outside the python set (the docs-table shape checks): a
+docs-only edit re-lints, and docs drift is never filtered away. When
+neither python nor docs changed the lint is skipped outright. The
+full ``--strict`` tree lint in CI remains the authoritative gate.
+
 Suppressions: ``# dtflint: disable=<rule>[,<rule>]`` on the flagged
 line or the line above; ``# dtflint: disable-file=<rule>`` anywhere in
 the file.
@@ -37,9 +58,46 @@ import argparse
 import importlib.util
 import json
 import os
+import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _changed_files(base: str) -> set[str] | None:
+    """Real paths of .py AND .md files that differ from ``base`` in the
+    git repository enclosing the CURRENT directory (committed diff +
+    working tree + untracked). Markdown counts because project-scope
+    rules anchor findings in the docs tables (metric-naming's
+    docs-side shape checks) — a docs-only change must not
+    short-circuit the lint. None on git failure (caller reports a
+    usage error)."""
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         capture_output=True, text=True)
+    if top.returncode != 0:
+        print(f"dtf_lint.py: error: not inside a git repository: "
+              f"{top.stderr.strip()}", file=sys.stderr)
+        return None
+    root = top.stdout.strip()
+    changed: set[str] = set()
+    cmds = (
+        ["git", "diff", "--name-only", "--diff-filter=d", base, "--",
+         "*.py", "*.md"],
+        ["git", "ls-files", "--others", "--exclude-standard", "--",
+         "*.py", "*.md"],
+    )
+    for cmd in cmds:
+        proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                              text=True)
+        if proc.returncode != 0:
+            print(f"dtf_lint.py: error: {' '.join(cmd)} failed: "
+                  f"{proc.stderr.strip()}", file=sys.stderr)
+            return None
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line:
+                changed.add(os.path.realpath(os.path.join(root, line)))
+    return changed
 
 
 def _load_analysis():
@@ -75,6 +133,13 @@ def main(argv=None) -> int:
                     help="machine-readable findings on stdout")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset of rules to run")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report findings only for files changed vs "
+                         "--base (tree still parsed for cross-module "
+                         "context)")
+    ap.add_argument("--base", default="HEAD",
+                    help="git ref --changed-only diffs against "
+                         "(default: HEAD)")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--self-check", action="store_true",
                     help="verify every rule fires on its shipped fixtures")
@@ -108,6 +173,16 @@ def main(argv=None) -> int:
     if args.rules:
         rules = [r.strip() for r in args.rules.split(",") if r.strip()]
 
+    changed: set[str] | None = None
+    if args.changed_only:
+        changed = _changed_files(args.base)
+        if changed is None:
+            return 2
+        if not changed:
+            print(f"dtflint: no python/docs files changed vs "
+                  f"{args.base}; nothing to lint", file=sys.stderr)
+            return 0
+
     parse_errors: list[str] = []
 
     def on_parse_error(path, exc):
@@ -119,6 +194,18 @@ def main(argv=None) -> int:
     except (FileNotFoundError, KeyError) as e:
         print(f"dtf_lint.py: error: {e}", file=sys.stderr)
         return 2
+
+    if changed is not None:
+        # findings anchored OUTSIDE the linted python set (the docs
+        # tables) always pass through — filtering them would approve
+        # exactly the vocabulary drift the docs-side checks block
+        findings = [f for f in findings
+                    if not f.path.endswith(".py")
+                    or os.path.realpath(f.path) in changed]
+        parse_errors = [
+            e for e in parse_errors
+            if os.path.realpath(e.split(":", 1)[0]) in changed
+        ]
 
     for err in parse_errors:
         print(err, file=sys.stderr)

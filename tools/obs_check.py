@@ -148,7 +148,10 @@ def _check_flightrec() -> list[str]:
         bad = os.path.join(d, "bad.jsonl")
         with open(path) as f_in:
             lines = f_in.read().splitlines()
-        with open(bad, "w") as f_out:
+        # reviewed: scratch corpus for the validator's must-fail probes,
+        # torn-on-crash is irrelevant (the file exists only inside this
+        # check's tempdir)
+        with open(bad, "w") as f_out:  # dtflint: disable=atomic-durable-write
             f_out.write(lines[0] + "\n")
             f_out.write('{"t": 5.0, "kind": "meteor_strike"}\n')
             f_out.write('{"t": 4.0, "kind": "train_start"}\n')
