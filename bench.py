@@ -311,7 +311,11 @@ def main() -> None:
     log(f"steps/sec={steps_per_sec:.3f} images/sec/chip={images_per_sec_per_chip:.1f} "
         f"MFU={mfu:.3f} (peak={peak:.3g})")
 
-    print(json.dumps({
+    # provenance block (obs/scaling.py): the shared stamp that keeps a
+    # CPU-fallback row from ever reading as a TPU number (BENCH_r02-r05)
+    from distributed_tensorflow_tpu.obs import scaling
+
+    print(json.dumps(scaling.stamp_provenance({
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(images_per_sec_per_chip, 2),
         "unit": "images/sec/chip",
@@ -337,7 +341,7 @@ def main() -> None:
         **({"host_decode_images_per_sec": round(host_decode_rate, 1),
             "host_cores": os.cpu_count()}
            if fed_data.startswith("jpeg") else {}),
-    }))
+    }, mesh)))
 
 
 if __name__ == "__main__":

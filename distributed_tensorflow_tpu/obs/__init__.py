@@ -41,3 +41,4 @@ from .flightrec import (  # noqa: F401
     validate_dump,
 )
 from . import goodput  # noqa: F401
+from . import scaling  # noqa: F401

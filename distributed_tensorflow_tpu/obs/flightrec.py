@@ -63,6 +63,9 @@ EVENT_KINDS = (
     "train_exception",      # unhandled step exception      {step, error, etype}
     "emergency_checkpoint", # best-effort crash save        {step, saved}
     "watchdog_stall",       # no step within the budget     {overdue_s, budget_s}
+    # distributed eval (train/evaluation.py)
+    "eval_start",           # sharded eval pass begins      {step, shards}
+    "eval_end",             # sharded eval pass done        {step, batches}
     # checkpoint lifecycle (train/checkpoint.py)
     "ckpt_save",            # checkpoint written            {step, trigger}
     "ckpt_restore",         # state restored                {step, fallback}

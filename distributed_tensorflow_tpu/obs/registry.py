@@ -341,6 +341,74 @@ class Registry:
                 out[key] = {"kind": m.kind, "value": m.value}
         return out
 
+    def delta(self, baseline: Mapping) -> dict:
+        """What changed since ``baseline`` (a dict from ``snapshot()``),
+        as a snapshot-shaped dict.
+
+        The per-interval isolation primitive for sweep/bench harnesses:
+        take ``snapshot()`` before an interval, ``delta(snap)`` after,
+        and read only that interval's counters/histogram observations —
+        WITHOUT a mid-run ``reset()``, which would break the registry's
+        merge-not-reset invariant for every concurrent consumer (the
+        supervised-restart ledger, a live scrape endpoint).
+
+        Semantics per kind: counters and histograms report the
+        DIFFERENCE (counts/sums are mergeable sufficient statistics, so
+        subtraction is exact); gauges report their CURRENT value — a
+        point-in-time reading has no meaningful diff — and are included
+        only when the value differs from the baseline's (a rewrite of
+        the same value is indistinguishable and omitted). Metrics absent
+        from the baseline diff against zero.
+
+        Reads the live table under the registry lock — the same
+        consistent-cut guarantee as ``snapshot()``, so a concurrent
+        ``merge`` cannot tear a histogram's counts/sum apart. Raises
+        ``ValueError`` when the baseline is ahead of the live registry
+        (a counter went down / histogram shrank): that means it came
+        from a different registry or a ``reset()`` intervened, and a
+        silently-negative delta would corrupt every derived rate."""
+        with self._lock:
+            current = self._snapshot_unlocked()
+        out: dict = {}
+        for key, now in current.items():
+            old = baseline.get(key)
+            if old is not None and old.get("kind") != now["kind"]:
+                raise ValueError(
+                    f"delta baseline kind mismatch for {key!r}: "
+                    f"{old.get('kind')} vs {now['kind']}")
+            if now["kind"] == "histogram":
+                old_counts = old["counts"] if old else [0] * len(now["counts"])
+                if len(old_counts) != len(now["counts"]):
+                    raise ValueError(
+                        f"delta baseline bucket mismatch for {key!r}")
+                counts = [a - b for a, b in zip(now["counts"], old_counts)]
+                if any(c < 0 for c in counts):
+                    raise ValueError(
+                        f"histogram {key!r} shrank since the baseline — "
+                        f"not a baseline of this registry (or reset() "
+                        f"intervened)")
+                if any(counts):
+                    out[key] = {
+                        "kind": "histogram",
+                        "sum": now["sum"] - (old["sum"] if old else 0.0),
+                        "count": sum(counts),
+                        "bounds": list(now["bounds"]),
+                        "counts": counts,
+                    }
+            elif now["kind"] == "counter":
+                diff = now["value"] - (old["value"] if old else 0.0)
+                if diff < 0:
+                    raise ValueError(
+                        f"counter {key!r} went down since the baseline — "
+                        f"not a baseline of this registry (or reset() "
+                        f"intervened)")
+                if diff != 0:
+                    out[key] = {"kind": "counter", "value": diff}
+            else:  # gauge: point-in-time reading, no meaningful diff
+                if old is None or old["value"] != now["value"]:
+                    out[key] = {"kind": now["kind"], "value": now["value"]}
+        return out
+
 
 _default = Registry()
 

@@ -25,6 +25,11 @@ from .optimizers import (  # noqa: F401
 )
 from .loop import Trainer  # noqa: F401
 from . import callbacks  # noqa: F401
+from .evaluation import (  # noqa: F401
+    ShardedEvaluator,
+    derive_metrics,
+    make_sharded_eval_step,
+)
 from .checkpoint import (  # noqa: F401
     CheckpointConfig,
     Checkpointer,

@@ -67,6 +67,15 @@ class HeartbeatCallback(Callback):
     def on_train_start(self, trainer):
         self.writer.beat(phase="train")
 
+    def note_pause(self, seconds: float) -> None:
+        """A sanctioned off-the-train-path pause (mid-train distributed
+        eval) just ended: beat NOW so the silent window the monitor saw
+        stops at the pause boundary instead of stretching into the next
+        step. A pause longer than the fleet's stall budget still needs
+        that budget sized for it — same rule as compile/restore silent
+        windows (docs/resilience.md)."""
+        self.writer.beat()
+
     def on_step_end(self, trainer, step, metrics):
         if step % self.every_n == 0:
             self.writer.beat(step=step)
@@ -113,6 +122,13 @@ class MetricsLogger(Callback):
     def on_train_start(self, trainer):
         self._t0 = None
         self.last, self.last_step = {}, None
+
+    def note_pause(self, seconds: float) -> None:
+        """Wall time spent OFF the train path between two steps (a
+        mid-train distributed eval) — shift the rate baseline forward so
+        steps/sec, examples/sec, and the derived MFU don't absorb it."""
+        if self._t0 is not None:
+            self._t0 += max(float(seconds), 0.0)
 
     def on_step_end(self, trainer, step, metrics):
         if step % self.every_n != 0:
@@ -242,6 +258,22 @@ class TelemetryCallback(Callback):
     def on_train_start(self, trainer):
         self._t_prev = None
         self._t_start = self.clock() if self.track_goodput else None
+
+    def note_pause(self, seconds: float) -> None:
+        """Wall time spent OFF the train path between two steps (a
+        mid-train distributed eval): shift the inter-step baseline
+        forward so the next ``train_step_seconds`` observation and its
+        productive-seconds booking cover only step time. Eval wall time
+        is deliberately neither productive nor wasted in the goodput
+        ledger — it buys evaluation, not training progress, and booking
+        it as either would skew ``goodput_fraction``."""
+        pause = max(float(seconds), 0.0)
+        if self._t_prev is not None:
+            self._t_prev += pause
+        elif self._t_start is not None:
+            # pause landed inside the warmup window: keep it out of the
+            # compile_warmup waste bucket too
+            self._t_start += pause
 
     def on_step_end(self, trainer, step, metrics):
         now = self.clock()
@@ -464,6 +496,18 @@ class Watchdog(Callback):
         self._thread = threading.Thread(
             target=self._watch, daemon=True, name="train-watchdog")
         self._thread.start()
+
+    def note_pause(self, seconds: float) -> None:
+        """A sanctioned pause (mid-train distributed eval) just ended:
+        re-arm the beat so the budget clock restarts at the pause
+        boundary — without this, a stall abort could fire right after a
+        long eval even though the loop is healthy. An eval LONGER than
+        the budget still flags mid-pause (the poll thread cannot know a
+        pause is sanctioned until it ends); size ``budget_s`` above the
+        expected eval wall time, the same rule as compile windows."""
+        with self._lock:
+            if self._beat is not None:
+                self._beat = self.clock()
 
     def on_step_end(self, trainer, step, metrics):
         with self._lock:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Any, Callable, Iterable
 
 import jax
@@ -23,17 +24,17 @@ from ..train import (
     CheckpointConfig,
     Checkpointer,
     OptimizerConfig,
+    ShardedEvaluator,
     StepOptions,
     Trainer,
     callbacks as cb,
+    derive_metrics,
     init_or_restore,
     init_train_state,
-    make_eval_step,
     make_optimizer,
     make_train_step,
 )
 from ..utils import config as config_lib
-from ..utils import metrics as metrics_lib
 
 logger = logging.getLogger(__name__)
 
@@ -125,7 +126,9 @@ class WorkloadParts:
     # flag set this True; the runner rejects an explicit eval_dataset the
     # workload would silently ignore (no silent eval-source degradation).
     consumed_eval_dataset: bool = False
-    _jit_eval: Callable | None = dataclasses.field(default=None, repr=False)
+    # cached ShardedEvaluator (train/evaluation.py) — built on first
+    # eval so repeated mid-train evals never retrace
+    _jit_eval: Any = dataclasses.field(default=None, repr=False)
 
 
 def _pipeline_memory_guard(cfg: RunConfig, mesh) -> None:
@@ -335,44 +338,33 @@ def _check_eval_dataset_consumed(cfg: RunConfig, parts: WorkloadParts) -> None:
             "workload that honors it (wide_deep)")
 
 
-def _run_eval(state: Any, put_batch: Callable, parts: WorkloadParts,
-              num_batches: int) -> dict:
-    """Shared eval loop: sums the eval_fn's summed metrics over the eval
-    split and derives accuracy/loss. The jitted eval step is cached on
-    parts so repeated mid-train evals don't retrace."""
+def _run_eval(state: Any, mesh, parts: WorkloadParts,
+              num_batches: int, step: int | None = None,
+              flightrec=None) -> dict:
+    """Shared eval loop — DISTRIBUTED: batches shard over the mesh's
+    batch axes and every device evaluates its chunk with the full
+    weights, with the cross-shard reduction done host-side in a fixed
+    order so the result is bit-identical to a serial evaluator
+    (train/evaluation.py has the construction). The evaluator (and its
+    jitted step) is cached on parts so repeated mid-train evals don't
+    retrace. Summed sufficient statistics — scalars AND fixed-size
+    arrays (e.g. the AUC histograms, utils/metrics.py) — merge by
+    addition; ratio metrics derive via the shared
+    ``evaluation.derive_metrics``."""
     if parts._jit_eval is None:
-        parts._jit_eval = jax.jit(make_eval_step(parts.eval_fn))
-    eval_step = parts._jit_eval
-    # Summed sufficient statistics: scalars AND fixed-size arrays (e.g.
-    # the AUC histograms, utils/metrics.py) merge by addition.
-    totals: dict[str, np.ndarray] = {}
-    import itertools
-
-    for batch in itertools.islice(parts.eval_dataset_fn(num_batches), num_batches):
-        out = eval_step(state, put_batch(batch))
-        for k, v in out.items():
-            v = np.asarray(v, np.float64)
-            totals[k] = totals.get(k, 0.0) + v
-    result = {k: float(v) for k, v in totals.items() if np.ndim(v) == 0}
-    for summed, ratio in (("correct", "accuracy"),
-                          ("top5_correct", "top5_accuracy"),
-                          ("loss_sum", "loss")):
-        if summed in result and result.get("count"):
-            result[ratio] = result[summed] / result["count"]
-    if "auc_pos_hist" in totals and "auc_neg_hist" in totals:
-        auc = metrics_lib.auc_from_histograms(
-            totals["auc_pos_hist"], totals["auc_neg_hist"]
-        )
-        # a one-class stream makes AUC undefined (NaN); omit the key
-        # rather than emit the non-JSON `NaN` literal downstream
-        if np.isfinite(auc):
-            result[parts.eval_metric_prefix + "auc"] = auc
-    return result
+        parts._jit_eval = ShardedEvaluator(parts.eval_fn, mesh,
+                                           flightrec=flightrec)
+    totals = parts._jit_eval.run(
+        state, parts.eval_dataset_fn(num_batches), num_batches, step=step)
+    return derive_metrics(totals, parts.eval_metric_prefix)
 
 
 def evaluate(trainer: Trainer, parts: WorkloadParts, num_batches: int) -> dict:
-    """Eval from live trainer state; shares the mesh and runs sharded."""
-    return _run_eval(trainer.state, trainer.put_batch, parts, num_batches)
+    """Eval from live trainer state; shares the mesh and runs sharded
+    across it (distributed eval — the train state never moves)."""
+    return _run_eval(trainer.state, trainer.mesh, parts, num_batches,
+                     step=int(trainer.state.step),
+                     flightrec=trainer.flightrec)
 
 
 def evaluate_from_checkpoint(
@@ -408,12 +400,8 @@ def evaluate_from_checkpoint(
                 f"no checkpoint found in {cfg.checkpoint.directory}"
             )
 
-        from ..parallel import sharding as sh
-
         n = num_batches if num_batches is not None else cfg.train.eval_batches
-        metrics = _run_eval(
-            state, lambda b: sh.put_host_batch(mesh, b), parts, n
-        )
+        metrics = _run_eval(state, mesh, parts, n, step=int(state.step))
         metrics["step"] = int(state.step)
         if cluster.is_chief():
             logger.info("eval from checkpoint @ step %d: %s",
@@ -424,11 +412,25 @@ def evaluate_from_checkpoint(
 
 
 class _EvalCallback(cb.Callback):
-    def __init__(self, cfg, parts):
+    """Periodic distributed eval from the step seam. The eval pass runs
+    sharded over the training mesh (no state movement, no second
+    evaluator process) and its wall time is reported to every
+    ``note_pause``-aware callback so the cadence meters —
+    ``train_step_seconds``, steps/sec, the goodput ledger — keep
+    measuring the train loop, not the eval pauses interleaved with it."""
+
+    def __init__(self, cfg, parts, clock=time.perf_counter):
         self.cfg, self.parts = cfg, parts
+        self.clock = clock
 
     def on_step_end(self, trainer, step, metrics):
         if step % self.cfg.train.eval_every == 0:
+            t0 = self.clock()
             m = evaluate(trainer, self.parts, self.cfg.train.eval_batches)
+            pause = self.clock() - t0
+            for other in trainer.callbacks:
+                note = getattr(other, "note_pause", None)
+                if note is not None:
+                    note(pause)
             if cluster.is_chief():
                 logger.info("eval @ step %d: %s", step, m)

@@ -197,6 +197,104 @@ def test_snapshot_consistent_under_concurrent_merge():
     assert dst.get("c_total").value == 1500.0
 
 
+def test_registry_delta_semantics():
+    """delta(snapshot) isolates an interval without reset():
+    counters/histograms diff, gauges report current value, unchanged
+    metrics are omitted, unseen metrics diff against zero."""
+    r = obs.Registry()
+    c = r.counter("c_total")
+    c.inc(2)
+    h = r.histogram("h_seconds", buckets=(1.0, 2.0))
+    h.observe(0.5)
+    g = r.gauge("g")
+    g.set(1.0)
+    r.counter("quiet_total").inc(7)  # untouched after the baseline
+
+    snap = r.snapshot()
+    c.inc(3)
+    h.observe(1.5)
+    h.observe(10.0)
+    g.set(4.0)
+    r.counter("born_later_total", x="1").inc()
+
+    d = r.delta(snap)
+    assert d["c_total"] == {"kind": "counter", "value": 3.0}
+    assert d["h_seconds"]["counts"] == [0, 1, 1]
+    assert d["h_seconds"]["count"] == 2
+    assert d["h_seconds"]["sum"] == pytest.approx(11.5)
+    assert d["g"] == {"kind": "gauge", "value": 4.0}
+    assert d["born_later_total{x=1}"] == {"kind": "counter", "value": 1.0}
+    assert "quiet_total" not in d
+    # a quiet interval yields an empty delta
+    assert r.delta(r.snapshot()) == {}
+    # the live registry is untouched: no reset happened
+    assert r.get("c_total").value == 5.0
+    assert r.get("quiet_total").value == 7.0
+
+
+def test_registry_delta_rejects_unrelated_baseline():
+    """A baseline the live registry is BEHIND (reset() intervened, or it
+    came from another registry) must raise, not emit negative rates."""
+    r = obs.Registry()
+    r.counter("c_total").inc(5)
+    snap = r.snapshot()
+    r.reset()
+    r.counter("c_total").inc(1)
+    with pytest.raises(ValueError, match="went down"):
+        r.delta(snap)
+
+    r2 = obs.Registry()
+    r2.histogram("h_seconds", buckets=(1.0, 2.0)).observe(0.5)
+    snap2 = r2.snapshot()
+    r2.reset()
+    with pytest.raises(ValueError, match="shrank"):
+        r2.delta(snap2)
+
+    r3 = obs.Registry()
+    r3.gauge("x")
+    with pytest.raises(ValueError, match="kind mismatch"):
+        r3.delta({"x": {"kind": "counter", "value": 0.0}})
+
+
+def test_delta_consistent_under_concurrent_merge():
+    """Companion to the snapshot-tear regression above: ``delta`` reads
+    the live table under the registry lock, so a delta taken while
+    merges are in flight must also be a consistent cut — every source
+    observation is 1.0, so consistency is exactly ``sum == count`` in
+    every delta the reader computes."""
+    import threading
+
+    src = obs.Registry()
+    wide = tuple(float(x) for x in np.linspace(1e-3, 1e3, 100_000))
+    hs = src.histogram("h_seconds", buckets=wide)
+    hs.observe(1.0)
+
+    dst = obs.Registry()
+    for _ in range(100):
+        dst.merge(src)
+    baseline = dst.snapshot()
+
+    stop = threading.Event()
+    torn: list[dict] = []
+
+    def reader():
+        while not stop.is_set():
+            d = dst.delta(baseline)
+            h = d.get("h_seconds")
+            if h is not None and h["sum"] != float(h["count"]):
+                torn.append(h)
+                return
+
+    t = threading.Thread(target=reader)
+    t.start()
+    for _ in range(1000):
+        dst.merge(src)
+    stop.set()
+    t.join()
+    assert not torn, f"torn histogram delta: {torn[:1]}"
+    assert dst.delta(baseline)["h_seconds"]["count"] == 1000
+
+
 def test_registry_reset_keeps_handles():
     r = obs.Registry()
     c, h, g = r.counter("c_total"), r.histogram("h"), r.gauge("g")

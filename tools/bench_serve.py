@@ -98,7 +98,11 @@ def main(argv=None):
     ttft_ms = pct("serve_ttft_seconds")
     tpot_ms = pct("serve_tpot_seconds")
     qwait_ms = pct("serve_queue_wait_seconds", (0.5,))
-    result = {
+    from distributed_tensorflow_tpu.obs import scaling
+
+    # provenance block (obs/scaling.py): every serve-bench row carries
+    # its backend context, same stamp as bench.py / tools/sweep.py
+    result = scaling.stamp_provenance({
         "requests": args.requests,
         "slots": args.slots,
         "steps": len(stats),
@@ -115,7 +119,7 @@ def main(argv=None):
         ),
         "full_batch_steps": full,
         "full_batch_frac": round(full / len(decode_steps), 3),
-    }
+    })
     # Chaos epilogue (ISSUE 3 acceptance): exercise the timeout and
     # cancel eviction paths on the SAME engine and re-check the
     # histogram-counts == Σ serve_finished_total invariant with the new

@@ -17,7 +17,13 @@
 #      determinism rule alone over tests/ — the chaos/replay oracles
 #      must not consume ambient entropy either (relaxed set: pure test
 #      scaffolding is exempt from everything but determinism)
-#   4. tools/chaos_smoke.py    — resilience smoke: scheduler
+#   4. tools/sweep.py --dryrun — scaling-observatory smoke (ISSUE 11):
+#      a 2-cell mesh×workload sweep (mlp × {1dev, dp8} on 8 fake CPU
+#      devices) that must emit a schema-valid dtf-scaling-1 report,
+#      every cell provenance-stamped (--expect-platform cpu is the
+#      masquerade tripwire: the report must SAY cpu when it ran on
+#      cpu), with the 8-dev dp scaling-efficiency gate enforced
+#   5. tools/chaos_smoke.py    — resilience smoke: scheduler
 #      timeout/cancel/backpressure invariants + one SIGTERM →
 #      coordinated-save → resume subprocess round (ISSUE 3) + one
 #      supervised SIGTERM + corrupt-newest-checkpoint run that must
@@ -28,7 +34,7 @@
 #      by missed heartbeats, whole-gang SIGTERM/SIGKILL, incarnation
 #      bump, and a relaunch from the latest common valid checkpoint
 #      (ISSUE 8)
-#   5. tools/postmortem.py     — flight-recorder gates: the supervised
+#   6. tools/postmortem.py     — flight-recorder gates: the supervised
 #      round's postmortem dump must pass schema validation AND contain
 #      fault → preemption save → restart → quarantine → fallback-restore
 #      in causal order (ISSUE 6), the nan-blame round's dump must tell
@@ -47,6 +53,10 @@ env JAX_PLATFORMS=cpu python tools/dtf_lint.py --strict \
   distributed_tensorflow_tpu tools bench.py
 env JAX_PLATFORMS=cpu python tools/dtf_lint.py --strict \
   --rules wall-clock-in-seam tests
+env JAX_PLATFORMS=cpu \
+  XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+  python tools/sweep.py --dryrun --expect-platform cpu \
+  --out artifacts/scaling_dryrun.json >/dev/null
 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 env JAX_PLATFORMS=cpu python tools/postmortem.py \
   "${DTF_CHAOS_POSTMORTEM:-artifacts/chaos_postmortem.jsonl}" --quiet \

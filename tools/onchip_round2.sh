@@ -1,4 +1,9 @@
 #!/bin/bash
+# HISTORICAL (round-2 record; superseded by tools/onchip_round5.sh).
+# Kept for the round's provenance: its JSON rows predate the
+# obs/scaling.py provenance stamp, so platform context lives only in
+# the logs. New measurement sessions: tools/onchip_round5.sh; scaling
+# curves: tools/sweep.py (provenance-stamped dtf-scaling-1 reports).
 # Round-2 on-chip measurement session (PERF_NOTES.md staged plan).
 # Runs each step SEQUENTIALLY — never two TPU processes at once (single
 # device lease behind the relay; a killed holder can wedge it).

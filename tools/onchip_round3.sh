@@ -1,4 +1,8 @@
 #!/bin/bash
+# HISTORICAL (round-3 record; superseded by tools/onchip_round5.sh —
+# new sessions go there, and scaling curves through tools/sweep.py,
+# whose dtf-scaling-1 reports are provenance-stamped so a CPU fallback
+# can never read as a TPU row again).
 # Round-3 on-chip measurement session (VERDICT r2 items 1, 2, 5 + Weak #2).
 # Same discipline as onchip_round2.sh: SEQUENTIAL (single device lease),
 # failure-tolerant, one log per step. New vs round 2:

@@ -1,4 +1,6 @@
 #!/bin/bash
+# HISTORICAL (round-3b record; superseded by tools/onchip_round5.sh —
+# new sessions go there, scaling curves through tools/sweep.py).
 # Round-3 FOLLOW-UP on-chip session — run after onchip_round3.sh landed
 # the first measurements and the builder fixed what they exposed:
 #   - bench_hbm now measures + subtracts the tunnel dispatch RTT (the

@@ -1,4 +1,7 @@
 #!/bin/bash
+# HISTORICAL (round-4 record; superseded by tools/onchip_round5.sh —
+# the tiered restructure of this queue. New sessions go there; scaling
+# curves through tools/sweep.py, whose reports are provenance-stamped).
 # Round-4 on-chip session — supersedes onchip_round3b.sh (same core queue,
 # VERDICT r3 item 1) plus the round-4 additions:
 #   - wide_deep embedding-tier row (VERDICT r3 item 5 — last family with

@@ -123,6 +123,14 @@ run gpt_long4k 1200 env BENCH_MODEL=gpt BENCH_SEQ=4096 BENCH_BATCH=4 \
   BENCH_REMAT=1 python -u tools/bench_bert.py
 run wide_deep 900 python -u tools/bench_wide_deep.py
 
+# scaling observatory (ISSUE 11): the first on-chip dtf-scaling-1
+# report — on one chip only the 1dev cells run (multi-dev cells are
+# recorded as skipped, never silently elided), but every number lands
+# provenance-stamped (platform/device_kind/git_sha), so this row can
+# never be confused with the CPU-rig curves the way BENCH_r02-r05 were
+run sweep_scaling 900 python -u tools/sweep.py \
+  --workloads mlp,gpt --eval-batches 2 --out "$ART/SCALING_r5.json"
+
 # fed-window proof (VERDICT r3 item 3): jpeg-decode-fed and the
 # PUT_SYNC A/B in the same session; hbm above already reported
 # host_to_device_gbps, making these rows self-explaining

@@ -1,4 +1,7 @@
 #!/bin/bash
+# HISTORICAL (round-4 watcher; superseded by tools/tpu_watch_r5.sh,
+# which probes through the canonical tools/probe.py shared cache and
+# re-arms after incomplete sessions — use that one).
 # Round-4 relay watcher: probe the tunneled TPU every ~4 min; at the first
 # healthy window take the chip-session lock and fire tools/onchip_round4.sh.
 # Exits when a session has been captured (or the deadline passes) so the
