@@ -1,8 +1,10 @@
 from .pipeline import (  # noqa: F401
     DataConfig,
+    ElasticStream,
     NpzDataset,
     Prefetcher,
     SyntheticClassification,
+    WorkerShard,
     local_batch_size,
     make_dataset,
 )

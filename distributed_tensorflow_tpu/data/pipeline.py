@@ -309,6 +309,106 @@ class QuarantineFilter:
         return batch
 
 
+@dataclasses.dataclass(frozen=True)
+class WorkerShard:
+    """Which disjoint slice of every GLOBAL batch one fleet worker loads
+    — the data half of the elastic fleet (docs/resilience.md "Elastic
+    fleet"). The global batch at index i is a pure function of
+    ``(seed, i)`` and never depends on the worker count; a shard is just
+    a strided view ``[rank::world]`` of it, so the union over ranks is
+    always exactly the global batch and a resize changes who loads what,
+    never what the gang trains on."""
+
+    rank: int
+    world: int
+
+    def __post_init__(self):
+        if self.world < 1:
+            raise ValueError(f"WorkerShard.world must be >= 1, got "
+                             f"{self.world}")
+        if not 0 <= self.rank < self.world:
+            raise ValueError(
+                f"WorkerShard.rank must be in [0, {self.world}), got "
+                f"{self.rank}")
+
+    def slice(self, batch):
+        """Strided ``[rank::world]`` view of every array in ``batch`` —
+        disjoint across ranks, union == the global batch, well-defined
+        for batch sizes not divisible by ``world`` (slice lengths differ
+        by at most 1)."""
+        if isinstance(batch, dict):
+            return {k: v[self.rank::self.world] for k, v in batch.items()}
+        return batch[self.rank::self.world]
+
+
+class ElasticStream:
+    """Reshardable worker view over a global ``(seed, index)``-pure batch
+    stream — the live-rewrite seam the fleet's elastic resize drives
+    (resilience/fleet.ElasticWorker ``on_reshard``).
+
+    ``make_source(i0)`` follows the QuarantineFilter contract: it returns
+    an iterable whose first batch is GLOBAL index ``i0 + 1`` (batch i
+    feeds step i). The stream holds a current ``WorkerShard`` and yields
+    ``shard.slice(global_batch)`` — or the whole batch when ``shard`` is
+    None (the collective-free test rig's replica mode, where every
+    worker computes the full-batch update in place of an allreduce).
+
+    ``reshard(shard, at_index)`` schedules a shard switch: batches with
+    index > ``at_index`` (the fleet barrier step) use the new shard; an
+    ``at_index`` already behind the cursor applies immediately. Because
+    the global stream is pure in ``(seed, index)`` and switches bind to
+    indices, the delivered slices are a pure function of
+    ``(seed, resize schedule)``: a live rewrite is bit-identical to a
+    fresh stream built with the same schedule.
+
+    Single-threaded by contract: ``reshard`` is called from the same
+    loop that consumes the stream (train/callbacks.ElasticCallback runs
+    on the step seam) — do not interpose a Prefetcher, which would run
+    the cursor ahead of the barrier being applied (same rule as the
+    anomaly defense's blame cursor)."""
+
+    def __init__(self, make_source: Callable[[int], Iterable],
+                 shard: WorkerShard | None = None, *, start_index: int = 0):
+        self.make_source = make_source
+        self.shard = shard
+        #: global index of the most recently delivered batch
+        self.index = int(start_index)
+        self._it = iter(make_source(self.index))
+        #: scheduled switches, ascending by at_index
+        self._pending: list[tuple[int, WorkerShard | None]] = []
+        #: applied (at_index, rank, world) history — the realized resize
+        #: schedule, the determinism oracle's replay input
+        self.schedule: list[tuple[int, int | None, int | None]] = []
+
+    def reshard(self, shard: WorkerShard | None, at_index: int) -> None:
+        """Switch to ``shard`` for batches with index > ``at_index``."""
+        at = int(at_index)
+        if at <= self.index:
+            self._apply(at, shard)
+            return
+        # a newer plan for the same (or an earlier) switch point
+        # supersedes anything scheduled at or after it
+        self._pending = [(a, s) for a, s in self._pending if a < at]
+        self._pending.append((at, shard))
+
+    def _apply(self, at: int, shard: WorkerShard | None) -> None:
+        self.shard = shard
+        self.schedule.append(
+            (at, shard.rank if shard else None,
+             shard.world if shard else None))
+
+    def __iter__(self) -> "ElasticStream":
+        return self
+
+    def __next__(self):
+        nxt = self.index + 1
+        while self._pending and self._pending[0][0] < nxt:
+            self._apply(*self._pending.pop(0))
+        batch = next(self._it)
+        self.index = nxt
+        return self.shard.slice(batch) if self.shard is not None else batch
+
+
 class Prefetcher:
     """Background-thread prefetch: keeps up to ``depth`` host batches ready.
     The Python tier of the input pipeline; the native (C++) loader in

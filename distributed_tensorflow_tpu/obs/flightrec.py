@@ -91,6 +91,8 @@ EVENT_KINDS = (
     "fleet_worker_dead",    # liveness/exit failure         {worker, cause, detail}
     "fleet_gang_stop",      # gang torn down                {cause, survivors, killed}
     "fleet_restart",        # new gang live after restart   {restart, cause, incarnation}
+    "fleet_shrink",         # elastic shrink released       {worker, world, barrier, cause}
+    "fleet_rejoin",         # replacement rejoined the gang {worker, world, barrier}
     "fleet_exhausted",      # fleet restart budget ran out  {cause, restarts}
     "fleet_done",           # every worker finished         {incarnation}
     # serving (serve/scheduler.py, serve/engine.py)

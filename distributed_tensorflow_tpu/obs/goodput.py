@@ -47,6 +47,7 @@ __all__ = [
     "WASTE_COMPILE_WARMUP",
     "WASTE_RETRY_BACKOFF",
     "WASTE_RESTART_RECOVERY",
+    "WASTE_ELASTIC_RESIZE",
     "WASTE_CAUSES",
     "note_productive",
     "note_wasted",
@@ -66,8 +67,10 @@ MFU = "mfu"
 WASTE_COMPILE_WARMUP = "compile_warmup"
 WASTE_RETRY_BACKOFF = "retry_backoff"
 WASTE_RESTART_RECOVERY = "restart_recovery"
+WASTE_ELASTIC_RESIZE = "elastic_resize"
 WASTE_CAUSES = (
     WASTE_COMPILE_WARMUP, WASTE_RETRY_BACKOFF, WASTE_RESTART_RECOVERY,
+    WASTE_ELASTIC_RESIZE,
 )
 
 

@@ -18,6 +18,7 @@ from .mesh import (  # noqa: F401
     describe,
     factor_mesh_axis,
     mesh_axis_size,
+    rescale_for_world,
     single_device_mesh,
 )
 from .cluster import (  # noqa: F401

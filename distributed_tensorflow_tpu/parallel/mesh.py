@@ -157,6 +157,45 @@ def build_mesh(
     return Mesh(dev_array, AXIS_NAMES)
 
 
+def rescale_for_world(spec: MeshSpec, old_world: int,
+                      new_world: int) -> MeshSpec:
+    """Respec a mesh for an elastic fleet resize (docs/resilience.md
+    "Elastic fleet"): the worker count changed ``old_world →
+    new_world``, so the device pool scales by the same ratio.
+
+    Only the BATCH axes may absorb a world change — ``model`` / ``pipe``
+    / ``seq`` / ``expert`` extents are baked into parameter and
+    activation layouts, and resizing them would re-partition state, not
+    just re-partition the batch. Concretely:
+
+    - ``data == -1`` passes through: the wildcard already absorbs
+      whatever devices the surviving workers contribute.
+    - otherwise the first of ``data``, ``fsdp`` (both are BATCH_AXES)
+      whose explicit extent scales integrally by
+      ``new_world / old_world`` absorbs the change; the DCN factor
+      constraint is re-validated by ``resolve`` at build time.
+
+    Anything else raises with the fix spelled out. The returned spec is
+    what a (re)launched worker passes to ``build_mesh`` for the resized
+    gang; the data-stream half of the resize is
+    ``data/pipeline.ElasticStream``."""
+    if old_world < 1 or new_world < 1:
+        raise ValueError("old_world and new_world must be >= 1")
+    if new_world == old_world or spec.data == -1:
+        return spec
+    for axis in (DATA, FSDP):
+        extent = getattr(spec, axis)
+        scaled = extent * new_world
+        if scaled % old_world == 0 and scaled >= old_world:
+            return dataclasses.replace(spec, **{axis: scaled // old_world})
+    raise ValueError(
+        f"neither batch axis scales by {new_world}/{old_world} "
+        f"(data={spec.data}, fsdp={spec.fsdp}): the resized extent would "
+        f"not be integral — use data=-1 so the batch axis absorbs the "
+        f"surviving devices, or pick a fleet size dividing a batch-axis "
+        f"extent")
+
+
 def _hybrid_device_array(spec: MeshSpec, devices: Sequence[jax.Device]) -> np.ndarray:
     """Device array for a multislice ICI×DCN mesh (SURVEY.md §2d: ICI
     within a slice, DCN between slices; the DeviceAssignment/Topology

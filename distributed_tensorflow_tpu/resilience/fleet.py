@@ -95,8 +95,23 @@ _KNOWN_CAUSES = frozenset({TRANSIENT, POISONED, FATAL, PREEMPTION, STALLED})
 #: fleet_restart waits on before declaring the new gang live)
 _PAST_BUILD_PHASES = ("train", "done", "preempted", "failed")
 
+#: metric names for the elastic path (documented in docs/observability.md)
+FLEET_SIZE = "fleet_size"
+FLEET_RESIZES_TOTAL = "fleet_resizes_total"
+
+#: failure classes a death may carry and still be absorbed elastically:
+#: the dead worker's state is on disk and the survivors' is healthy.
+#: POISONED/FATAL stay gang failures — they indict the trajectory, not
+#: one process.
+_ELASTIC_CAUSES = frozenset({TRANSIENT, STALLED, PREEMPTION})
+
 _INCARNATION_FILE = "INCARNATION"
 _RESTORE_FILE = "RESTORE_STEP"
+_SHARD_PLAN_FILE = "SHARD_PLAN"
+
+#: ShardPlan phases
+PLAN_STEADY = "steady"
+PLAN_HOLD = "hold"
 
 
 class WorkerDead(OSError):
@@ -200,6 +215,100 @@ def clear_restore_step(fleet_dir: str) -> None:
     silently roll a longer continuation run back to an old step."""
     path = os.path.join(
         os.path.abspath(os.path.expanduser(fleet_dir)), _RESTORE_FILE)
+    if os.path.exists(path):
+        os.remove(path)
+
+
+# ---------------------------------------------------------------------------
+# Shard plan (elastic resize control file)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardPlan:
+    """One on-disk data-sharding epoch of the elastic fleet
+    (docs/resilience.md "Elastic fleet"). ``ranks`` maps worker index →
+    shard rank over ``world``; the sharding applies to global batch
+    indices > ``barrier_step``. ``phase == PLAN_HOLD`` is the resize
+    handshake: every worker listed in ``hold`` pauses at its next step
+    boundary (heartbeat phase ``barrier``) until a newer PLAN_STEADY
+    release names the barrier and the post-resize sharding. Versions
+    are strictly increasing; workers apply each version exactly once."""
+
+    version: int
+    phase: str
+    world: int
+    ranks: dict[int, int]
+    barrier_step: int
+    incarnation: int = 0
+    hold: tuple[int, ...] = ()
+    #: the NOMINAL fleet size (what the run was configured for) —
+    #: consumers rescaling N-sized resources to ``world`` (the runner's
+    #: mesh respec) need the denominator; 0 = unknown (older plans)
+    fleet_size: int = 0
+
+    def __post_init__(self):
+        if self.phase not in (PLAN_STEADY, PLAN_HOLD):
+            raise ValueError(f"unknown plan phase {self.phase!r}")
+        if self.world < 1 or self.version < 1:
+            raise ValueError("plan world and version must be >= 1")
+        if sorted(self.ranks.values()) != list(range(len(self.ranks))):
+            raise ValueError(
+                f"plan ranks must be a bijection onto 0..{len(self.ranks)-1},"
+                f" got {self.ranks}")
+        if self.world != len(self.ranks):
+            # an unserved rank would silently drop a slice of every
+            # batch — the union-over-ranks invariant is the whole point
+            raise ValueError(
+                f"plan world={self.world} != {len(self.ranks)} ranks: "
+                f"every rank of the world must be served by a worker")
+
+
+def _shard_plan_path(fleet_dir: str) -> str:
+    return os.path.join(
+        os.path.abspath(os.path.expanduser(fleet_dir)), _SHARD_PLAN_FILE)
+
+
+def read_shard_plan(fleet_dir: str) -> ShardPlan | None:
+    """Current shard plan (None when no elastic fleet has written one,
+    or the file is unreadable — a worker that cannot read the plan keeps
+    its last applied sharding, which is the conservative choice)."""
+    try:
+        with open(_shard_plan_path(fleet_dir)) as f:
+            d = json.load(f)
+        return ShardPlan(
+            version=int(d["version"]), phase=str(d["phase"]),
+            world=int(d["world"]),
+            ranks={int(k): int(v) for k, v in d["ranks"].items()},
+            barrier_step=int(d["barrier_step"]),
+            incarnation=int(d.get("incarnation", 0)),
+            hold=tuple(int(i) for i in d.get("hold", ())),
+            fleet_size=int(d.get("fleet_size", 0)),
+        )
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        logger.warning("unreadable shard plan in %s (%s); treating as absent",
+                       fleet_dir, e)
+        return None
+
+
+def write_shard_plan(fleet_dir: str, plan: ShardPlan) -> None:
+    d = os.path.abspath(os.path.expanduser(fleet_dir))
+    os.makedirs(d, exist_ok=True)
+    _atomic_write(os.path.join(d, _SHARD_PLAN_FILE), json.dumps({
+        "version": plan.version, "phase": plan.phase, "world": plan.world,
+        "ranks": {str(k): v for k, v in plan.ranks.items()},
+        "barrier_step": plan.barrier_step, "incarnation": plan.incarnation,
+        "hold": list(plan.hold), "fleet_size": plan.fleet_size,
+    }))
+
+
+def clear_shard_plan(fleet_dir: str) -> None:
+    """Remove the shard plan — every fresh fleet run starts here, like
+    ``clear_restore_step``: a previous run's plan must not assign this
+    run's workers stale shards."""
+    path = _shard_plan_path(fleet_dir)
     if os.path.exists(path):
         os.remove(path)
 
@@ -330,6 +439,10 @@ class Heartbeat:
     cause: str | None = None
     restore_step: int | None = None
     restore_fallback: bool | None = None
+    #: elastic plan acknowledgment: the newest ShardPlan version this
+    #: worker has applied (or is holding at), and its sharded world size
+    plan_version: int | None = None
+    world: int | None = None
 
 
 def read_heartbeat(path: str) -> Heartbeat | None:
@@ -348,6 +461,8 @@ def read_heartbeat(path: str) -> Heartbeat | None:
             cause=data.get("cause"),
             restore_step=data.get("restore_step"),
             restore_fallback=data.get("restore_fallback"),
+            plan_version=data.get("plan_version"),
+            world=data.get("world"),
         )
     except FileNotFoundError:
         return None
@@ -380,6 +495,7 @@ class HeartbeatWriter:
         self._phase = "init"
         self._cause: str | None = None
         self._restore: tuple[int, bool] | None = None
+        self._plan: tuple[int, int] | None = None  # (version, world)
         self._stop = threading.Event()
         self._pulse: threading.Thread | None = None
         if pulse_interval_s is not None:
@@ -409,6 +525,8 @@ class HeartbeatWriter:
             }
             if self._restore is not None:
                 rec["restore_step"], rec["restore_fallback"] = self._restore
+            if self._plan is not None:
+                rec["plan_version"], rec["world"] = self._plan
             # write INSIDE the lock: beats from the pulse thread and the
             # train loop serialize, so seq order on disk == write order
             _atomic_write(self.path, json.dumps(rec))
@@ -420,6 +538,20 @@ class HeartbeatWriter:
         with self._lock:
             self._restore = (int(step), bool(fallback))
         self.beat()
+
+    def note_plan(self, version: int, world: int) -> None:
+        """Record the newest ShardPlan this worker has applied (or is
+        holding at) — the fleet's resize-acknowledgment signal. The
+        caller beats separately (usually with the matching phase)."""
+        with self._lock:
+            self._plan = (int(version), int(world))
+
+    @property
+    def phase(self) -> str:
+        """Last beaten phase — lets a transient phase (``save``) restore
+        what it replaced instead of guessing."""
+        with self._lock:
+            return self._phase
 
     def finish(self, phase: str, cause: str | None = None) -> None:
         """Terminal beat (``done`` / ``preempted`` / ``failed``) — the
@@ -450,6 +582,11 @@ STALLED_HB = "stalled"  # beats ticking, no progress past the budget
 
 #: phases after which a frozen step is expected (the process is exiting)
 _TERMINAL_PHASES = ("done", "preempted", "failed")
+
+#: phases during which a frozen step is SANCTIONED: the fleet itself is
+#: holding the worker at a resize barrier (and bounds the hold with its
+#: own ``hold_timeout_s`` — the stall budget must not race it)
+_HOLD_PHASES = ("barrier",)
 
 
 class HeartbeatMonitor:
@@ -510,9 +647,122 @@ class HeartbeatMonitor:
             return DEAD
         if (self.heartbeat is not None
                 and self.heartbeat.phase not in _TERMINAL_PHASES
+                and self.heartbeat.phase not in _HOLD_PHASES
                 and now - self._t_progress > self.stall_timeout_s):
             return STALLED_HB
         return LIVE
+
+
+# ---------------------------------------------------------------------------
+# Elastic worker client (worker side)
+# ---------------------------------------------------------------------------
+
+
+class ElasticWorker:
+    """Worker-side elastic resize client — polled from the step seam
+    (train/callbacks.ElasticCallback), jax-free like the rest of the
+    control plane.
+
+    ``poll(step)`` reads the SHARD_PLAN control file and applies any
+    version newer than the last one applied:
+
+    - ``PLAN_STEADY``: schedule ``on_reshard(rank, world, barrier_step)``
+      (rank None when this worker is not a member — a replacement still
+      catching up). The reshard binds to the barrier INDEX, so applying
+      it early is exact.
+    - ``PLAN_HOLD`` naming this worker: pause HERE — beat heartbeat
+      phase ``barrier`` (with the hold version acknowledged via
+      ``note_plan``) and block, beating for liveness, until the fleet
+      releases with a newer PLAN_STEADY. The pause is what makes the
+      barrier step the fleet picks an upper bound for every member.
+
+    A hold abandoned past ``hold_timeout_s`` (fleet died mid-resize)
+    raises OSError — classified transient, so the in-process Supervisor
+    restarts the attempt instead of hanging forever.
+
+    ``on_reshard(rank | None, world, at_index)`` rewires the data
+    stream — typically ``ElasticStream.reshard`` (data/pipeline.py)
+    through a WorkerShard. Plain ints cross the seam so this module
+    never imports the (jax-importing) data package.
+    """
+
+    def __init__(self, fleet_dir: str, worker: int, writer: HeartbeatWriter,
+                 on_reshard: Callable[[int | None, int, int], None]
+                 | None = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 poll_s: float = 0.05, hold_timeout_s: float = 120.0):
+        if poll_s <= 0 or hold_timeout_s <= 0:
+            raise ValueError("poll_s and hold_timeout_s must be positive")
+        self.fleet_dir = fleet_dir
+        self.worker = int(worker)
+        self.writer = writer
+        self.on_reshard = on_reshard
+        self.clock = clock
+        self.sleep = sleep
+        self.poll_s = poll_s
+        self.hold_timeout_s = hold_timeout_s
+        #: newest plan version applied (or held at)
+        self.applied_version = 0
+        #: (rank | None, world) from the newest applied steady plan
+        self.assignment: tuple[int | None, int] | None = None
+
+    def poll(self, step: int | None = None) -> None:
+        """One step-seam poll; blocks only while the fleet holds this
+        worker at a resize barrier."""
+        plan = read_shard_plan(self.fleet_dir)
+        if plan is None or plan.version <= self.applied_version:
+            return
+        if plan.phase == PLAN_HOLD:
+            if self.worker in plan.hold:
+                self._hold(step, plan)
+            # a hold not naming us (we are the joiner the gang is about
+            # to absorb) is applied by the release that follows it
+            return
+        self._apply(plan)
+        self.writer.beat(step=step)
+
+    def _hold(self, step: int | None, plan: ShardPlan) -> None:
+        self.applied_version = plan.version
+        self.writer.note_plan(plan.version, plan.world)
+        prev_phase = self.writer.phase
+        if prev_phase in ("save", "barrier"):
+            # never re-instate a transient phase after the release: a
+            # 'save' whose async commit landed during the hold (its
+            # restore thread refuses to clobber our barrier) would
+            # otherwise stick forever and force every later death down
+            # the mid-checkpoint gang-stop path
+            prev_phase = "train"
+        self.writer.beat(step=step, phase="barrier")
+        logger.warning("elastic: worker %d holding at step %s for resize "
+                       "(plan v%d)", self.worker, step, plan.version)
+        deadline = self.clock() + self.hold_timeout_s
+        while True:
+            self.sleep(self.poll_s)
+            nxt = read_shard_plan(self.fleet_dir)
+            if (nxt is not None and nxt.version > plan.version
+                    and nxt.phase == PLAN_STEADY):
+                self._apply(nxt)
+                self.writer.beat(phase=prev_phase)
+                return
+            if self.clock() > deadline:
+                # surface as transient: the supervisor restarts the
+                # attempt, which re-reads whatever plan exists by then
+                raise OSError(
+                    f"elastic hold abandoned: no release within "
+                    f"{self.hold_timeout_s}s of plan v{plan.version}")
+            self.writer.beat()  # liveness while paused
+
+    def _apply(self, plan: ShardPlan) -> None:
+        self.applied_version = plan.version
+        rank = plan.ranks.get(self.worker)
+        self.assignment = (rank, plan.world)
+        self.writer.note_plan(plan.version, plan.world)
+        if self.on_reshard is not None:
+            self.on_reshard(rank, plan.world, plan.barrier_step)
+        logger.info("elastic: worker %d applied plan v%d (rank %s of %d, "
+                    "from batch %d)", self.worker, plan.version, rank,
+                    plan.world, plan.barrier_step + 1)
 
 
 # ---------------------------------------------------------------------------
@@ -544,6 +794,23 @@ class FleetConfig:
     #: SIGTERM → SIGKILL grace during a gang stop (must cover one
     #: coordinated preemption save)
     term_grace_s: float = 10.0
+    #: elastic resize (docs/resilience.md "Elastic fleet"): a worker
+    #: death SHRINKS the gang to the survivors at a barrier step instead
+    #: of gang-stopping everyone, and a relaunched replacement REJOINS
+    #: at the next barrier. Gang-stop remains the fallback (below
+    #: min_workers, death mid-checkpoint, resize already in flight,
+    #: poisoned/fatal causes).
+    elastic: bool = False
+    #: survivor floor: a death that would leave fewer members than this
+    #: falls back to the gang-stop → common-checkpoint restart path
+    min_workers: int = 1
+    #: budget for a relaunched replacement's FIRST heartbeat (its
+    #: launch grace); after it proves life past build+restore it rejoins
+    #: at the next barrier
+    rejoin_grace_s: float = 120.0
+    #: budget for every member to reach (and be released from) a resize
+    #: barrier; an overrun falls back to the gang-stop path
+    hold_timeout_s: float = 60.0
 
     def __post_init__(self):
         if self.max_restarts < 0:
@@ -553,6 +820,20 @@ class FleetConfig:
             raise ValueError(f"unknown restart_on classes: {sorted(unknown)}")
         if self.poll_s <= 0 or self.term_grace_s <= 0:
             raise ValueError("poll_s and term_grace_s must be positive")
+        if self.min_workers < 1:
+            raise ValueError(
+                f"min_workers must be >= 1 (a gang cannot shrink to "
+                f"nothing), got {self.min_workers}")
+        if self.rejoin_grace_s <= 0:
+            raise ValueError(
+                f"rejoin_grace_s must be > 0 (a replacement needs a "
+                f"liveness budget covering spawn + imports + restore), "
+                f"got {self.rejoin_grace_s}")
+        if self.hold_timeout_s <= 0:
+            raise ValueError(
+                f"hold_timeout_s must be > 0 (members must be released "
+                f"from a barrier or the gang falls back), got "
+                f"{self.hold_timeout_s}")
 
 
 @dataclasses.dataclass
@@ -563,6 +844,9 @@ class _Worker:
     done: bool = False               # exited 0 this incarnation
     ready: bool = False              # heartbeat got past build+restore
     exit_code: int | None = None
+    #: False while this slot is a catching-up replacement (launched by
+    #: an elastic shrink, not yet absorbed by a rejoin barrier)
+    member: bool = True
 
 
 class FleetSupervisor:
@@ -600,6 +884,17 @@ class FleetSupervisor:
             raise ValueError("num_workers must be >= 1")
         if ckpt_dirs is not None and len(ckpt_dirs) != num_workers:
             raise ValueError("ckpt_dirs must have one entry per worker")
+        if cfg.elastic and num_workers == 1:
+            raise ValueError(
+                "elastic=True is incompatible with num_workers=1: a "
+                "1-worker gang has no survivors to shrink to — use the "
+                "gang-restart path (elastic=False), which restarts the "
+                "single worker from its newest valid checkpoint")
+        if cfg.elastic and cfg.min_workers > num_workers:
+            raise ValueError(
+                f"min_workers={cfg.min_workers} exceeds the fleet size "
+                f"{num_workers}: every death would bypass the elastic "
+                f"path — lower min_workers or grow the fleet")
         self.launch = launch
         self.num_workers = num_workers
         self.workdir = os.path.abspath(os.path.expanduser(workdir))
@@ -615,14 +910,30 @@ class FleetSupervisor:
         self._stop_signal: list[int] = []
         #: gang restarts performed by the last run() (test observability)
         self.restarts = 0
+        #: elastic resizes completed (shrinks + rejoins) by the last run()
+        self.resizes = 0
         self.incarnation = 0
         #: restore ceiling written for the CURRENT incarnation (None =
         #: no ceiling; every checked-in worker must have restored it)
         self._ceiling: int | None = None
         self._workers: list[_Worker] = []
+        #: current shard plan (elastic mode only)
+        self._plan: ShardPlan | None = None
+        #: in-flight resize state machine (None = steady):
+        #: {kind: shrink|rejoin, stage: hold|released, t0, worker,
+        #:  hold: [indices], version: plan version of the current stage}
+        self._resize: dict | None = None
+        #: relaunches spent on replacements that died before rejoining
+        self._joiner_relaunches = 0
+        #: start of the current gang outage (gang stop → gang live) —
+        #: the window booked as restart_recovery waste
+        self._t_outage: float | None = None
         self._m_deaths = self.registry.counter(
             FLEET_WORKER_DEATHS_TOTAL,
             "fleet worker deaths detected (exit, missed heartbeat, stall)")
+        self._m_size = self.registry.gauge(
+            FLEET_SIZE, "current gang size (members sharing the data "
+            "stream; drops on an elastic shrink, recovers on rejoin)")
 
     # -- interruptible waiting --------------------------------------------
 
@@ -667,24 +978,38 @@ class FleetSupervisor:
                 pid=getattr(handle, "pid", None))
             logger.info("fleet: launched worker %d (incarnation %d, pid %s)",
                         i, self.incarnation, getattr(handle, "pid", None))
+        self._m_size.set(self.num_workers)
 
     def run(self) -> dict:
         """Supervise until every worker reaches a clean ``done`` exit.
 
-        Returns ``{"restarts": n, "incarnation": k}``. Raises
-        ``FleetExhausted`` when the restart budget runs out or the
-        failure class is not restartable (postmortem dumped first).
+        Returns ``{"restarts": n, "incarnation": k, "resizes": m}``.
+        Raises ``FleetExhausted`` when the restart budget runs out or
+        the failure class is not restartable (postmortem dumped first).
         """
         os.makedirs(self.workdir, exist_ok=True)
         # new fleet run == new incarnation: stale heartbeats from any
         # previous fleet in this dir can never read as liveness — and no
-        # inherited restore ceiling: a previous run's RESTORE_STEP would
-        # cap this run's restores at an old step
+        # inherited restore ceiling or shard plan: a previous run's
+        # RESTORE_STEP would cap this run's restores at an old step, and
+        # its SHARD_PLAN would hand this run's workers stale shards
         self.incarnation = read_incarnation(self.workdir) + 1
         write_incarnation(self.workdir, self.incarnation)
         clear_restore_step(self.workdir)
+        clear_shard_plan(self.workdir)
         self.restarts = 0
+        self.resizes = 0
         self._ceiling = None
+        self._resize = None
+        self._plan = None
+        self._joiner_relaunches = 0
+        self._t_outage = None
+        if self.cfg.elastic:
+            self._write_plan(ShardPlan(
+                version=1, phase=PLAN_STEADY, world=self.num_workers,
+                ranks={i: i for i in range(self.num_workers)},
+                barrier_step=0, incarnation=self.incarnation,
+                fleet_size=self.num_workers))
         main = threading.current_thread() is threading.main_thread()
         prev_handler = (signal_lib.signal(signal_lib.SIGTERM, self._sigterm)
                         if main else None)
@@ -708,23 +1033,33 @@ class FleetSupervisor:
                                         cause=cause, detail=detail[:200])
                     logger.error("fleet: worker %d dead [%s]: %s",
                                  worker, cause, detail)
-                    self._gang_stop(cause)
-                    if cause not in self.cfg.restart_on \
-                            or self.restarts >= self.cfg.max_restarts:
-                        self.flightrec.emit("fleet_exhausted", cause=cause,
-                                            restarts=self.restarts)
-                        self._dump_postmortem(f"fleet_exhausted:{cause}")
-                        raise FleetExhausted(cause, self.restarts, detail)
-                    pending_restart = self._gang_restart(cause)
+                    if self._absorb_elastically(
+                            worker, cause,
+                            pending=pending_restart is not None):
+                        continue
+                    pending_restart = self._gang_path(cause, detail)
                     relayed = False
-                elif all(w.done for w in self._workers):
+                    continue
+                if pending_restart is None:
+                    # tick BEFORE the done check: a replacement that
+                    # finished between polls must still be absorbed (the
+                    # timeline owes a fleet_rejoin before fleet_done)
+                    stuck = self._elastic_tick()
+                    if stuck is not None:
+                        pending_restart = self._gang_path(*stuck)
+                        relayed = False
+                        continue
+                if (self._resize is None
+                        and all(w.done for w in self._workers)):
                     self.flightrec.emit("fleet_done",
                                         incarnation=self.incarnation)
                     logger.info("fleet: all %d workers done (incarnation %d,"
-                                " %d restart(s))", self.num_workers,
-                                self.incarnation, self.restarts)
+                                " %d restart(s), %d resize(s))",
+                                self.num_workers, self.incarnation,
+                                self.restarts, self.resizes)
                     return {"restarts": self.restarts,
-                            "incarnation": self.incarnation}
+                            "incarnation": self.incarnation,
+                            "resizes": self.resizes}
         finally:
             # no worker may outlive its supervisor: on every normal path
             # (done, exhausted, preempted teardown) the gang is already
@@ -815,6 +1150,17 @@ class FleetSupervisor:
             logger.warning("fleet: gang live after restart %d (cause=%s, "
                            "incarnation %d)", restart_index, cause,
                            self.incarnation)
+            if self._t_outage is not None:
+                # the WHOLE outage — gang stop, backoff, relaunch,
+                # restore, first-beat — is recovery waste: N workers
+                # trained nothing from the death to this moment. This is
+                # the number the elastic path shrinks by ~an order of
+                # magnitude (docs/resilience.md "Elastic fleet").
+                slept = self.clock() - self._t_outage
+                if slept > 0:
+                    goodput.note_wasted(goodput.WASTE_RESTART_RECOVERY,
+                                        slept, registry=self.registry)
+                self._t_outage = None
             pending_restart = None
         return pending_restart, relayed, failed
 
@@ -886,6 +1232,39 @@ class FleetSupervisor:
         self.flightrec.emit("fleet_gang_stop", cause=cause,
                             survivors=len(survivors), killed=killed)
 
+    def _gang_path(self, cause: str, detail: str) -> tuple[int, str]:
+        """The non-elastic failure path: tear the whole gang down and
+        either schedule a restart (returned as ``pending_restart``) or
+        raise ``FleetExhausted``. The window from here to the restarted
+        gang's liveness confirmation is booked as ``restart_recovery``
+        waste."""
+        t0 = self.clock()
+        self._resize = None  # any in-flight resize is moot: everyone dies
+        self._gang_stop(cause)
+        if cause not in self.cfg.restart_on \
+                or self.restarts >= self.cfg.max_restarts:
+            # book the recovery waste spent so far: an exhausted chain
+            # never reaches the gang-live booking in _poll_round, and an
+            # unbooked outage would under-report exactly the ledger the
+            # postmortem of a dead run is read against
+            start = self._t_outage if self._t_outage is not None else t0
+            slept = self.clock() - start
+            if slept > 0:
+                goodput.note_wasted(goodput.WASTE_RESTART_RECOVERY, slept,
+                                    registry=self.registry)
+            self._t_outage = None
+            self.flightrec.emit("fleet_exhausted", cause=cause,
+                                restarts=self.restarts)
+            self._dump_postmortem(f"fleet_exhausted:{cause}")
+            raise FleetExhausted(cause, self.restarts, detail)
+        pending = self._gang_restart(cause)
+        if self._t_outage is None:
+            # a second death during a still-pending restart must not
+            # restart the outage clock: the window runs from the FIRST
+            # gang stop to the first gang that confirms live
+            self._t_outage = t0
+        return pending
+
     def _gang_restart(self, cause: str) -> tuple[int, str]:
         delay = self.cfg.backoff.backoff_s(self.restarts)
         self.restarts += 1
@@ -896,13 +1275,10 @@ class FleetSupervisor:
         logger.warning("fleet: gang restart %d/%d (cause=%s) after %.2fs "
                        "backoff", self.restarts, self.cfg.max_restarts,
                        cause, delay)
-        t0 = self.clock()
+        # the backoff sleep needs no waste booking of its own: it sits
+        # inside the gang-stop → gang-live outage window booked when the
+        # restarted gang confirms liveness (_poll_round)
         self._wait(delay)
-        slept = self.clock() - t0
-        if slept > 0:
-            # ELAPSED, not nominal: injected no-op sleeps waste nothing
-            goodput.note_wasted(goodput.WASTE_RESTART_RECOVERY, slept,
-                                registry=self.registry)
         self._ceiling = None
         if self.ckpt_dirs is not None:
             common = newest_common_valid_step(self.ckpt_dirs)
@@ -915,8 +1291,276 @@ class FleetSupervisor:
                                "is step %d", self.incarnation + 1, common)
         self.incarnation += 1
         write_incarnation(self.workdir, self.incarnation)
+        if self.cfg.elastic:
+            # the restarted gang is N-wide again: fresh steady plan, the
+            # sharding applying from the restore ceiling forward
+            self._joiner_relaunches = 0
+            self._write_plan(ShardPlan(
+                version=(self._plan.version + 1) if self._plan else 1,
+                phase=PLAN_STEADY, world=self.num_workers,
+                ranks={i: i for i in range(self.num_workers)},
+                barrier_step=self._ceiling or 0,
+                incarnation=self.incarnation,
+                fleet_size=self.num_workers))
         self._launch_all()
         return (self.restarts, cause)
+
+    # -- elastic resize (shrink at N-1, rejoin at N) -----------------------
+
+    def _write_plan(self, plan: ShardPlan) -> None:
+        write_shard_plan(self.workdir, plan)
+        self._plan = plan
+
+    def _absorb_elastically(self, worker: int, cause: str,
+                            pending: bool = False) -> bool:
+        """Decide whether this death shrinks the gang instead of
+        stopping it. True = handled (shrink begun, or a dead replacement
+        relaunched); False = take the gang-stop path."""
+        if not self.cfg.elastic:
+            return False
+        if pending:
+            # a gang restart is still confirming: members may not have
+            # read their restore ceiling yet, and a hold would name
+            # workers still in build/restore — another gang pass is the
+            # only consistent answer
+            logger.warning(
+                "elastic: worker %d died while a gang restart is "
+                "pending; falling back to another gang restart", worker)
+            return False
+        w = self._workers[worker]
+        if not w.member:
+            return self._relaunch_joiner(w)
+        if self._resize is not None:
+            logger.warning(
+                "elastic: worker %d died during an in-flight %s; falling "
+                "back to gang restart", worker, self._resize["kind"])
+            return False
+        if cause not in _ELASTIC_CAUSES:
+            logger.warning(
+                "elastic: cause %r indicts the trajectory, not one "
+                "process; falling back to gang restart", cause)
+            return False
+        hb = w.monitor.heartbeat
+        if hb is not None and hb.phase == "save":
+            logger.warning(
+                "elastic: worker %d died mid-checkpoint; its newest step "
+                "dir may be torn — falling back to gang restart", worker)
+            return False
+        survivors = [x for x in self._workers
+                     if x.member and not x.done and x.index != worker]
+        members_after = sum(
+            1 for x in self._workers if x.member and x.index != worker)
+        if members_after < self.cfg.min_workers or not survivors:
+            logger.warning(
+                "elastic: shrink would leave %d member(s), below "
+                "min_workers=%d (or none still training); falling back "
+                "to gang restart", members_after, self.cfg.min_workers)
+            return False
+        self._begin_shrink(w, survivors, cause)
+        return True
+
+    def _begin_shrink(self, w: _Worker, survivors: list[_Worker],
+                      cause: str) -> None:
+        """Survivors pause at a barrier (hold plan), the dead worker's
+        slot is relaunched as a catching-up replacement, and the release
+        (written by ``_elastic_tick`` once every survivor acknowledges
+        the hold) reshards the stream across N-1."""
+        self._ensure_dead(w)
+        w.member = False
+        hold = tuple(sorted(x.index for x in survivors))
+        self._resize = {
+            "kind": "shrink", "stage": "hold", "t0": self.clock(),
+            "worker": w.index, "cause": cause, "hold": hold,
+            "version": self._plan.version + 1,
+        }
+        self._write_plan(dataclasses.replace(
+            self._plan, version=self._plan.version + 1, phase=PLAN_HOLD,
+            hold=hold))
+        logger.warning(
+            "elastic: shrink begun — worker %d out, holding %s at the "
+            "next step boundary (plan v%d)", w.index, list(hold),
+            self._plan.version)
+        self._launch_joiner(w.index)
+
+    def _launch_joiner(self, index: int) -> None:
+        """Relaunch worker ``index``'s slot as a non-member replacement.
+        It restores from its own newest valid checkpoint and replays the
+        deterministic stream to catch up; once it proves life past
+        build+restore (within ``rejoin_grace_s``) the next barrier
+        absorbs it back into the gang."""
+        path = heartbeat_path(self.workdir, index)
+        if os.path.exists(path):
+            # a corpse's last beat must never satisfy the replacement's
+            # launch grace (same incarnation, so the monitor would
+            # otherwise accept it)
+            os.remove(path)
+        # an earlier gang restart's RESTORE_STEP was consumed when that
+        # gang came live; left behind it would cap a joiner's restore at
+        # the old ceiling and force a needless long replay
+        clear_restore_step(self.workdir)
+        handle = self.launch(index, self.incarnation)
+        self._workers[index] = _Worker(
+            index=index, handle=handle,
+            monitor=HeartbeatMonitor(
+                path, self.incarnation, clock=self.clock,
+                heartbeat_timeout_s=self.cfg.heartbeat_timeout_s,
+                stall_timeout_s=self.cfg.stall_timeout_s,
+                launch_grace_s=self.cfg.rejoin_grace_s,
+            ),
+            member=False)
+        self.flightrec.emit("fleet_launch", worker=index,
+                            incarnation=self.incarnation,
+                            pid=getattr(handle, "pid", None), rejoin=True)
+        logger.warning("fleet: launched replacement for worker %d "
+                       "(incarnation %d, pid %s)", index, self.incarnation,
+                       getattr(handle, "pid", None))
+
+    def _relaunch_joiner(self, w: _Worker) -> bool:
+        """A replacement died before rejoining. Relaunch it (bounded by
+        the restart budget); an in-flight rejoin hold is released at the
+        CURRENT sharding so the members never wait on a corpse."""
+        if self._joiner_relaunches >= self.cfg.max_restarts:
+            logger.error(
+                "elastic: replacement for worker %d died %d time(s); "
+                "falling back to gang restart", w.index,
+                self._joiner_relaunches + 1)
+            return False
+        self._joiner_relaunches += 1
+        self._ensure_dead(w)
+        if self._resize is not None and self._resize["kind"] == "rejoin":
+            self._write_plan(dataclasses.replace(
+                self._plan, version=self._plan.version + 1,
+                phase=PLAN_STEADY, hold=()))
+            self._resize = None
+        self._launch_joiner(w.index)
+        return True
+
+    def _elastic_tick(self) -> tuple[str, str] | None:
+        """Advance the resize state machine one poll round. Returns a
+        ``(cause, detail)`` gang-stop escalation when a resize overran
+        its budget, else None."""
+        if not self.cfg.elastic:
+            return None
+        st = self._resize
+        if st is None:
+            joiner = next((w for w in self._workers if not w.member), None)
+            if joiner is not None and joiner.ready:
+                self._begin_rejoin(joiner)
+            return None
+        if self.clock() - st["t0"] > self.cfg.hold_timeout_s:
+            logger.error("elastic: %s overran hold_timeout_s=%.1f in stage "
+                         "%s; falling back to gang restart", st["kind"],
+                         self.cfg.hold_timeout_s, st["stage"])
+            return (TRANSIENT,
+                    f"elastic {st['kind']} timed out in stage {st['stage']}")
+        if st["stage"] == "hold":
+            acked: list[int] = []
+            for i in st["hold"]:
+                w = self._workers[i]
+                if w.done:
+                    continue
+                hb = w.monitor.heartbeat
+                if (hb is None or hb.plan_version != st["version"]
+                        or hb.phase != "barrier"):
+                    return None  # keep waiting for this member
+                acked.append(hb.step)
+            self._release(st, acked)
+        else:  # released: wait for every member to apply the new plan
+            for w in self._workers:
+                if not w.member or w.done:
+                    continue
+                hb = w.monitor.heartbeat
+                if hb is None or (hb.plan_version or 0) < st["version"]:
+                    return None
+            waste = self.clock() - st["t0"]
+            if waste > 0:
+                goodput.note_wasted(goodput.WASTE_ELASTIC_RESIZE, waste,
+                                    registry=self.registry)
+            logger.warning("elastic: %s complete in %.2fs (world %d)",
+                           st["kind"], waste, self._plan.world)
+            self._resize = None
+        return None
+
+    def _begin_rejoin(self, joiner: _Worker) -> None:
+        """The replacement proved life: absorb it at the next barrier,
+        restoring N-way sharding. With no member left training (they
+        finished while it caught up) the release is immediate."""
+        holders = tuple(sorted(
+            w.index for w in self._workers if w.member and not w.done))
+        st = {
+            "kind": "rejoin", "stage": "hold", "t0": self.clock(),
+            "worker": joiner.index, "cause": None, "hold": holders,
+            "version": self._plan.version + 1,
+        }
+        self._resize = st
+        if holders:
+            self._write_plan(dataclasses.replace(
+                self._plan, version=self._plan.version + 1, phase=PLAN_HOLD,
+                hold=holders))
+            logger.warning("elastic: rejoin begun — worker %d back, "
+                           "holding %s (plan v%d)", joiner.index,
+                           list(holders), self._plan.version)
+        else:
+            self._release(st, [])
+
+    def _release(self, st: dict, acked_steps: list[int]) -> None:
+        """Write the post-resize steady plan. The barrier is the highest
+        step any holder paused at — holders pause BEFORE fetching their
+        next batch, so every member's stream cursor is <= barrier and
+        the new sharding binds exactly to batches > barrier."""
+        members = sorted(w.index for w in self._workers if w.member)
+        if st["kind"] == "rejoin":
+            members = sorted(set(members) | {st["worker"]})
+        # the barrier must bound every cursor in the gang: holders'
+        # paused steps, but also members that already FINISHED (their
+        # consumed range may exceed the holders') and the joiner — the
+        # switch may never rewrite a batch anyone already consumed
+        steps = list(acked_steps)
+        steps += [hb.step for w in self._workers
+                  if (w.member or w.index == st["worker"])
+                  and (hb := w.monitor.heartbeat) is not None]
+        barrier = max(steps) if steps else 0
+        plan = ShardPlan(
+            version=self._plan.version + 1, phase=PLAN_STEADY,
+            world=len(members),
+            ranks={idx: r for r, idx in enumerate(members)},
+            barrier_step=barrier, incarnation=self.incarnation,
+            fleet_size=self.num_workers)
+        self._write_plan(plan)
+        st["stage"], st["version"] = "released", plan.version
+        self.resizes += 1
+        self.registry.counter(
+            FLEET_RESIZES_TOTAL, "elastic gang resizes by direction",
+            direction=st["kind"],
+        ).inc()
+        self._m_size.set(plan.world)
+        if st["kind"] == "shrink":
+            self.flightrec.emit("fleet_shrink", worker=st["worker"],
+                                world=plan.world, barrier=barrier,
+                                cause=st["cause"])
+        else:
+            self._workers[st["worker"]].member = True
+            self.flightrec.emit("fleet_rejoin", worker=st["worker"],
+                                world=plan.world, barrier=barrier)
+        logger.warning("elastic: %s released at barrier step %d "
+                       "(world %d, plan v%d)", st["kind"], barrier,
+                       plan.world, plan.version)
+
+    def _ensure_dead(self, w: _Worker) -> None:
+        """Make one worker's death final before its slot is rewired:
+        terminate (grace for a coordinated save), kill past the grace,
+        reap."""
+        if w.handle.poll() is None:
+            w.handle.terminate()
+            deadline = self.clock() + self.cfg.term_grace_s
+            while w.handle.poll() is None and self.clock() < deadline:
+                self._wait(min(self.cfg.poll_s, self.cfg.term_grace_s / 4))
+            if w.handle.poll() is None:
+                w.handle.kill()
+        try:
+            w.handle.wait(timeout=5.0)
+        except Exception as e:  # reap is best-effort bookkeeping
+            logger.warning("fleet: reaping worker %d failed: %r", w.index, e)
 
     def _preempted_teardown(self) -> None:
         """The fleet process itself was SIGTERMed: stop the gang (the

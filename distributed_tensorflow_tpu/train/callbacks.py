@@ -81,6 +81,51 @@ class HeartbeatCallback(Callback):
             self.writer.beat(step=step)
 
 
+class ElasticCallback(Callback):
+    """Step-seam adapter for the elastic fleet client
+    (resilience/fleet.ElasticWorker): after every completed step the
+    client polls the fleet's SHARD_PLAN, applies any new sharding to the
+    worker's data stream (``ElasticStream.reshard`` through
+    ``on_reshard``), and — when the fleet orders a resize hold — PAUSES
+    the loop here, at a step boundary, until the release names the
+    barrier. Pairs with a ``HeartbeatCallback`` on the same writer so
+    liveness continues through the pause (the client beats while
+    holding). Place it BEFORE the CheckpointCallback: a hold must land
+    between steps, not between a step and its cadence save.
+
+    A barrier hold is a sanctioned off-the-train-path pause, so its
+    wall time is broadcast to every ``note_pause``-aware peer callback
+    (the PR 11 protocol the mid-train eval uses): the cadence meters
+    keep measuring the train loop — the fleet books the same window as
+    ``elastic_resize`` waste, and double-booking it as productive would
+    lie twice — and an armed ``Watchdog`` re-arms at the pause boundary
+    instead of aborting the holder mid-resize."""
+
+    def __init__(self, client, clock=time.perf_counter):
+        self.client = client
+        self.clock = clock
+
+    def _poll(self, trainer, step):
+        t0 = self.clock()
+        self.client.poll(step)
+        pause = self.clock() - t0
+        if pause > 0:
+            for other in trainer.callbacks:
+                if other is self:
+                    continue
+                note = getattr(other, "note_pause", None)
+                if note is not None:
+                    note(pause)
+
+    def on_train_start(self, trainer):
+        # apply whatever plan is already on disk before the first step
+        # (a worker launched mid-resize must not train a stale shard)
+        self._poll(trainer, int(trainer.state.step))
+
+    def on_step_end(self, trainer, step, metrics):
+        self._poll(trainer, step)
+
+
 class StopAtStep(Callback):
     """$TF basic_session_run_hooks.py:393 StopAtStepHook."""
 

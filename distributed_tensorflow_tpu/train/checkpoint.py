@@ -124,15 +124,20 @@ class Checkpointer:
 
     def __init__(self, cfg: CheckpointConfig, mesh: Mesh, spec_tree: Any = None,
                  io_retry: RetryPolicy | None = None, registry=None,
-                 flightrec=None):
+                 flightrec=None, heartbeat=None):
         """``io_retry``: transient-IO retry budget applied to the save /
         restore / manifest-write seams (sites ``ckpt_save`` /
         ``ckpt_restore`` / ``ckpt_manifest_write``); defaults to a
         3-attempt exponential policy. ``registry``: obs.Registry for the
         retry counters (default: the process-wide one). ``flightrec``:
         obs.FlightRecorder for checkpoint lifecycle events (save /
-        restore / quarantine; default: the process-wide ring). Kept out
-        of CheckpointConfig so the config stays JSON-serializable."""
+        restore / quarantine; default: the process-wide ring).
+        ``heartbeat``: optional fleet heartbeat writer
+        (resilience/fleet.HeartbeatWriter, duck-typed ``beat``/``phase``)
+        — saves beat phase ``save`` for their duration, so the fleet's
+        elastic path can tell a death that landed mid-checkpoint (step
+        dir possibly torn → gang-stop fallback) from a clean one. Kept
+        out of CheckpointConfig so the config stays JSON-serializable."""
         if not cfg.directory:
             raise ValueError("CheckpointConfig.directory is required")
         self.cfg = cfg
@@ -140,6 +145,7 @@ class Checkpointer:
         self.spec_tree = spec_tree
         self.io_retry = io_retry if io_retry is not None else RetryPolicy()
         self.registry = registry
+        self.heartbeat = heartbeat
         self.flightrec = (flightrec if flightrec is not None
                           else flightrec_lib.default_recorder())
         self.watcher = PreemptionWatcher() if cfg.save_on_preemption else None
@@ -154,6 +160,12 @@ class Checkpointer:
         self._finite_check = None
         #: (step, thread) for in-flight async manifest stampers
         self._manifest_threads: list[tuple[int, threading.Thread]] = []
+        #: save-sequence counter guarding the heartbeat save-phase
+        #: window: a phase-restore thread only restores if NO newer save
+        #: started meanwhile (back-to-back async saves must not clear
+        #: the phase while the newer save's shard writes are in flight)
+        self._hb_lock = threading.Lock()
+        self._hb_save_seq = 0
 
     # -- save -------------------------------------------------------------
     def maybe_save(self, step: int, state: Any) -> bool:
@@ -236,13 +248,45 @@ class Checkpointer:
         # the heavy shard writes happen later on orbax's own threads (their
         # failures surface at wait_until_finished); the sync path — and the
         # metadata/dispatch work of the async one — gets the retry budget.
-        saved = retry_call(
-            lambda: self.manager.save(
-                step, args=ocp.args.StandardSave(state), force=force
-            ),
-            policy=self.io_retry, site="ckpt_save", registry=self.registry,
-            flightrec=self.flightrec,
-        )
+        prev_phase = None
+        seq = 0
+        if self.heartbeat is not None:
+            # phase "save" for the WRITE's duration — including the
+            # async shard writes on orbax's background threads, not just
+            # the dispatch: a worker that dies anywhere inside this
+            # window may leave a torn step dir, and the fleet's elastic
+            # path reads the phase to fall back to a gang stop instead
+            # of shrinking around unverified state. ("save" never nests:
+            # a prior save's pending restore must not be re-captured.)
+            prev_phase = self.heartbeat.phase
+            if prev_phase == "save":
+                prev_phase = "train"
+            with self._hb_lock:
+                self._hb_save_seq += 1
+                seq = self._hb_save_seq
+            self.heartbeat.beat(step=step, phase="save")
+        saved = False
+        try:
+            saved = retry_call(
+                lambda: self.manager.save(
+                    step, args=ocp.args.StandardSave(state), force=force
+                ),
+                policy=self.io_retry, site="ckpt_save", registry=self.registry,
+                flightrec=self.flightrec,
+            )
+        finally:
+            if self.heartbeat is not None:
+                if saved and self.cfg.async_save:
+                    # the heavy shard writes are still in flight on
+                    # orbax's threads: restore the phase only once the
+                    # commit lands
+                    threading.Thread(
+                        target=self._restore_phase_after_commit,
+                        args=(prev_phase, seq), daemon=True,
+                        name=f"ckpt-hb-phase-{step}",
+                    ).start()
+                else:
+                    self._restore_phase(prev_phase, seq)
         if saved:
             self.flightrec.emit("ckpt_save", step=step, trigger=trigger)
         if saved and cluster.is_chief():
@@ -267,6 +311,29 @@ class Checkpointer:
     # -- native CRC manifest (runtime/io.py integration) -------------------
     def _step_dir(self, step: int) -> str:
         return step_dir(self.cfg.directory, step)
+
+    def _restore_phase(self, prev_phase: str, seq: int) -> None:
+        """Restore the pre-save heartbeat phase — unless a NEWER save
+        already started (its own 'save' window must not be cleared by a
+        stale restore), or something else owns the phase now (a resize
+        barrier hold, a terminal phase): this thread only ever CLEARS
+        the 'save' it set."""
+        with self._hb_lock:
+            if self._hb_save_seq != seq:
+                return  # a newer save owns the phase now
+            if self.heartbeat.phase != "save":
+                return  # barrier/terminal phase owns it — never clobber
+            self.heartbeat.beat(phase=prev_phase)
+
+    def _restore_phase_after_commit(self, prev_phase: str, seq: int) -> None:
+        try:
+            self.manager.wait_until_finished()
+        except Exception:
+            # the failure surfaces to the caller at the next wait(); the
+            # phase must still be restored or "save" sticks forever
+            logger.exception("async commit failed while heartbeat phase "
+                             "'save' was held")
+        self._restore_phase(prev_phase, seq)
 
     def _manifest_after_commit(self, step: int) -> None:
         try:
@@ -355,18 +422,28 @@ class Checkpointer:
                 os.fsync(f.fileno())
             os.replace(tmp, path)
 
-    def wait(self) -> None:
+    def wait(self, manifest_join_s: float = 60.0) -> None:
+        """Drain pending async commits AND their manifest stampers.
+
+        Every in-flight stamper thread is joined here with a bounded
+        ``manifest_join_s`` timeout — saves only PRUNE dead entries from
+        ``_manifest_threads``, so without this join the LAST save's
+        stamper would be orphaned at exit and its checkpoint would
+        silently lack MANIFEST.dtf. Stragglers that outlive the bound
+        are logged BY STEP (so the operator knows exactly which
+        checkpoint may be missing its integrity manifest) and kept for a
+        later wait()/close() to retry the join."""
         self.manager.wait_until_finished()
         still_alive: list[tuple[int, threading.Thread]] = []
         for step, t in self._manifest_threads:
-            t.join(timeout=60)
+            t.join(timeout=manifest_join_s)
             if t.is_alive():
                 # never silently drop a stamper: the step's restore-time
                 # integrity check depends on MANIFEST.dtf existing
                 logger.error(
-                    "manifest thread for step %d still running after 60s "
-                    "join; MANIFEST.dtf for that checkpoint may be missing",
-                    step,
+                    "manifest thread for step %d still running after "
+                    "%.1fs join; MANIFEST.dtf for that checkpoint may be "
+                    "missing", step, manifest_join_s,
                 )
                 still_alive.append((step, t))
         self._manifest_threads = still_alive

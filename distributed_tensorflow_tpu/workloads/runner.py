@@ -87,6 +87,37 @@ class TrainSection:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetSection:
+    """This process's membership in a FleetSupervisor gang
+    (resilience/fleet.py). ``dir`` is the fleet control dir
+    (INCARNATION / RESTORE_STEP / SHARD_PLAN / heartbeats); empty =
+    standalone run. With ``elastic`` the runner reads the current
+    SHARD_PLAN at startup — worker-sharded data via
+    ``data/pipeline.ElasticStream``, mesh respec'd through
+    ``parallel.rescale_for_world`` — and follows live resizes from the
+    step seam (``callbacks.ElasticCallback``). One jax process per fleet
+    worker: the worker shard replaces process-count data sharding."""
+
+    dir: str = ""
+    worker: int = 0
+    elastic: bool = False
+    # worker-side budget for an abandoned resize hold. SIZE AT OR ABOVE
+    # the fleet's FleetConfig.hold_timeout_s: if the worker gives up
+    # first, a legitimate slow resize turns into an attempt restart
+    # while the fleet still counts this worker as holding.
+    hold_timeout_s: float = 120.0
+
+    def __post_init__(self):
+        if self.worker < 0:
+            raise ValueError("fleet.worker must be >= 0")
+        if self.elastic and not self.dir:
+            raise ValueError("fleet.elastic=true needs fleet.dir (the "
+                             "SHARD_PLAN lives there)")
+        if self.hold_timeout_s <= 0:
+            raise ValueError("fleet.hold_timeout_s must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class RunConfig:
     workload: str = "mnist_mlp"
     model: Any = None  # workload-specific config dataclass, set by preset
@@ -96,6 +127,7 @@ class RunConfig:
     optimizer: OptimizerConfig = OptimizerConfig()
     train: TrainSection = TrainSection()
     checkpoint: CheckpointConfig = CheckpointConfig()
+    fleet: FleetSection = FleetSection()
 
 
 @dataclasses.dataclass
@@ -219,7 +251,25 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
     (models embedding collective schedules — seq-parallel attention,
     pipeline stages — need it at construction; others ignore it)."""
     cluster.initialize(cfg.cluster)
-    mesh = build_mesh(cfg.mesh)
+    fleet_writer = fleet_plan = None
+    mesh_spec = cfg.mesh
+    if cfg.fleet.dir:
+        from ..parallel import rescale_for_world
+        from ..resilience import fleet as fleet_lib
+
+        fleet_writer = fleet_lib.HeartbeatWriter(
+            fleet_lib.heartbeat_path(cfg.fleet.dir, cfg.fleet.worker),
+            incarnation=fleet_lib.read_incarnation(cfg.fleet.dir))
+        if cfg.fleet.elastic:
+            fleet_plan = fleet_lib.read_shard_plan(cfg.fleet.dir)
+            if fleet_plan is not None:
+                # the config's mesh is authored for the NOMINAL fleet;
+                # a shrunken gang gets the batch axes rescaled to the
+                # surviving world (parameter axes never resize)
+                mesh_spec = rescale_for_world(
+                    cfg.mesh, fleet_plan.fleet_size or fleet_plan.world,
+                    fleet_plan.world)
+    mesh = build_mesh(mesh_spec)
     if cluster.is_chief():
         logger.info("mesh: %s", describe(mesh))
         logger.info("config:\n%s", config_lib.to_json(cfg))
@@ -232,7 +282,9 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
 
     ckpt = None
     if cfg.checkpoint.directory:
-        ckpt = Checkpointer(cfg.checkpoint, mesh)
+        # heartbeat: saves beat phase "save" so the fleet's elastic path
+        # can tell a mid-checkpoint death (gang-stop) from a clean one
+        ckpt = Checkpointer(cfg.checkpoint, mesh, heartbeat=fleet_writer)
         state, specs, restored = init_or_restore(
             ckpt, parts.init_fn, tx, mesh, rng,
             param_rules=parts.param_rules, param_specs=parts.param_specs,
@@ -278,6 +330,13 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
 
     start_step = int(state.step)
     policy = None
+    if cfg.train.anomaly_defense and cfg.fleet.elastic:
+        raise ValueError(
+            "train.anomaly_defense and fleet.elastic are mutually "
+            "exclusive: both must own the raw stream cursor (the blame "
+            "index and the reshard barrier bind to it) — run the elastic "
+            "fleet with the in-graph guard alone, or the anomaly defense "
+            "outside an elastic gang")
     if cfg.train.anomaly_defense:
         if not cfg.checkpoint.directory:
             raise ValueError(
@@ -299,8 +358,79 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
             AnomalyConfig(skip_budget=cfg.train.anomaly_skip_budget),
             index_fn=lambda: data.raw,
         )
+    elif cfg.fleet.elastic:
+        from ..data.pipeline import ElasticStream, WorkerShard
+        from ..resilience import fleet as fleet_lib
+
+        from ..parallel import BATCH_AXES, mesh_axis_size
+
+        batch_extent = mesh_axis_size(mesh, BATCH_AXES)
+
+        def _check_world(world: int) -> None:
+            # WorkerShard tolerates ragged slices, but the device
+            # placement path does not: put_host_batch shards the batch
+            # dim over the mesh batch axes, so every worker's slice must
+            # be uniform AND divide the mesh's batch-axes extent — fail
+            # at config/reshard time with the fix named, not at the
+            # first step with a shape error
+            if cfg.data.global_batch_size % world != 0:
+                raise ValueError(
+                    f"data.global_batch_size={cfg.data.global_batch_size} "
+                    f"not divisible by elastic world={world}: worker "
+                    f"slices must be uniform to shard across the mesh "
+                    f"batch axes — pick a global batch divisible by "
+                    f"every fleet size the gang can shrink to")
+            local = cfg.data.global_batch_size // world
+            if local % batch_extent != 0:
+                raise ValueError(
+                    f"per-worker slice {local} "
+                    f"(global_batch_size={cfg.data.global_batch_size} / "
+                    f"world={world}) not divisible by the mesh batch-axes "
+                    f"extent {batch_extent}: pick a global batch whose "
+                    f"per-world slices divide the mesh for every fleet "
+                    f"size the gang can shrink to")
+
+        shard = None
+        if fleet_plan is not None:
+            _check_world(fleet_plan.world)
+            rank = fleet_plan.ranks.get(cfg.fleet.worker)
+            if rank is not None:
+                shard = WorkerShard(rank, fleet_plan.world)
+
+        def _on_reshard(rank, world, at):
+            _check_world(world)
+            data.reshard(
+                WorkerShard(rank, world) if rank is not None else None, at)
+
+        # no Prefetcher: a prefetch depth would run the stream cursor
+        # past the barrier a live reshard binds to (ElasticStream
+        # docstring — same rule as the anomaly defense's blame cursor)
+        data = ElasticStream(parts.dataset_fn, shard,
+                             start_index=start_step)
+        elastic_client = fleet_lib.ElasticWorker(
+            cfg.fleet.dir, cfg.fleet.worker, fleet_writer,
+            on_reshard=_on_reshard,
+            hold_timeout_s=cfg.fleet.hold_timeout_s)
+        if (fleet_plan is not None
+                and fleet_plan.phase == fleet_lib.PLAN_STEADY):
+            # pre-ack ONLY a steady plan. A PLAN_HOLD naming this worker
+            # must go through poll() -> _hold at train start: pre-acking
+            # it would skip the barrier handshake and stall the fleet's
+            # resize until hold_timeout_s (restarted-worker-races-resize)
+            elastic_client.applied_version = fleet_plan.version
+            fleet_writer.note_plan(fleet_plan.version, fleet_plan.world)
+        # before the CheckpointCallback: a resize hold must land between
+        # steps, never between a step and its cadence save
+        ckpt_at = next(
+            (i for i, c in enumerate(callbacks)
+             if isinstance(c, cb.CheckpointCallback)), len(callbacks))
+        callbacks.insert(ckpt_at, cb.ElasticCallback(elastic_client))
     else:
         data = Prefetcher(parts.dataset_fn(start_step), depth=2)
+    if fleet_writer is not None:
+        # first: the heartbeat must record the step even when a later
+        # callback raises (PreemptionSaved skips the rest of the round)
+        callbacks.insert(0, cb.HeartbeatCallback(fleet_writer))
 
     trainer = Trainer(step_fn, state, mesh, specs, callbacks=callbacks,
                       anomaly_policy=policy)
@@ -320,6 +450,8 @@ def run(cfg: RunConfig, build: Callable[[RunConfig, Any], WorkloadParts],
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
+    if fleet_writer is not None:
+        fleet_writer.close()
     return RunResult(state, metrics_logger.history, eval_metrics, mesh)
 
 

@@ -46,6 +46,16 @@ Markers on stdout (the drivers assert on these):
                              fleet worker reached the target step
     FLEET-PREEMPTED step=K   fleet worker exited via a preemption save
     FLEET-FAILED cause=C     fleet worker's in-process supervision exhausted
+    FLEET-DYING step=K       scripted --die-at hard exit (elastic rounds)
+
+With ``--elastic`` the worker additionally follows the fleet's
+SHARD_PLAN (resilience/fleet.ElasticWorker): it pauses at resize
+barriers, acknowledges plans through its heartbeat, and appends every
+applied ``(rank, world, at)`` to ``<workdir>/reshard_log.jsonl`` — the
+consistency oracle the elastic E2E reads. The rig is collective-free,
+so every worker trains on the FULL global batch (the stand-in for the
+data-parallel allreduce); the recorded schedule, not the tensors, is
+what a resize changes here.
 """
 
 import argparse
@@ -302,12 +312,71 @@ def _fleet(args, mesh, model, tx) -> int:
         callbacks as cb, init_or_restore, make_train_step,
     )
 
+    class _DieAt(cb.Callback):
+        """Hard, uncoordinated death at an exact global step — the
+        elastic round's scripted fault. os._exit skips every handler
+        and atexit hook: no preemption save, no final heartbeat — the
+        fleet sees a raw nonzero exit (classified transient). The
+        launcher owns the schedule (pass --die-at only to the launch
+        that should die)."""
+
+        def __init__(self, step):
+            self.step = step
+
+        def on_step_end(self, trainer, step, metrics):
+            if step == self.step:
+                print(f"FLEET-DYING step={step}", flush=True)
+                os._exit(86)
+
+    class _StepSleep(cb.Callback):
+        """Slow the loop so real-subprocess elastic rounds overlap: the
+        members must still be training when the replacement comes up.
+        Pure pacing — wall time never feeds the trajectory."""
+
+        def __init__(self, seconds):
+            self.seconds = seconds
+
+        def on_step_end(self, trainer, step, metrics):
+            import time
+
+            time.sleep(self.seconds)
+
     incarnation = fleet_lib.read_incarnation(args.fleet_dir)
     writer = fleet_lib.HeartbeatWriter(
         fleet_lib.heartbeat_path(args.fleet_dir, args.worker_index),
         incarnation=incarnation,
     )
     ceiling = fleet_lib.read_restore_step(args.fleet_dir)
+    elastic_client = None
+    if args.elastic:
+        plan = fleet_lib.read_shard_plan(args.fleet_dir)
+        if plan is not None and args.worker_index not in plan.ranks:
+            # we are a catching-up replacement (elastic shrink relaunch),
+            # not a gang-restarted member: any RESTORE_STEP on disk
+            # belongs to an earlier gang restart and must not roll our
+            # restore back below our own newest valid step
+            ceiling = None
+
+        # replica-mode reshard seam: the collective-free rig trains
+        # every worker on the FULL global batch (the stand-in for the
+        # data-parallel allreduce), so a reshard changes no tensor —
+        # the realized schedule is recorded for the E2E consistency
+        # oracle instead (same (world, barrier) sequence on every
+        # survivor, ranks a bijection)
+        reshard_log = os.path.join(args.workdir, "reshard_log.jsonl")
+
+        def on_reshard(rank, world, at):
+            import json
+
+            os.makedirs(args.workdir, exist_ok=True)
+            with open(reshard_log, "a") as f:
+                f.write(json.dumps(
+                    {"rank": rank, "world": world, "at": at,
+                     "incarnation": incarnation}) + "\n")
+
+        elastic_client = fleet_lib.ElasticWorker(
+            args.fleet_dir, args.worker_index, writer,
+            on_reshard=on_reshard)
     faults = []
     if incarnation == args.fault_incarnation:
         # the incarnation counter is the cross-process fired-state: a
@@ -331,6 +400,10 @@ def _fleet(args, mesh, model, tx) -> int:
                              max_to_keep=10, async_save=False,
                              preemption_check_every=1),
             mesh,
+            # elastic: saves beat phase "save" so a death landing
+            # mid-checkpoint makes the fleet gang-stop, never shrink
+            # around a possibly-torn step dir
+            heartbeat=writer if args.elastic else None,
         )
         state, specs, restored = init_or_restore(
             ckpt, common.make_init_fn(model, (8,)), tx, mesh,
@@ -344,14 +417,22 @@ def _fleet(args, mesh, model, tx) -> int:
         start = int(state.step)
         if restored:
             writer.note_restore(start, fallback=True)
+        # heartbeat FIRST: it must record the step even when
+        # CheckpointCallback raises PreemptionSaved (which skips every
+        # later callback for that step), and before the fault callback
+        # can hang the loop; the elastic poll sits between heartbeat and
+        # checkpoint so a resize hold lands between steps
+        callbacks = [cb.HeartbeatCallback(writer)]
+        if elastic_client is not None:
+            callbacks.append(cb.ElasticCallback(elastic_client))
+        callbacks += [cb.CheckpointCallback(ckpt), plan.callback()]
+        if args.die_at is not None:
+            callbacks.append(_DieAt(args.die_at))
+        if args.step_sleep > 0:
+            callbacks.append(_StepSleep(args.step_sleep))
         trainer = Trainer(
             make_train_step(loss_fn, tx, StepOptions()), state, mesh, specs,
-            # heartbeat FIRST: it must record the step even when
-            # CheckpointCallback raises PreemptionSaved (which skips
-            # every later callback for that step), and before the fault
-            # callback can hang the loop
-            callbacks=[cb.HeartbeatCallback(writer),
-                       cb.CheckpointCallback(ckpt), plan.callback()],
+            callbacks=callbacks,
         )
         return trainer, plan.wrap(batches_from(start), start=start), ckpt
 
@@ -449,6 +530,18 @@ def main(argv=None) -> int:
     ap.add_argument("--fault-incarnation", type=int, default=1,
                     help="fleet mode: inject faults only when the fleet "
                          "incarnation equals this (default 1 — first launch)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="fleet mode: follow the fleet's SHARD_PLAN "
+                         "(elastic resize client: barrier holds, reshard "
+                         "schedule recorded to <workdir>/reshard_log.jsonl)")
+    ap.add_argument("--die-at", type=int, default=None,
+                    help="fleet mode: hard os._exit at this GLOBAL step "
+                         "(no save, no final heartbeat — the elastic "
+                         "round's scripted death; the LAUNCHER gates which "
+                         "launch gets it)")
+    ap.add_argument("--step-sleep", type=float, default=0.0,
+                    help="fleet mode: sleep this long after every step "
+                         "(pacing for real-subprocess elastic rounds)")
     args = ap.parse_args(argv)
     if args.fleet and not args.fleet_dir:
         raise SystemExit("--fleet requires --fleet-dir")

@@ -317,3 +317,42 @@ def test_heartbeat_callback_beats_from_step_seam(tmp_path):
     hb_cb.on_step_end(t, 2, {})
     hb = fl.read_heartbeat(str(tmp_path / "hb.json"))
     assert hb.seq == seq0 + 1 and hb.step == 2
+
+
+def test_elastic_callback_reports_hold_as_pause():
+    """A resize barrier hold is a sanctioned pause: its wall time is
+    broadcast to every note_pause-aware peer (cadence meters keep
+    measuring the train loop; an armed Watchdog re-arms at the
+    boundary) and never booked as a step."""
+
+    class FakeClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = FakeClock()
+
+    class HoldingClient:
+        def __init__(self):
+            self.polled = []
+
+        def poll(self, step):
+            self.polled.append(step)
+            clk.t += 7.5  # the fleet held us for 7.5s
+
+    class Peer(cb.Callback):
+        def __init__(self):
+            self.pauses = []
+
+        def note_pause(self, seconds):
+            self.pauses.append(seconds)
+
+    client, peer = HoldingClient(), Peer()
+    ecb = cb.ElasticCallback(client, clock=clk)
+    t = StubTrainer()
+    t.callbacks = [ecb, peer]
+    ecb.on_step_end(t, 3, {})
+    assert client.polled == [3]
+    assert peer.pauses == [7.5]

@@ -5,8 +5,10 @@ import pytest
 
 from distributed_tensorflow_tpu.data import (
     DataConfig,
+    ElasticStream,
     Prefetcher,
     SyntheticClassification,
+    WorkerShard,
     local_batch_size,
 )
 
@@ -60,6 +62,91 @@ def test_npz_dataset_bounded_and_offset(tmp_path):
     cont = list(NpzDataset(path, cfg, num_batches=3, index_offset=7))
     straight = list(NpzDataset(path, cfg, num_batches=10))
     np.testing.assert_array_equal(cont[0]["image"], straight[7]["image"])
+
+
+def _global_batches(i0):
+    """QuarantineFilter/ElasticStream contract: first batch is global
+    index i0 + 1; batch i is a pure function of i."""
+    i = i0
+    while True:
+        i += 1
+        yield {"x": np.arange(12, dtype=np.int64) * 100 + i,
+               "y": np.full(12, i)}
+
+
+def test_worker_shard_slices_partition_the_batch():
+    batch = next(_global_batches(0))
+    shards = [WorkerShard(r, 3) for r in range(3)]
+    pieces = [s.slice(batch) for s in shards]
+    # disjoint, union == the global batch (order-insensitive), and
+    # well-defined for 12 % 3 == 0 AND ragged worlds
+    got = np.sort(np.concatenate([p["x"] for p in pieces]))
+    np.testing.assert_array_equal(got, np.sort(batch["x"]))
+    ragged = [WorkerShard(r, 5).slice(batch)["x"] for r in range(5)]
+    assert sorted(len(p) for p in ragged) == [2, 2, 2, 3, 3]
+    np.testing.assert_array_equal(
+        np.sort(np.concatenate(ragged)), np.sort(batch["x"]))
+    with pytest.raises(ValueError, match="rank"):
+        WorkerShard(3, 3)
+    with pytest.raises(ValueError, match="world"):
+        WorkerShard(0, 0)
+
+
+def test_elastic_stream_live_reshard_is_pure_in_schedule():
+    """The determinism contract: a live reshard at index B delivers
+    EXACTLY the slices a fresh stream built with the same schedule
+    would — the trajectory is a pure function of (seed, schedule)."""
+    live = ElasticStream(_global_batches, WorkerShard(0, 3))
+    out = [next(live) for _ in range(3)]          # batches 1..3 at 0/3
+    live.reshard(WorkerShard(0, 2), at_index=5)   # shrink binds to >5
+    out += [next(live) for _ in range(4)]         # 4,5 at 0/3; 6,7 at 0/2
+    live.reshard(WorkerShard(1, 3), at_index=8)   # rejoin, new rank
+    out += [next(live) for _ in range(3)]         # 8 at 0/2; 9,10 at 1/3
+    assert live.schedule == [(5, 0, 2), (8, 1, 3)]
+
+    def replay(i):
+        shard = (WorkerShard(0, 3) if i <= 5
+                 else WorkerShard(0, 2) if i <= 8 else WorkerShard(1, 3))
+        return shard.slice(
+            {"x": np.arange(12, dtype=np.int64) * 100 + i,
+             "y": np.full(12, i)})
+
+    for i, got in enumerate(out, start=1):
+        want = replay(i)
+        np.testing.assert_array_equal(got["x"], want["x"])
+        np.testing.assert_array_equal(got["y"], want["y"])
+
+
+def test_elastic_stream_reshard_behind_cursor_applies_now():
+    s = ElasticStream(_global_batches, WorkerShard(0, 2), start_index=4)
+    first = next(s)                      # batch 5 at 0/2
+    assert first["y"][0] == 5 and len(first["x"]) == 6
+    s.reshard(WorkerShard(1, 4), at_index=3)  # barrier already behind
+    nxt = next(s)                        # batch 6, new shard immediately
+    assert len(nxt["x"]) == 3
+    np.testing.assert_array_equal(
+        nxt["x"], (np.arange(12, dtype=np.int64) * 100 + 6)[1::4])
+
+
+def test_elastic_stream_none_shard_is_replica_mode():
+    """shard=None yields the FULL global batch — the collective-free
+    test rig's stand-in for the data-parallel allreduce."""
+    s = ElasticStream(_global_batches, None)
+    assert len(next(s)["x"]) == 12
+    s.reshard(WorkerShard(0, 2), at_index=1)
+    assert len(next(s)["x"]) == 6
+    s.reshard(None, at_index=2)
+    assert len(next(s)["x"]) == 12
+    assert s.schedule == [(1, 0, 2), (2, None, None)]
+
+
+def test_elastic_stream_newer_plan_supersedes_pending():
+    s = ElasticStream(_global_batches, WorkerShard(0, 2))
+    s.reshard(WorkerShard(0, 3), at_index=4)
+    s.reshard(WorkerShard(0, 4), at_index=2)  # newer plan, earlier barrier
+    out = [next(s) for _ in range(4)]
+    assert [len(b["x"]) for b in out] == [6, 6, 3, 3]
+    assert s.schedule == [(2, 0, 4)]  # the superseded switch never fired
 
 
 def test_prefetcher_order_and_completion():

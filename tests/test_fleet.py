@@ -301,14 +301,14 @@ class Scenario:
 
 
 def _mk_fleet(tmp_path, launch, clk, scenario, *, n=2, max_restarts=2,
-              ckpt_dirs=None):
+              ckpt_dirs=None, **cfg_kw):
     rec = FlightRecorder(clock=clk)
     reg = Registry()
     cfg = fl.FleetConfig(
         max_restarts=max_restarts,
         backoff=rz.RetryPolicy(base_s=0.0, jitter=0.0),
         poll_s=1.0, heartbeat_timeout_s=5.0, stall_timeout_s=10.0,
-        launch_grace_s=20.0, term_grace_s=4.0)
+        launch_grace_s=20.0, term_grace_s=4.0, **cfg_kw)
     fleet = fl.FleetSupervisor(
         launch, n, str(tmp_path / "fleet"), cfg, ckpt_dirs=ckpt_dirs,
         registry=reg, flightrec=rec, clock=clk, sleep=scenario.sleep)
@@ -353,7 +353,7 @@ def test_fleet_gang_restart_on_worker_death(tmp_path):
     sc.at(3.0, lambda: _beat(fleet_dir, 0, 1, clk, step=3))
 
     out = fleet.run()
-    assert out == {"restarts": 1, "incarnation": 2}
+    assert out == {"restarts": 1, "incarnation": 2, "resizes": 0}
     assert fl.read_incarnation(fleet_dir) == 2
     assert [(i, inc) for i, inc, _ in launches] == [
         (0, 1), (1, 1), (0, 2), (1, 2)]
@@ -573,6 +573,655 @@ def test_fleet_interrupt_wakes_default_wait():
 
 
 # ---------------------------------------------------------------------------
+# Elastic resize: plan file, worker client, supervisor state machine
+# ---------------------------------------------------------------------------
+
+
+def test_shard_plan_roundtrip_and_validation(tmp_path):
+    d = str(tmp_path / "fleet")
+    assert fl.read_shard_plan(d) is None
+    plan = fl.ShardPlan(version=3, phase=fl.PLAN_STEADY, world=2,
+                        ranks={0: 0, 2: 1}, barrier_step=7, incarnation=1,
+                        fleet_size=3)
+    fl.write_shard_plan(d, plan)
+    assert fl.read_shard_plan(d) == plan
+    hold = fl.ShardPlan(version=4, phase=fl.PLAN_HOLD, world=2,
+                        ranks={0: 0, 2: 1}, barrier_step=7, hold=(0, 2))
+    fl.write_shard_plan(d, hold)
+    assert fl.read_shard_plan(d).hold == (0, 2)
+    fl.clear_shard_plan(d)
+    assert fl.read_shard_plan(d) is None
+    # garbage reads as absent (conservative: keep the last applied plan)
+    with open(os.path.join(d, "SHARD_PLAN"), "w") as f:
+        f.write("{broken")
+    assert fl.read_shard_plan(d) is None
+    with pytest.raises(ValueError, match="phase"):
+        fl.ShardPlan(version=1, phase="frozen", world=1, ranks={0: 0},
+                     barrier_step=0)
+    with pytest.raises(ValueError, match="bijection"):
+        fl.ShardPlan(version=1, phase=fl.PLAN_STEADY, world=2,
+                     ranks={0: 0, 1: 2}, barrier_step=0)
+    with pytest.raises(ValueError, match="must be served"):
+        # an unserved rank would silently drop a slice of every batch
+        fl.ShardPlan(version=1, phase=fl.PLAN_STEADY, world=3,
+                     ranks={0: 0, 1: 1}, barrier_step=0)
+
+
+def test_fleet_config_validates_elastic_knobs(tmp_path):
+    """Satellite: the new elastic knobs fail fast with actionable
+    messages."""
+    with pytest.raises(ValueError, match="min_workers must be >= 1"):
+        fl.FleetConfig(elastic=True, min_workers=0)
+    with pytest.raises(ValueError, match="rejoin_grace_s must be > 0"):
+        fl.FleetConfig(elastic=True, rejoin_grace_s=0.0)
+    with pytest.raises(ValueError, match="hold_timeout_s must be > 0"):
+        fl.FleetConfig(elastic=True, hold_timeout_s=-1.0)
+    with pytest.raises(ValueError, match="incompatible with num_workers=1"):
+        fl.FleetSupervisor(lambda i, k: FakeProc(), 1, str(tmp_path),
+                           fl.FleetConfig(elastic=True),
+                           flightrec=FlightRecorder(), registry=Registry())
+    with pytest.raises(ValueError, match="min_workers=5 exceeds"):
+        fl.FleetSupervisor(lambda i, k: FakeProc(), 2, str(tmp_path),
+                           fl.FleetConfig(elastic=True, min_workers=5),
+                           flightrec=FlightRecorder(), registry=Registry())
+
+
+def test_newest_common_valid_step_over_subset(tmp_path):
+    """Satellite: the N-1 gang case — the ceiling is computed over the
+    dirs you PASS. A dead worker's behind dir, not passed, must not
+    veto; a member dir whose newer steps were evicted above a ceiling
+    must."""
+    w0, w1, dead = (str(tmp_path / n) for n in ("w0", "w1", "dead"))
+    for s in (2, 4, 6):
+        _fake_ckpt_step(w0, s)
+        _fake_ckpt_step(w1, s)
+    _fake_ckpt_step(dead, 2)  # died long ago, far behind
+    # full gang: the dead dir drags the ceiling down to 2
+    assert fl.newest_common_valid_step([w0, w1, dead]) == 2
+    # N-1 live members only: the dead worker cannot veto
+    assert fl.newest_common_valid_step([w0, w1]) == 6
+    # but an eviction DOES bind: once w1 rolled back to 4, the shrunken
+    # gang's ceiling must follow it
+    assert fl.evict_steps_above(w1, 4) == [6]
+    assert fl.newest_common_valid_step([w0, w1]) == 4
+    assert fl.valid_steps(w1) == [2, 4]
+
+
+def test_monitor_barrier_phase_is_not_a_stall(tmp_path):
+    """A member paused at a resize barrier beats with a frozen step for
+    as long as the fleet holds it — sanctioned, never a stall (the
+    fleet bounds holds with hold_timeout_s)."""
+    path = str(tmp_path / "hb.json")
+    clk = rz.FaultClock()
+    w = fl.HeartbeatWriter(path, incarnation=1, clock=clk)
+    m = _monitor(path, clk)
+    w.beat(step=5, phase="train")
+    assert m.check() == fl.LIVE
+    w.beat(phase="barrier")
+    for _ in range(8):               # way past the 10s stall budget
+        clk.advance(3.0)
+        w.beat()
+        assert m.check() == fl.LIVE
+    # released: train phase resumes, the stall clock rearms from here
+    w.beat(step=6, phase="train")
+    assert m.check() == fl.LIVE
+
+
+def test_elastic_worker_applies_steady_plan(tmp_path):
+    d = str(tmp_path / "fleet")
+    writer = fl.HeartbeatWriter(fl.heartbeat_path(d, 0), incarnation=1,
+                                clock=rz.FaultClock())
+    applied = []
+    ew = fl.ElasticWorker(d, 0, writer,
+                          on_reshard=lambda r, w, at: applied.append(
+                              (r, w, at)))
+    ew.poll(1)  # no plan yet
+    assert applied == []
+    fl.write_shard_plan(d, fl.ShardPlan(
+        version=1, phase=fl.PLAN_STEADY, world=3, ranks={0: 0, 1: 1, 2: 2},
+        barrier_step=0))
+    ew.poll(1)
+    ew.poll(2)  # same version: applied exactly once
+    assert applied == [(0, 3, 0)]
+    assert ew.assignment == (0, 3)
+    # a non-member (catching-up replacement) applies rank None
+    fl.write_shard_plan(d, fl.ShardPlan(
+        version=2, phase=fl.PLAN_STEADY, world=2, ranks={1: 0, 2: 1},
+        barrier_step=4))
+    ew.poll(3)
+    assert applied[-1] == (None, 2, 4)
+    hb = fl.read_heartbeat(fl.heartbeat_path(d, 0))
+    assert hb.plan_version == 2 and hb.world == 2
+
+
+def test_elastic_worker_holds_until_release(tmp_path):
+    """A hold naming this worker pauses poll() — heartbeat phase
+    ``barrier``, seq still ticking — until the release, whose sharding
+    is then applied; a hold not naming it is ignored."""
+    d = str(tmp_path / "fleet")
+    clk = rz.FaultClock()
+    writer = fl.HeartbeatWriter(fl.heartbeat_path(d, 0), incarnation=1,
+                                clock=clk)
+    writer.beat(step=3, phase="train")
+    applied = []
+    polls = {"n": 0}
+
+    def sleep(s):
+        clk.advance(s)
+        polls["n"] += 1
+        if polls["n"] == 3:  # release arrives while holding
+            fl.write_shard_plan(d, fl.ShardPlan(
+                version=3, phase=fl.PLAN_STEADY, world=1, ranks={0: 0},
+                barrier_step=5))
+
+    ew = fl.ElasticWorker(d, 0, writer, clock=clk, sleep=sleep,
+                          on_reshard=lambda r, w, at: applied.append(
+                              (r, w, at)))
+    # a hold entered during an async save window (phase 'save') must
+    # NOT re-instate 'save' after the release: the save's restore
+    # thread refuses to clobber the barrier, so a re-instated 'save'
+    # would stick forever and force every later death down the
+    # mid-checkpoint gang-stop path
+    writer.beat(phase="save")
+    fl.write_shard_plan(d, fl.ShardPlan(
+        version=2, phase=fl.PLAN_HOLD, world=2, ranks={0: 0, 1: 1},
+        barrier_step=0, hold=(0,)))
+    ew.poll(3)
+    assert applied == [(0, 1, 5)]
+    hb = fl.read_heartbeat(fl.heartbeat_path(d, 0))
+    assert hb.phase == "train" and hb.plan_version == 3  # never "save"
+    # a hold for OTHER workers does not pause us
+    fl.write_shard_plan(d, fl.ShardPlan(
+        version=4, phase=fl.PLAN_HOLD, world=1, ranks={0: 0},
+        barrier_step=5, hold=(1,)))
+    ew.poll(4)  # returns immediately
+    assert applied == [(0, 1, 5)]
+
+
+def test_elastic_worker_abandoned_hold_raises_transient(tmp_path):
+    d = str(tmp_path / "fleet")
+    clk = rz.FaultClock()
+    writer = fl.HeartbeatWriter(fl.heartbeat_path(d, 0), incarnation=1,
+                                clock=clk)
+    ew = fl.ElasticWorker(d, 0, writer, clock=clk,
+                          sleep=lambda s: clk.advance(s),
+                          hold_timeout_s=5.0)
+    fl.write_shard_plan(d, fl.ShardPlan(
+        version=2, phase=fl.PLAN_HOLD, world=2, ranks={0: 0, 1: 1},
+        barrier_step=0, hold=(0,)))
+    with pytest.raises(OSError, match="hold abandoned"):
+        ew.poll(3)
+    assert rz.classify_failure(OSError("elastic hold abandoned")) \
+        == rz.TRANSIENT
+
+
+def _elastic_fleet(tmp_path, launch, clk, sc, *, n=3, **kw):
+    kw.setdefault("elastic", True)
+    kw.setdefault("min_workers", 2)
+    kw.setdefault("rejoin_grace_s", 20.0)
+    kw.setdefault("hold_timeout_s", 50.0)
+    return _mk_fleet(tmp_path, launch, clk, sc, n=n, **kw)
+
+
+def test_elastic_shrink_and_rejoin_scripted(tmp_path):
+    """The full elastic state machine on scripted workers: death →
+    hold → survivor barrier acks → shrink release at the max paused
+    step → replacement launched, proves life → rejoin hold → release
+    at N with the rank map restored — zero gang restarts, zero
+    restart_recovery waste, the resize window booked as
+    elastic_resize."""
+    from distributed_tensorflow_tpu.obs import goodput
+
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    launches = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        launches.append((i, incarnation, p))
+        return p
+
+    fleet, rec, reg = _elastic_fleet(tmp_path, launch, clk, sc)
+    writers = {}
+
+    def w(i):
+        if i not in writers:
+            writers[i] = fl.HeartbeatWriter(
+                fl.heartbeat_path(fleet_dir, i), incarnation=1, clock=clk)
+        return writers[i]
+
+    def ack(i, version, world, step, phase):
+        w(i).note_plan(version, world)
+        w(i).beat(step=step, phase=phase)
+
+    def fin(i, launch_slot, step):
+        w(i).beat(step=step, phase="done")
+        launches[launch_slot][2].rc = 0
+
+    # t1: gang live at v1; t2: worker 1 dies hard
+    sc.at(1.0, lambda: [w(i).beat(step=2, phase="train") for i in (0, 1, 2)])
+    sc.at(2.0, lambda: setattr(launches[1][2], "rc", 86))
+    # survivors ack the hold (v2) at their paused steps 3 and 4
+    sc.at(3.0, lambda: (ack(0, 2, 3, 3, "barrier"),
+                        ack(2, 2, 3, 4, "barrier")))
+    # release (v3) applied: members resume at world 2
+    sc.at(4.0, lambda: (ack(0, 3, 2, 5, "train"), ack(2, 3, 2, 5, "train")))
+    # the replacement (slot 3 in launches) restores and proves life
+    def joiner_up():
+        del writers[1]  # fleet removed the corpse's file; fresh writer
+        jw = w(1)
+        jw.note_restore(2, fallback=True)
+        jw.beat(step=2, phase="train")
+    sc.at(5.0, joiner_up)
+    # members ack the rejoin hold (v4) at step 6
+    sc.at(6.0, lambda: (ack(0, 4, 2, 6, "barrier"),
+                        ack(2, 4, 2, 6, "barrier"), w(1).beat(step=3)))
+    # rejoin release (v5): everyone at world 3
+    sc.at(7.0, lambda: (ack(0, 5, 3, 7, "train"), ack(2, 5, 3, 7, "train"),
+                        ack(1, 5, 3, 4, "train")))
+    sc.at(8.0, lambda: (fin(0, 0, 8), fin(2, 2, 8), fin(1, 3, 8)))
+
+    out = fleet.run()
+    assert out == {"restarts": 0, "incarnation": 1, "resizes": 2}
+    # four launches: the initial gang + one replacement, all incarnation 1
+    assert [(i, inc) for i, inc, _ in launches] == [
+        (0, 1), (1, 1), (2, 1), (1, 1)]
+    plan = fl.read_shard_plan(fleet_dir)
+    assert plan.version == 5 and plan.phase == fl.PLAN_STEADY
+    assert plan.world == 3 and plan.ranks == {0: 0, 1: 1, 2: 2}
+    assert plan.barrier_step == 6 and plan.fleet_size == 3
+    assert fr.contains_in_order(rec.events(), [
+        ("fleet_start", {"workers": 3}),
+        ("fleet_worker_dead", {"worker": 1, "cause": rz.TRANSIENT}),
+        ("fleet_launch", {"worker": 1, "rejoin": True}),
+        ("fleet_shrink", {"worker": 1, "world": 2, "barrier": 4,
+                          "cause": rz.TRANSIENT}),
+        ("fleet_rejoin", {"worker": 1, "world": 3, "barrier": 6}),
+        ("fleet_done", {"incarnation": 1}),
+    ]), rec.events()
+    # no gang stop, no gang restart anywhere in the timeline
+    assert not fr.contains_in_order(rec.events(), ["fleet_gang_stop"])
+    assert not fr.contains_in_order(rec.events(), ["fleet_restart"])
+    assert reg.get(fl.FLEET_RESIZES_TOTAL, direction="shrink").value == 1
+    assert reg.get(fl.FLEET_RESIZES_TOTAL, direction="rejoin").value == 1
+    assert reg.get(fl.FLEET_SIZE).value == 3
+    assert reg.get(fl.FLEET_WORKER_DEATHS_TOTAL).value == 1
+    rr = reg.get(goodput.WASTED_SECONDS, cause=goodput.WASTE_RESTART_RECOVERY)
+    assert rr is None or rr.value == 0.0
+    resize_waste = reg.get(goodput.WASTED_SECONDS,
+                           cause=goodput.WASTE_ELASTIC_RESIZE)
+    assert resize_waste is not None and resize_waste.value > 0
+
+
+def test_elastic_waste_drops_10x_vs_gang_restart(tmp_path):
+    """The goodput acceptance, scripted on the injected clock: the same
+    single-death schedule costs the gang-restart baseline its whole
+    outage window (stop → backoff → relaunch → restore → live) in
+    restart_recovery, while the elastic path books zero there — well
+    past the 10x bar."""
+    from distributed_tensorflow_tpu.obs import goodput
+
+    # -- baseline: elastic OFF, relaunch takes 13 simulated seconds ----
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    launches = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        launches.append(p)
+        if incarnation == 2:
+            # the relaunched worker needs real (simulated) time for
+            # spawn + imports + restore before it proves life
+            sc.at(15.0, lambda: _beat(fleet_dir, i, 2, clk, step=8,
+                                      phase="done", restore=2))
+            sc.at(15.0, lambda: setattr(p, "rc", 0))
+        return p
+
+    fleet, rec, reg = _mk_fleet(tmp_path, launch, clk, sc, n=2)
+    sc.at(1.0, lambda: _beat(fleet_dir, 0, 1, clk, step=2))
+    sc.at(1.0, lambda: _beat(fleet_dir, 1, 1, clk, step=2))
+    sc.at(2.0, lambda: setattr(launches[1], "rc", 86))
+    fleet.run()
+    baseline = reg.get(goodput.WASTED_SECONDS,
+                       cause=goodput.WASTE_RESTART_RECOVERY)
+    assert baseline is not None and baseline.value >= 10.0
+
+    # -- elastic: same death schedule, survivors never stop ------------
+    clk2 = rz.FaultClock()
+    sc2 = Scenario(clk2)
+    fleet_dir2 = str(tmp_path / "fleet2")
+    launches2 = []
+
+    def launch2(i, incarnation):
+        p = FakeProc()
+        launches2.append((i, p))
+        return p
+
+    fleet2, rec2, reg2 = _elastic_fleet(
+        tmp_path / "e", launch2, clk2, sc2, n=2, min_workers=1)
+    fleet_dir2 = fleet2.workdir
+    writers = {}
+
+    def w(i):
+        if i not in writers:
+            writers[i] = fl.HeartbeatWriter(
+                fl.heartbeat_path(fleet_dir2, i), incarnation=1, clock=clk2)
+        return writers[i]
+
+    sc2.at(1.0, lambda: [w(i).beat(step=2, phase="train") for i in (0, 1)])
+    sc2.at(2.0, lambda: setattr(launches2[1][1], "rc", 86))
+    def hold_ack():
+        w(0).note_plan(2, 2)
+        w(0).beat(step=3, phase="barrier")
+    sc2.at(3.0, hold_ack)
+    def release_ack():
+        w(0).note_plan(3, 1)
+        w(0).beat(step=4, phase="train")
+    sc2.at(4.0, release_ack)
+    # the member keeps TRAINING (and beating) through the whole window
+    # the baseline spent relaunching — that is the entire point
+    for t, s in ((6.0, 5), (8.0, 6), (10.0, 7), (12.0, 8), (14.0, 9)):
+        sc2.at(t, lambda s=s: w(0).beat(step=s, phase="train"))
+    def joiner_up():
+        del writers[1]
+        jw = w(1)
+        jw.beat(step=2, phase="train")
+    sc2.at(15.0, joiner_up)  # replacement takes just as long to come up
+    def rejoin_acks():
+        w(0).note_plan(4, 1)
+        w(0).beat(step=9, phase="barrier")
+    sc2.at(16.0, rejoin_acks)
+    def rejoin_apply():
+        w(0).note_plan(5, 2)
+        w(0).beat(step=10, phase="train")
+        w(1).note_plan(5, 2)
+        w(1).beat(step=3, phase="train")
+    sc2.at(17.0, rejoin_apply)
+    def fins():
+        w(0).beat(step=12, phase="done")
+        launches2[0][1].rc = 0
+        w(1).beat(step=12, phase="done")
+        launches2[2][1].rc = 0
+    sc2.at(18.0, fins)
+    out = fleet2.run()
+    assert out["restarts"] == 0 and out["resizes"] == 2
+    rr = reg2.get(goodput.WASTED_SECONDS,
+                  cause=goodput.WASTE_RESTART_RECOVERY)
+    elastic_rr = rr.value if rr is not None else 0.0
+    # the acceptance bar: >= 10x drop for the same death schedule
+    assert elastic_rr * 10 <= baseline.value
+    # while the survivors' only cost is the barrier window, booked
+    # under the dedicated cause
+    assert reg2.get(goodput.WASTED_SECONDS,
+                    cause=goodput.WASTE_ELASTIC_RESIZE).value > 0
+
+
+def test_outage_window_spans_chained_gang_restarts(tmp_path):
+    """A relaunched worker dying again before the gang confirms live
+    must not restart the outage clock: restart_recovery spans the FIRST
+    gang stop to the first gang that actually comes live."""
+    from distributed_tensorflow_tpu.obs import goodput
+
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        if incarnation == 2:
+            # dies during restore, before ever beating
+            sc.at(5.0, lambda: setattr(p, "rc", 86))
+        if incarnation == 3:
+            sc.at(10.0, lambda: _beat(fleet_dir, i, 3, clk, step=8,
+                                      phase="done", restore=0))
+            sc.at(10.0, lambda: setattr(p, "rc", 0))
+        if incarnation == 1:
+            sc.at(2.0, lambda: setattr(p, "rc", 86))
+        return p
+
+    fleet, rec, reg = _mk_fleet(tmp_path, launch, clk, sc, n=1,
+                                max_restarts=3)
+    sc.at(1.0, lambda: _beat(fleet_dir, 0, 1, clk, step=2))
+    out = fleet.run()
+    assert out["restarts"] == 2
+    rr = reg.get(goodput.WASTED_SECONDS,
+                 cause=goodput.WASTE_RESTART_RECOVERY)
+    # first death at t=2, gang live at t=10: the full ~8s window is
+    # booked, not just the second restart's ~5s tail
+    assert rr is not None and rr.value >= 7.0, rr and rr.value
+
+
+def test_death_during_pending_gang_restart_is_not_absorbed(tmp_path):
+    """A worker dying while a gang restart is still CONFIRMING must take
+    another gang pass, never an elastic shrink: the relaunched members
+    may not have read their restore ceiling yet, and a hold would name
+    workers still in build/restore."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        if incarnation == 1 and i == 1:
+            # mid-checkpoint death: forces the GANG path first
+            def die_saving():
+                _beat(fleet_dir, 1, 1, clk, step=2, phase="save")
+                p.rc = 86
+            sc.at(2.0, die_saving)
+        if incarnation == 2:
+            if i == 1:
+                # dies again BEFORE the restarted gang confirms live
+                sc.at(4.0, lambda: setattr(p, "rc", 86))
+            else:
+                sc.at(3.0, lambda i=i: _beat(fleet_dir, i, 2, clk, step=2,
+                                             phase="train", restore=0))
+        if incarnation == 3:
+            sc.at(8.0, lambda i=i: _beat(fleet_dir, i, 3, clk, step=8,
+                                         phase="done", restore=0))
+            sc.at(8.0, lambda: setattr(p, "rc", 0))
+        return p
+
+    fleet, rec, reg = _elastic_fleet(tmp_path, launch, clk, sc, n=3,
+                                     max_restarts=3)
+    for i in (0, 1, 2):
+        sc.at(1.0, lambda i=i: _beat(fleet_dir, i, 1, clk, step=2))
+    out = fleet.run()
+    assert out["restarts"] == 2 and out["resizes"] == 0
+    assert not fr.contains_in_order(rec.events(), ["fleet_shrink"])
+    assert not fr.contains_in_order(rec.events(), ["fleet_launch",
+                                                   "fleet_shrink"])
+
+
+def test_exhausted_chain_still_books_recovery_waste(tmp_path):
+    """A chain that dies before any gang confirms live (FleetExhausted)
+    must still book the outage into restart_recovery — the ledger a
+    dead run's postmortem is read against."""
+    from distributed_tensorflow_tpu.obs import goodput
+
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        if incarnation == 1:
+            sc.at(2.0, lambda: setattr(p, "rc", 86))
+        # incarnation 2 never beats: dead at launch grace, budget spent
+        return p
+
+    fleet, rec, reg = _mk_fleet(tmp_path, launch, clk, sc, n=1,
+                                max_restarts=1)
+    sc.at(1.0, lambda: _beat(fleet_dir, 0, 1, clk, step=2))
+    with pytest.raises(fl.FleetExhausted):
+        fleet.run()
+    rr = reg.get(goodput.WASTED_SECONDS,
+                 cause=goodput.WASTE_RESTART_RECOVERY)
+    # first death at t=2, exhaustion at the relaunch's ~20s launch
+    # grace: the whole dead window is booked
+    assert rr is not None and rr.value >= 18.0, rr and rr.value
+
+
+def test_elastic_falls_back_below_min_workers(tmp_path):
+    """A death that would shrink past min_workers takes the gang-stop
+    path (with the restore ceiling machinery), never a shrink."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    launches = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        launches.append(p)
+        if incarnation == 2:
+            _beat(fleet_dir, i, 2, clk, step=8, phase="done", restore=0)
+            p.rc = 0
+        return p
+
+    fleet, rec, reg = _elastic_fleet(tmp_path, launch, clk, sc, n=2,
+                                     min_workers=2)
+    sc.at(1.0, lambda: _beat(fleet_dir, 0, 1, clk, step=2))
+    sc.at(1.0, lambda: _beat(fleet_dir, 1, 1, clk, step=2))
+    sc.at(2.0, lambda: setattr(launches[1], "rc", 86))
+    out = fleet.run()
+    assert out["restarts"] == 1 and out["resizes"] == 0
+    assert fr.contains_in_order(rec.events(), [
+        ("fleet_worker_dead", {}), ("fleet_gang_stop", {}),
+        ("fleet_restart", {}), ("fleet_done", {})])
+    assert not fr.contains_in_order(rec.events(), ["fleet_shrink"])
+
+
+def test_elastic_falls_back_when_death_lands_mid_checkpoint(tmp_path):
+    """A worker whose last heartbeat phase is ``save`` died inside a
+    checkpoint write: its newest step dir may be torn, so the fleet
+    gang-stops (manifest-verified common ceiling) instead of shrinking
+    around unverified state."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    launches = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        launches.append(p)
+        if incarnation == 2:
+            _beat(fleet_dir, i, 2, clk, step=8, phase="done", restore=0)
+            p.rc = 0
+        return p
+
+    fleet, rec, reg = _elastic_fleet(tmp_path, launch, clk, sc, n=3)
+    for i in (0, 1, 2):
+        sc.at(1.0, lambda i=i: _beat(fleet_dir, i, 1, clk, step=2))
+    def die_saving():
+        _beat(fleet_dir, 1, 1, clk, step=4, phase="save")
+        launches[1].rc = 86
+    sc.at(2.0, die_saving)
+    out = fleet.run()
+    assert out["restarts"] == 1 and out["resizes"] == 0
+    assert not fr.contains_in_order(rec.events(), ["fleet_shrink"])
+    assert fr.contains_in_order(rec.events(), [
+        ("fleet_worker_dead", {"worker": 1}), ("fleet_gang_stop", {})])
+
+
+def test_elastic_hold_timeout_falls_back_to_gang_restart(tmp_path):
+    """Survivors that never reach the barrier (hung in a long step, or
+    the plan file is unreadable to them) must not hold the fleet
+    hostage: past hold_timeout_s the resize is abandoned for the
+    gang-stop path."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    launches = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        launches.append(p)
+        if incarnation == 2:
+            _beat(fleet_dir, i, 2, clk, step=8, phase="done", restore=0)
+            p.rc = 0
+        return p
+
+    fleet, rec, reg = _elastic_fleet(tmp_path, launch, clk, sc, n=3,
+                                     hold_timeout_s=6.0)
+    # survivors beat (stay live) but never ack the hold
+    for t in range(1, 12):
+        for i in (0, 2):
+            sc.at(float(t), lambda i=i, t=t: _beat(
+                fleet_dir, i, 1, clk, step=2 + t))
+    sc.at(2.0, lambda: setattr(launches[1], "rc", 86))
+    out = fleet.run()
+    assert out["restarts"] == 1 and out["resizes"] == 0
+    assert fr.contains_in_order(rec.events(), [
+        ("fleet_worker_dead", {"worker": 1}),
+        ("fleet_gang_stop", {"cause": rz.TRANSIENT}),
+        ("fleet_restart", {}), ("fleet_done", {})])
+
+
+def test_elastic_dead_replacement_is_relaunched(tmp_path):
+    """A replacement that dies while catching up is relaunched (bounded
+    by the restart budget) without disturbing the members."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    fleet_dir = str(tmp_path / "fleet")
+    launches = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        launches.append((i, p))
+        return p
+
+    fleet, rec, reg = _elastic_fleet(tmp_path, launch, clk, sc, n=2,
+                                     min_workers=1)
+    writers = {}
+
+    def w(i):
+        if i not in writers:
+            writers[i] = fl.HeartbeatWriter(
+                fl.heartbeat_path(fleet_dir, i), incarnation=1, clock=clk)
+        return writers[i]
+
+    sc.at(1.0, lambda: [w(i).beat(step=2, phase="train") for i in (0, 1)])
+    sc.at(2.0, lambda: setattr(launches[1][1], "rc", 86))
+    def hold_ack():
+        w(0).note_plan(2, 2)
+        w(0).beat(step=3, phase="barrier")
+    sc.at(3.0, hold_ack)
+    def release_ack():
+        w(0).note_plan(3, 1)
+        w(0).beat(step=4, phase="train")
+    sc.at(4.0, release_ack)
+    # first replacement dies before ever beating
+    sc.at(5.0, lambda: setattr(launches[2][1], "rc", 86))
+    # second replacement comes up and finishes with the member
+    def joiner2_up():
+        del writers[1]
+        w(1).beat(step=2, phase="train")
+    sc.at(7.0, joiner2_up)
+    def rejoin_flow():
+        w(0).note_plan(4, 1)
+        w(0).beat(step=6, phase="barrier")
+    sc.at(8.0, rejoin_flow)
+    def rejoin_apply():
+        w(0).note_plan(5, 2)
+        w(0).beat(step=7, phase="train")
+        w(1).note_plan(5, 2)
+        w(1).beat(step=3, phase="train")
+    sc.at(9.0, rejoin_apply)
+    def fins():
+        w(0).beat(step=8, phase="done")
+        launches[0][1].rc = 0
+        w(1).beat(step=8, phase="done")
+        launches[3][1].rc = 0
+    sc.at(10.0, fins)
+    out = fleet.run()
+    assert out == {"restarts": 0, "incarnation": 1, "resizes": 2}
+    # two deaths observed (member + replacement), two relaunches of slot 1
+    assert reg.get(fl.FLEET_WORKER_DEATHS_TOTAL).value == 2
+    assert [i for i, _ in launches] == [0, 1, 1, 1]
+
+
+# ---------------------------------------------------------------------------
 # Subprocess E2E: missed-heartbeat death → gang restart → bit-identity
 # ---------------------------------------------------------------------------
 
@@ -663,3 +1312,95 @@ def _logs(fleet_dir):
             with open(os.path.join(fleet_dir, n)) as f:
                 chunks.append(f"--- {n} ---\n{f.read()}")
     return "\n".join(chunks)
+
+
+def _run_elastic_fleet(tmp_path, tag, steps=8):
+    """One real-subprocess elastic round: 3 chaos workers, worker 1
+    hard-dies at step 3 on its FIRST launch only (the launcher owns the
+    death schedule), the fleet shrinks to 2 and absorbs the relaunched
+    replacement back at a barrier."""
+    fleet_dir = str(tmp_path / f"fleet_{tag}")
+    os.makedirs(fleet_dir, exist_ok=True)
+    ckpt_dirs = [str(tmp_path / f"ckpt_{tag}_{i}") for i in range(3)]
+    outs = [str(tmp_path / f"out_{tag}_{i}.npz") for i in range(3)]
+    launched: dict[int, int] = {}
+
+    def launch(i, incarnation):
+        n = launched.get(i, 0)
+        launched[i] = n + 1
+        args = [sys.executable, WORKER, ckpt_dirs[i], "--fleet", "--elastic",
+                "--fleet-dir", fleet_dir, "--worker-index", str(i),
+                "--steps", str(steps), "--out", outs[i],
+                "--step-sleep", "0.25"]
+        if i == 1 and n == 0:
+            args += ["--die-at", "3"]  # the scripted death schedule
+        log = open(os.path.join(fleet_dir, f"worker{i}-n{n}.log"), "w")
+        try:
+            return subprocess.Popen(args, stdout=log,
+                                    stderr=subprocess.STDOUT, env=_env())
+        finally:
+            log.close()
+
+    rec = FlightRecorder()
+    reg = Registry()
+    fleet = fl.FleetSupervisor(
+        launch, 3, fleet_dir,
+        fl.FleetConfig(max_restarts=2, elastic=True, min_workers=2,
+                       backoff=rz.RetryPolicy(base_s=0.0, jitter=0.0),
+                       poll_s=0.2, heartbeat_timeout_s=20.0,
+                       stall_timeout_s=600.0, launch_grace_s=180.0,
+                       rejoin_grace_s=180.0, hold_timeout_s=120.0,
+                       term_grace_s=5.0),
+        ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec)
+    out = fleet.run()
+    return out, rec, reg, outs, fleet_dir
+
+
+def test_fleet_e2e_elastic_shrink_rejoin_bit_identical(tmp_path):
+    """THE elastic acceptance gate (real subprocesses): a single
+    scripted death with a replacement available is absorbed WITHOUT a
+    gang restart — the survivors never stop, `restart_recovery` stays
+    zero — and the whole trajectory is deterministic: two same-seed
+    runs with the same scripted death schedule, and the uninterrupted
+    straight run, all finish with BIT-identical params."""
+    from distributed_tensorflow_tpu.obs import goodput
+
+    straight_out = str(tmp_path / "straight.npz")
+    _run_straight(tmp_path / "straight_ckpt", straight_out)
+
+    results = [_run_elastic_fleet(tmp_path, tag) for tag in ("a", "b")]
+    a = np.load(straight_out)
+    for out, rec, reg, outs, fleet_dir in results:
+        assert out["restarts"] == 0, _logs(fleet_dir)
+        assert out["resizes"] == 2, _logs(fleet_dir)
+        assert out["incarnation"] == 1  # never bumped: nobody gang-stopped
+        # the causal story: death -> shrink -> replacement -> rejoin ->
+        # done, with no gang stop/restart anywhere
+        assert fr.contains_in_order(rec.events(), [
+            ("fleet_worker_dead", {"worker": 1, "cause": rz.TRANSIENT}),
+            ("fleet_launch", {"worker": 1, "rejoin": True}),
+            ("fleet_shrink", {"worker": 1, "world": 2}),
+            ("fleet_rejoin", {"worker": 1, "world": 3}),
+            ("fleet_done", {}),
+        ]), rec.events()
+        assert not fr.contains_in_order(rec.events(), ["fleet_gang_stop"])
+        # survivors never stopped: zero seconds booked to the gang
+        # outage bucket (the elastic acceptance bar is a >= 10x drop;
+        # the realized drop is total)
+        rr = reg.get(goodput.WASTED_SECONDS,
+                     cause=goodput.WASTE_RESTART_RECOVERY)
+        assert rr is None or rr.value == 0.0
+        plan = fl.read_shard_plan(fleet_dir)
+        assert plan.world == 3 and plan.phase == fl.PLAN_STEADY
+        # bit-identity vs the uninterrupted straight run, every worker
+        for o in outs:
+            b = np.load(o)
+            assert sorted(a.files) == sorted(b.files) and a.files, \
+                _logs(fleet_dir)
+            for k in a.files:
+                np.testing.assert_array_equal(a[k], b[k])
+    # and across the two same-seed, same-schedule elastic runs
+    for o1, o2 in zip(results[0][3], results[1][3]):
+        b1, b2 = np.load(o1), np.load(o2)
+        for k in b1.files:
+            np.testing.assert_array_equal(b1[k], b2[k])

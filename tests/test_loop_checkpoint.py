@@ -492,6 +492,168 @@ def test_manifest_async_save(mesh8, tmp_path):
     ckpt.close()
 
 
+def test_close_joins_last_manifest_stamper(mesh8, tmp_path):
+    """Regression (ISSUE 12 satellite): saves only PRUNE dead entries
+    from _manifest_threads, so the LAST save's async stamper has nobody
+    behind it — close() (via wait()) must join it, or the final
+    checkpoint silently lacks MANIFEST.dtf."""
+    tx = optax.sgd(0.1)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "j"), async_save=True,
+                         save_on_preemption=False),
+        mesh8,
+    )
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    assert ckpt.save(0, state, force=True)
+    # close() WITHOUT an explicit wait(): the stamper must still be
+    # drained and the manifest on disk
+    ckpt.close()
+    assert ckpt._manifest_threads == []
+    assert (tmp_path / "j" / "0" / "MANIFEST.dtf").exists()
+    assert ckpt.verify_manifest(0) is True
+
+
+class _FakeHeartbeatWriter:
+    """The HeartbeatWriter duck-type Checkpointer(heartbeat=) consumes:
+    ``beat`` + ``phase``, with every beat recorded for assertions."""
+
+    def __init__(self):
+        self._phase = "train"
+        self.beats = []
+
+    @property
+    def phase(self):
+        return self._phase
+
+    def beat(self, step=None, attempt=None, phase=None):
+        if phase is not None:
+            self._phase = phase
+        self.beats.append((step, phase))
+
+
+def test_save_brackets_fleet_heartbeat_phase(mesh8, tmp_path):
+    """With a fleet heartbeat wired, every save beats phase ``save`` for
+    the write's duration and then restores the previous phase — the
+    signal the elastic fleet reads to gang-stop (not shrink) around a
+    death that landed mid-checkpoint."""
+    w = _FakeHeartbeatWriter()
+    tx = optax.sgd(0.1)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "hb"), async_save=False,
+                         save_on_preemption=False),
+        mesh8, heartbeat=w,
+    )
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    assert ckpt.save(0, state, force=True)
+    phases = [p for _, p in w.beats if p is not None]
+    assert phases == ["save", "train"]  # bracketed, previous restored
+    assert w.phase == "train"
+    # a refused/duplicate save never beats (no write happened)
+    n = len(w.beats)
+    assert not ckpt.save(0, state, force=True)
+    assert len(w.beats) == n
+    ckpt.close()
+
+
+def test_async_save_holds_save_phase_until_commit(mesh8, tmp_path):
+    """With async_save the heavy shard writes happen on orbax's threads
+    AFTER save() returns — the heartbeat must keep phase ``save`` for
+    that whole window (a death during the background writes can tear
+    the step dir, and the elastic fleet reads the phase to gang-stop
+    instead of shrinking around it), restoring the previous phase only
+    once the commit lands."""
+    import time
+
+    w = _FakeHeartbeatWriter()
+    tx = optax.sgd(0.1)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "ah"), async_save=True,
+                         save_on_preemption=False),
+        mesh8, heartbeat=w,
+    )
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    assert ckpt.save(0, state, force=True)
+    ckpt.wait()  # commit landed; the phase-restore thread races us only
+    deadline = time.monotonic() + 10.0
+    while w.phase != "train" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    phases = [p for _, p in w.beats if p is not None]
+    assert phases == ["save", "train"], phases
+    ckpt.close()
+
+
+def test_stale_phase_restore_cannot_clear_a_newer_save_window(mesh8,
+                                                              tmp_path):
+    """Back-to-back async saves: the FIRST save's phase-restore thread
+    waking after a NEWER save began must not beat the phase back to
+    'train' while the newer save's shard writes are in flight — the
+    save-sequence guard drops the stale restore."""
+    tx = optax.sgd(0.1)
+    w = _FakeHeartbeatWriter()
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "sq"), async_save=False,
+                         save_on_preemption=False),
+        mesh8, heartbeat=w,
+    )
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    assert ckpt.save(0, state, force=True)  # seq 1, bracketed normally
+    # simulate a newer save owning the window while save 1's restore
+    # thread wakes late
+    with ckpt._hb_lock:
+        ckpt._hb_save_seq += 1  # "save 2" started
+    w.beat(phase="save")        # ...and beat its save window
+    ckpt._restore_phase("train", seq=1)  # save 1's stale restore
+    assert w.phase == "save"    # the newer window survives
+    # and a restore landing while something ELSE owns the phase (a
+    # resize barrier) must never clobber it either
+    w.beat(phase="barrier")
+    ckpt._restore_phase("train", seq=ckpt._hb_save_seq)
+    assert w.phase == "barrier"
+    w.beat(phase="save")
+    ckpt._restore_phase("train", seq=ckpt._hb_save_seq)  # the owner's
+    assert w.phase == "train"
+    ckpt.close()
+
+
+def test_wait_bounds_straggler_join_and_logs_step(mesh8, tmp_path, caplog):
+    """A stamper that outlives the bounded join must not hang wait()
+    forever: it is logged BY STEP (naming the checkpoint that may lack
+    its manifest) and retained so a later wait() retries the join."""
+    import logging
+    import threading
+
+    tx = optax.sgd(0.1)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / "s"), async_save=False,
+                         save_on_preemption=False),
+        mesh8,
+    )
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, tx, mesh8, jax.random.PRNGKey(0)
+    )
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, daemon=True)
+    t.start()
+    ckpt._manifest_threads = [(7, t)]
+    with caplog.at_level(logging.ERROR,
+                         logger="distributed_tensorflow_tpu.train.checkpoint"):
+        ckpt.wait(manifest_join_s=0.05)  # bounded: returns, never hangs
+    assert "manifest thread for step 7" in caplog.text
+    assert [s for s, _ in ckpt._manifest_threads] == [7]  # retained
+    release.set()
+    ckpt.wait(manifest_join_s=5.0)  # the retry drains it
+    assert ckpt._manifest_threads == []
+    ckpt.close()
+
+
 def test_ftrl_matches_tf_reference():
     """Exact-FTRL parity oracle: our optax ftrl() tracks
     tf.compat.v1.train.FtrlOptimizer ($TF/python/training/ftrl.py) step

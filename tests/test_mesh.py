@@ -7,8 +7,33 @@ from distributed_tensorflow_tpu.parallel import (
     build_mesh,
     describe,
     mesh_axis_size,
+    rescale_for_world,
     single_device_mesh,
 )
+
+
+def test_rescale_for_world_batch_axes_only():
+    """Elastic resize seam: only the batch axes absorb a worker-count
+    change — a wildcard data axis passes through, an explicit one
+    scales exactly, and non-integral scalings are refused with the fix
+    named."""
+    wild = MeshSpec(data=-1)
+    assert rescale_for_world(wild, 3, 2) is wild          # absorbs
+    assert rescale_for_world(MeshSpec(data=6), 3, 3).data == 6  # no-op
+    assert rescale_for_world(MeshSpec(data=6), 3, 2).data == 4  # shrink
+    assert rescale_for_world(MeshSpec(data=4), 2, 3).data == 6  # grow
+    # fsdp is a batch axis too: when data cannot absorb the change
+    # (extent 1, or non-integral), fsdp does
+    out = rescale_for_world(MeshSpec(data=1, fsdp=8), 4, 3)
+    assert (out.data, out.fsdp) == (1, 6)
+    # model/pipe extents ride along untouched (parameter layouts)
+    spec = MeshSpec(data=4, model=2, pipe=1)
+    out = rescale_for_world(spec, 2, 1)
+    assert (out.data, out.model) == (2, 2)
+    with pytest.raises(ValueError, match="data=-1"):
+        rescale_for_world(MeshSpec(data=3), 2, 1)
+    with pytest.raises(ValueError, match=">= 1"):
+        rescale_for_world(MeshSpec(), 0, 2)
 
 
 def test_axis_names_order():

@@ -31,6 +31,15 @@ machinery is wired at all):
    is still alive), SIGTERM/SIGKILLs the gang, bumps the incarnation,
    and relaunches from the latest common valid checkpoint — both
    workers must finish at the target step after exactly one restart.
+   Its full outage window lands in `wasted_seconds_total{
+   restart_recovery}` — the baseline the elastic round is measured
+   against.
+6. **One elastic shrink/rejoin round** (three chaos_worker --fleet
+   --elastic subprocesses): worker 1 hard-dies at step 3 (os._exit, no
+   save), the ELASTIC fleet holds the survivors at a barrier, reshards
+   to world 2, relaunches the slot, and the replacement rejoins at the
+   next barrier — zero gang restarts, with `restart_recovery` at least
+   10x below the gang-restart baseline (ISSUE 12 acceptance).
 
 Usage: JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 """
@@ -203,12 +212,14 @@ FLEET_EXPECT = (
 )
 
 
-def fleet_round() -> None:
+def fleet_round() -> float:
     """Worker 1 hangs (heartbeats stop, process alive) → the fleet
     detects the death by missed heartbeats, gang-stops, and relaunches
     everyone at incarnation 2 from the latest common valid checkpoint.
     The flight-recorder dump is left at FLEET_POSTMORTEM_ARTIFACT for
-    the ci_fast gate."""
+    the ci_fast gate. Returns the gang restart's booked
+    ``restart_recovery`` seconds — the baseline the elastic round's
+    10x acceptance bar is measured against."""
     from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
     from distributed_tensorflow_tpu.obs.registry import Registry
     from distributed_tensorflow_tpu.resilience import RetryPolicy
@@ -239,7 +250,10 @@ def fleet_round() -> None:
             finally:
                 log.close()
 
+        from distributed_tensorflow_tpu.obs import goodput
+
         rec = FlightRecorder()
+        reg = Registry()
         fleet = fl.FleetSupervisor(
             launch, 2, fleet_dir,
             fl.FleetConfig(max_restarts=2,
@@ -247,15 +261,110 @@ def fleet_round() -> None:
                            poll_s=0.2, heartbeat_timeout_s=20.0,
                            stall_timeout_s=600.0, launch_grace_s=180.0,
                            term_grace_s=5.0),
-            ckpt_dirs=ckpt_dirs, registry=Registry(), flightrec=rec)
+            ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec)
         out = fleet.run()
-        assert out == {"restarts": 1, "incarnation": 2}, out
+        assert out == {"restarts": 1, "incarnation": 2, "resizes": 0}, out
         assert fl.read_restore_step(fleet_dir) == 2, "common-step ceiling"
         rec.dump(FLEET_POSTMORTEM_ARTIFACT, reason="chaos_smoke_fleet")
+        # the gang-restart baseline's price: the whole outage window
+        # (stop -> relaunch -> restore -> live) in restart_recovery —
+        # the elastic round below must beat it by >= 10x
+        baseline = reg.get(goodput.WASTED_SECONDS,
+                           cause=goodput.WASTE_RESTART_RECOVERY)
+        baseline_rr = baseline.value if baseline is not None else 0.0
+        assert baseline_rr > 0, "gang restart booked no recovery waste"
     assert os.path.exists(FLEET_POSTMORTEM_ARTIFACT)
     print("chaos_smoke: fleet hang -> missed-heartbeat death -> gang "
           "restart (incarnation 2, common ckpt) -> done OK (postmortem "
-          f"at {FLEET_POSTMORTEM_ARTIFACT})")
+          f"at {FLEET_POSTMORTEM_ARTIFACT}; "
+          f"restart_recovery={baseline_rr:.2f}s)")
+    return baseline_rr
+
+
+#: where the elastic round's flight-recorder dump lands — the ci_fast
+#: gate checks the shrink -> rejoin causal chain on it
+ELASTIC_POSTMORTEM_ARTIFACT = os.environ.get(
+    "DTF_ELASTIC_POSTMORTEM",
+    os.path.join(_REPO, "artifacts", "elastic_postmortem.jsonl"),
+)
+
+#: the causal story the elastic round's timeline must tell, in order
+ELASTIC_EXPECT = "fleet_worker_dead,fleet_shrink,fleet_rejoin,fleet_done"
+
+
+def elastic_round(baseline_rr: float) -> None:
+    """One of 3 workers hard-dies mid-run (os._exit, no save, no final
+    heartbeat) → the ELASTIC fleet shrinks the gang to the survivors at
+    a barrier instead of gang-stopping, relaunches the slot, and the
+    replacement rejoins at the next barrier — zero gang restarts, zero
+    restart_recovery seconds (vs. the gang-restart baseline's full
+    outage window: the >= 10x acceptance bar of ISSUE 12). The dump is
+    left at ELASTIC_POSTMORTEM_ARTIFACT for the ci_fast gate."""
+    from distributed_tensorflow_tpu.obs import goodput
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.obs.registry import Registry
+    from distributed_tensorflow_tpu.resilience import RetryPolicy
+    from distributed_tensorflow_tpu.resilience import fleet as fl
+
+    os.makedirs(os.path.dirname(ELASTIC_POSTMORTEM_ARTIFACT), exist_ok=True)
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke_elastic_") as d:
+        fleet_dir = os.path.join(d, "fleet")
+        os.makedirs(fleet_dir)
+        ckpt_dirs = [os.path.join(d, f"ckpt{i}") for i in range(3)]
+        launched = {}
+
+        def launch(i, incarnation):
+            n = launched.get(i, 0)
+            launched[i] = n + 1
+            args = [sys.executable, WORKER, ckpt_dirs[i], "--fleet",
+                    "--elastic", "--fleet-dir", fleet_dir,
+                    "--worker-index", str(i), "--steps", "8",
+                    "--step-sleep", "0.25"]
+            if i == 1 and n == 0:
+                args += ["--die-at", "3"]  # first launch only
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            # reviewed: a worker's stdout log stream, not durable state
+            log = open(os.path.join(  # dtflint: disable=atomic-durable-write
+                fleet_dir, f"worker{i}-n{n}.log"), "w")
+            try:
+                return subprocess.Popen(args, stdout=log,
+                                        stderr=subprocess.STDOUT, env=env)
+            finally:
+                log.close()
+
+        rec = FlightRecorder()
+        reg = Registry()
+        fleet = fl.FleetSupervisor(
+            launch, 3, fleet_dir,
+            fl.FleetConfig(max_restarts=2, elastic=True, min_workers=2,
+                           backoff=RetryPolicy(base_s=0.0, jitter=0.0),
+                           poll_s=0.2, heartbeat_timeout_s=20.0,
+                           stall_timeout_s=600.0, launch_grace_s=180.0,
+                           rejoin_grace_s=180.0, hold_timeout_s=120.0,
+                           term_grace_s=5.0),
+            ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec)
+        out = fleet.run()
+        assert out["restarts"] == 0, out
+        assert out["resizes"] == 2, out  # one shrink + one rejoin
+        rr = reg.get(goodput.WASTED_SECONDS,
+                     cause=goodput.WASTE_RESTART_RECOVERY)
+        elastic_rr = rr.value if rr is not None else 0.0
+        # ISSUE 12 acceptance: >= 10x drop vs the gang-restart baseline
+        assert elastic_rr * 10 <= baseline_rr, (elastic_rr, baseline_rr)
+        # the same chain ci_fast gates the dump on — asserted here too,
+        # so this constant and the shell literal cannot drift apart
+        from distributed_tensorflow_tpu.obs import flightrec as fr
+
+        assert fr.contains_in_order(rec.events(), ELASTIC_EXPECT.split(",")), \
+            rec.events()
+        rec.dump(ELASTIC_POSTMORTEM_ARTIFACT, reason="chaos_smoke_elastic")
+    assert os.path.exists(ELASTIC_POSTMORTEM_ARTIFACT)
+    print("chaos_smoke: elastic death -> shrink@barrier -> replacement "
+          "rejoin -> done OK (restart_recovery "
+          f"{elastic_rr:.2f}s vs gang baseline {baseline_rr:.2f}s; "
+          f"postmortem at {ELASTIC_POSTMORTEM_ARTIFACT})")
 
 
 def main() -> int:
@@ -263,7 +372,8 @@ def main() -> int:
     sigterm_resume_round()
     supervised_recovery_round()
     nan_blame_round()
-    fleet_round()
+    baseline_rr = fleet_round()
+    elastic_round(baseline_rr)
     return 0
 
 

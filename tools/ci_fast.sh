@@ -33,7 +33,10 @@
 #      (ISSUE 9) + one fleet gang-restart round: a hung worker detected
 #      by missed heartbeats, whole-gang SIGTERM/SIGKILL, incarnation
 #      bump, and a relaunch from the latest common valid checkpoint
-#      (ISSUE 8)
+#      (ISSUE 8) + one ELASTIC round: one of 3 workers hard-dies, the
+#      gang shrinks at a barrier instead of stopping, the relaunched
+#      replacement rejoins at the next barrier, and restart_recovery
+#      waste beats the gang-restart baseline by >= 10x (ISSUE 12)
 #   6. tools/postmortem.py     — flight-recorder gates: the supervised
 #      round's postmortem dump must pass schema validation AND contain
 #      fault → preemption save → restart → quarantine → fallback-restore
@@ -41,7 +44,9 @@
 #      the anomaly story — nan fault → in-graph skip → blame →
 #      restart restore (ISSUE 9) — and the fleet round's dump the
 #      gang-restart story — worker dead → gang stop → fallback
-#      ckpt_restore → fleet restart — in causal order (ISSUE 8)
+#      ckpt_restore → fleet restart — in causal order (ISSUE 8), and
+#      the elastic round's dump the resize story — worker dead →
+#      fleet_shrink → fleet_rejoin → fleet_done (ISSUE 12)
 #
 # Usage: tools/ci_fast.sh   (extra args are passed to smoke_collect)
 set -euo pipefail
@@ -67,4 +72,7 @@ env JAX_PLATFORMS=cpu python tools/postmortem.py \
 env JAX_PLATFORMS=cpu python tools/postmortem.py \
   "${DTF_FLEET_POSTMORTEM:-artifacts/fleet_postmortem.jsonl}" --quiet \
   --expect 'fleet_worker_dead,fleet_gang_stop,ckpt_restore[fallback=True],fleet_restart,fleet_done'
+env JAX_PLATFORMS=cpu python tools/postmortem.py \
+  "${DTF_ELASTIC_POSTMORTEM:-artifacts/elastic_postmortem.jsonl}" --quiet \
+  --expect 'fleet_worker_dead,fleet_shrink,fleet_rejoin,fleet_done'
 echo "ci_fast: all gates passed"
