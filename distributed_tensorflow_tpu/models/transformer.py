@@ -287,7 +287,25 @@ class SelfAttention(nn.Module):
 
         new_cache = None
         seq_shards = self.mesh.shape[mesh_lib.SEQ] if self.mesh is not None else 1
-        if cache is not None:
+        if cache is not None and "bt" in cache:
+            from ..ops.attention import (
+                cached_attention, paged_append_kv, paged_gather_kv,
+            )
+
+            # paged path: per-layer pool [NB,H,bs,D] + block table [B,MB].
+            # New K/V scatter through the table at the tokens' absolute
+            # positions (sentinel ids drop padded/idle writes); attention
+            # runs over the gathered contiguous logical view, the same
+            # masked dense form as the slot-dense path below.
+            bt = cache["bt"]
+            ck = paged_append_kv(cache["k"], k, bt, decode_pos)
+            cv = paged_append_kv(cache["v"], v, bt, decode_pos)
+            new_cache = {"k": ck, "v": cv, "bt": bt}
+            out = cached_attention(
+                q, paged_gather_kv(ck, bt), paged_gather_kv(cv, bt),
+                q_pos=decode_pos,
+            )
+        elif cache is not None:
             from ..ops.attention import append_kv, cached_attention
 
             start = decode_pos[:, 0]
@@ -483,7 +501,7 @@ class Transformer(nn.Module):
     def __call__(self, input_ids, attention_mask=None, *,
                  train: bool = False, positions=None,
                  return_hidden: bool = False,
-                 kv_cache=None, decode_pos=None):
+                 kv_cache=None, decode_pos=None, block_table=None):
         # ``kv_cache`` (serve.kv_cache.KVCache: k/v [L,B,H,M,D]) with
         # ``decode_pos`` [B,S] switches on the serving path: the S incoming
         # tokens sit at those ABSOLUTE positions (prefill: arange(P);
@@ -491,9 +509,16 @@ class Transformer(nn.Module):
         # the per-layer cache buffers, and the return is (logits, new
         # kv_cache). Causal models only; ``attention_mask`` is rejected —
         # validity is the contiguous-fill predicate (ops.cached_attention).
+        # With ``block_table`` [B, max_blocks] the cache is instead a
+        # paged block POOL (serve.kv_cache.PagedKVCache: k/v
+        # [L, num_blocks, H, block_size, D]): K/V scatter through the
+        # table and attention gathers the logical view back
+        # (ops.paged_append_kv / paged_gather_kv).
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         B, S = input_ids.shape
+        if block_table is not None and kv_cache is None:
+            raise ValueError("block_table requires kv_cache (a block pool)")
         if kv_cache is not None:
             if not cfg.causal:
                 raise ValueError("KV-cached decode requires causal=True")
@@ -548,9 +573,12 @@ class Transformer(nn.Module):
             )
             block = block_cls(cfg, self.mesh, use_moe, name=f"layer_{i}")
             if kv_cache is not None:
+                layer_cache = {"k": kv_cache.k[i], "v": kv_cache.v[i]}
+                if block_table is not None:
+                    layer_cache["bt"] = block_table
                 x, lc = block(
                     x, mask, train,
-                    cache={"k": kv_cache.k[i], "v": kv_cache.v[i]},
+                    cache=layer_cache,
                     decode_pos=decode_pos,
                 )
                 new_k.append(lc["k"])

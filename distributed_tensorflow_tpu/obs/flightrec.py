@@ -100,6 +100,10 @@ EVENT_KINDS = (
     "serve_evict",          # request left (any reason)     {uid, reason}
     "serve_drain",          # engine graceful shutdown      {finished}
     "serve_close",          # scheduler admission stopped   {cancelled}
+    "serve_preempt",        # resident evicted to queue head on block
+    #                         exhaustion (paged cache)      {uid, slot}
+    "serve_prefill_chunk",  # one chunk of a chunked prefill
+    #                                               {uid, slot, start, n}
     # free-form operator note
     "note",
 )

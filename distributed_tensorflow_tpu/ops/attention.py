@@ -70,6 +70,61 @@ def append_kv(
     )(buf, new, start)
 
 
+def paged_append_kv(
+    pool: jax.Array,
+    new: jax.Array,
+    block_table: jax.Array,
+    pos: jax.Array,
+) -> jax.Array:
+    """Scatter ``new`` [B,H,S,D] into the shared block pool
+    [num_blocks, H, block_size, D] through a per-sequence block table
+    [B, max_blocks] (logical block index → physical block id). Token
+    ``(b, s)`` at absolute position ``p = pos[b, s]`` lands in physical
+    block ``block_table[b, p // block_size]`` at offset
+    ``p % block_size``.
+
+    Out-of-range routing is the padding contract: a position past the
+    table (``p // block_size >= max_blocks`` — the chunk-padding
+    sentinel) or a table entry ``>= num_blocks`` (the idle-slot /
+    unallocated sentinel) produces an out-of-bounds scatter index, and
+    the scatter drops it — padded rows and idle slots write NOTHING,
+    instead of corrupting a live block."""
+    NB, H, bs, D = pool.shape
+    B, _, S, _ = new.shape
+    MB = block_table.shape[1]
+    blk = pos // bs                                   # [B,S] logical block
+    off = pos % bs
+    bids = jnp.where(
+        blk < MB,
+        jnp.take_along_axis(block_table, jnp.clip(blk, 0, MB - 1), axis=1),
+        NB,  # past-the-table positions route out of bounds -> dropped
+    )
+    flat_new = new.transpose(0, 2, 1, 3).reshape(B * S, H, D)
+    return pool.at[bids.reshape(-1), :, off.reshape(-1), :].set(
+        flat_new.astype(pool.dtype), mode="drop"
+    )
+
+
+def paged_gather_kv(pool: jax.Array, block_table: jax.Array) -> jax.Array:
+    """Reassemble the contiguous logical K (or V) view from the block
+    pool: ``[num_blocks, H, block_size, D]`` gathered through
+    ``block_table`` [B, max_blocks] → ``[B, H, max_blocks*block_size,
+    D]``, where logical position ``p`` of sequence ``b`` is
+    ``pool[block_table[b, p // bs], :, p % bs]``. Sentinel entries
+    (``>= num_blocks``, the unallocated tail) clamp to the last block
+    and read stale garbage — exactly the positions above the write
+    frontier that ``cached_attention``'s ``j <= q_pos`` mask excludes,
+    so no zeroing and no validity bitmap are needed."""
+    NB, H, bs, D = pool.shape
+    B, MB = block_table.shape
+    g = jnp.take(pool, jnp.clip(block_table, 0, NB - 1).reshape(-1), axis=0)
+    return (
+        g.reshape(B, MB, H, bs, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, H, MB * bs, D)
+    )
+
+
 def cached_attention(
     q: jax.Array,
     k: jax.Array,

@@ -11,19 +11,32 @@ device-computes loop like train/loop.py. See docs/serving.md.
 """
 
 from .decode import (  # noqa: F401
+    copy_block,
     decode_step,
+    jit_copy_block,
     jit_decode_step,
+    jit_paged_decode_step,
+    jit_paged_prefill_chunk,
     jit_prefill,
+    paged_decode_step,
+    paged_prefill_chunk,
     prefill,
     prefill_bucket,
 )
 from .engine import ServeEngine, StepStats  # noqa: F401
 from .kv_cache import (  # noqa: F401
     CACHE_LOGICAL,
+    PAGED_CACHE_LOGICAL,
+    BlockAllocator,
     KVCache,
+    NoFreeBlocks,
+    PagedKVCache,
     cache_specs,
     init_cache,
+    init_paged_cache,
+    paged_cache_specs,
     shard_cache,
+    shard_paged_cache,
 )
 from .sampling import sample  # noqa: F401
 from .scheduler import (  # noqa: F401

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..models.transformer import Transformer
-from .kv_cache import KVCache
+from .kv_cache import KVCache, PagedKVCache
 
 
 def prefill(
@@ -96,6 +96,93 @@ def jit_decode_step(model: Transformer):
     """Compiled decode step; one compile per cache shape. The cache is
     donated (see jit_prefill)."""
     return jax.jit(partial(decode_step, model), donate_argnums=(1,))
+
+
+# ---------------------------------------------------------------------------
+# Paged path (docs/serving.md "Paged KV cache"): the pool + block-table
+# analogs of the two jit units above, plus the COW block copy. The dense
+# functions above remain the exact-parity fallback.
+# ---------------------------------------------------------------------------
+
+
+def paged_prefill_chunk(
+    model: Transformer,
+    params,
+    cache: PagedKVCache,
+    table_row: jax.Array,
+    tokens: jax.Array,
+    start: jax.Array,
+    length: jax.Array,
+) -> tuple[jax.Array, PagedKVCache]:
+    """One fixed-size prefill chunk of ONE request: ``tokens`` [C] int32
+    (chunk, zero-padded past ``length``) at absolute positions
+    ``start .. start+length-1``, scattered through ``table_row``
+    [max_blocks]. Padded rows get a past-the-table sentinel position so
+    their K/V writes are dropped (ops.paged_append_kv). Returns the
+    next-token logits at the chunk's last REAL position — only the
+    final chunk's caller reads them — and the updated pool.
+
+    One compiled program covers EVERY prompt length: chunks are a fixed
+    shape, unlike the dense path's per-bucket prefill programs."""
+    C = tokens.shape[0]
+    sentinel = table_row.shape[0] * cache.block_size
+    idx = jnp.arange(C, dtype=jnp.int32)
+    pos = jnp.where(idx < length, start + idx, sentinel)
+    logits, cache = model.apply(
+        {"params": params}, tokens[None], kv_cache=cache,
+        decode_pos=pos[None], block_table=table_row[None],
+    )
+    return logits[0, length - 1], cache
+
+
+def paged_decode_step(
+    model: Transformer,
+    params,
+    cache: PagedKVCache,
+    block_tables: jax.Array,
+    tokens: jax.Array,
+    lengths: jax.Array,
+) -> tuple[jax.Array, PagedKVCache]:
+    """One decode step for all slots over the block pool. ``lengths``
+    [num_slots] is each slot's write position; idle and mid-prefill
+    slots carry a past-the-table sentinel instead, so their garbage
+    token writes NOTHING (a mid-prefill slot's frontier may sit in a
+    COW-shared block that a stray write must not touch)."""
+    logits, cache = model.apply(
+        {"params": params}, tokens[:, None], kv_cache=cache,
+        decode_pos=lengths[:, None], block_table=block_tables,
+    )
+    return logits[:, 0], cache
+
+
+def copy_block(
+    cache: PagedKVCache, src: jax.Array, dst: jax.Array
+) -> PagedKVCache:
+    """Copy-on-write resolution: duplicate physical block ``src`` into
+    ``dst`` across every layer and both buffers, on device. The engine
+    calls this (jit, donated) before the first divergent write into a
+    block whose refcount is > 1."""
+    return dataclasses.replace(
+        cache,
+        k=cache.k.at[:, dst].set(cache.k[:, src]),
+        v=cache.v.at[:, dst].set(cache.v[:, src]),
+    )
+
+
+def jit_paged_prefill_chunk(model: Transformer):
+    """Compiled paged prefill chunk; the pool is donated (in-place
+    scatter, no per-chunk pool copy — see jit_prefill)."""
+    return jax.jit(partial(paged_prefill_chunk, model), donate_argnums=(1,))
+
+
+def jit_paged_decode_step(model: Transformer):
+    """Compiled paged decode step; the pool is donated."""
+    return jax.jit(partial(paged_decode_step, model), donate_argnums=(1,))
+
+
+def jit_copy_block():
+    """Compiled COW block copy; the pool is donated."""
+    return jax.jit(copy_block, donate_argnums=(0,))
 
 
 def prefill_bucket(length: int, *, minimum: int = 8) -> int:

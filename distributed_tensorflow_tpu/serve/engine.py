@@ -1,19 +1,29 @@
 """ServeEngine — the user-facing submit/step/stream loop.
 
-Ties the pieces together: a jit-compiled prefill and decode step
-(decode.py) over one resident KVCache (kv_cache.py), driven by the
-continuous-batching scheduler (scheduler.py), with sampling.py choosing
-tokens. One engine ``step()`` is the serving analog of one train step:
+Ties the pieces together: jit-compiled prefill and decode steps
+(decode.py) over one resident cache — the PAGED block pool by default,
+the slot-dense KVCache as the exact-parity fallback (kv_cache.py,
+``paged=False``) — driven by the continuous-batching scheduler
+(scheduler.py), with sampling.py choosing tokens. One engine ``step()``
+is the serving analog of one train step:
 
-1. **Admit + prefill.** Every request the scheduler can place into a
-   free slot is prefilled (one compiled program per prompt bucket), and
-   its first token is sampled from the last prompt position's logits.
-2. **Decode.** One fused decode step advances EVERY slot by one token
-   ([num_slots, 1] inputs — idle slots compute garbage that is never
-   delivered, keeping a single compiled program hot at any occupancy).
-3. **Deliver + evict.** Sampled tokens are appended via the scheduler,
+1. **Admit.** Every queued request the scheduler can place into a free
+   slot — paged admission additionally gated on free KV blocks — is
+   admitted; paged admission also maps whatever prefix the block cache
+   already holds (copy-on-write sharing).
+2. **Prefill.** Paged: at most ONE fixed-size chunk per mid-prefill
+   slot per step, so a long prompt never starves the resident decoders
+   for more than one chunk. Dense: the whole prompt at once (one
+   compiled program per prompt bucket). The final chunk samples the
+   request's first token.
+3. **Decode.** One fused decode step advances every decode-ready slot
+   by one token ([num_slots, 1] inputs — idle and mid-prefill slots
+   compute garbage that is never delivered and, on the paged path,
+   write through an out-of-bounds sentinel so it lands nowhere).
+4. **Deliver + evict.** Sampled tokens are appended via the scheduler,
    which evicts finished requests (EOS / max-new / max-len) so their
-   slots are re-admissible on the NEXT step's admit phase.
+   slots — and their KV blocks — are re-admissible on the NEXT step's
+   admit phase.
 
 Everything device-side is shape-static; everything dynamic (queue
 state, per-slot write indices, request lifetimes) lives host-side in
@@ -36,7 +46,14 @@ from ..obs import flightrec as flightrec_lib
 from ..obs.registry import Registry
 from . import decode as decode_lib
 from . import sampling
-from .kv_cache import KVCache, init_cache
+from .kv_cache import (
+    BlockAllocator,
+    KVCache,
+    NoFreeBlocks,
+    PagedKVCache,
+    init_cache,
+    init_paged_cache,
+)
 from .scheduler import (
     FINISH_REASONS,
     Request,
@@ -51,6 +68,9 @@ class StepStats:
     admitted: int = 0
     decoded_slots: int = 0
     occupancy: float = 0.0
+    #: prefill chunks run this step (paged engine; dense prefill is
+    #: atomic and reports 0)
+    prefill_chunks: int = 0
     #: (uid, token) pairs in delivery order — a uid can appear twice in
     #: one step (its prefill token AND its first decode token)
     tokens: list[tuple[int, int]] = dataclasses.field(default_factory=list)
@@ -82,6 +102,11 @@ class ServeEngine:
         max_len: int | None = None,
         max_queue: int | None = None,
         cache_dtype=None,
+        paged: bool = True,
+        block_size: int = 16,
+        num_blocks: int | None = None,
+        prefill_chunk: int = 32,
+        prefix_reuse: bool = True,
         temperature: float = 0.0,
         top_k: int = 0,
         seed: int = 0,
@@ -94,24 +119,83 @@ class ServeEngine:
         self.cfg = cfg
         self.params = params
         self.model = Transformer(cfg)
-        self.cache: KVCache = init_cache(
-            cfg, num_slots, max_len=max_len, dtype=cache_dtype
-        )
+        M = cfg.max_len if max_len is None else max_len
+        if M > cfg.max_len:
+            raise ValueError(
+                f"max_len={M} exceeds the model context window "
+                f"(cfg.max_len={cfg.max_len})"
+            )
+        self.paged = paged
+        self.prefix_reuse = prefix_reuse and paged
+        if paged:
+            if prefill_chunk < 1:
+                raise ValueError("prefill_chunk must be >= 1")
+            self.block_size = block_size
+            self.prefill_chunk = prefill_chunk
+            #: logical blocks per request = the per-request token budget
+            self._mb = -(-M // block_size)
+            if num_blocks is None:
+                # default: same token capacity as the dense cache; pass
+                # fewer blocks to trade worst-case headroom for memory
+                num_blocks = num_slots * self._mb
+            if num_blocks < self._mb:
+                raise ValueError(
+                    f"num_blocks={num_blocks} < ceil(max_len/block_size)="
+                    f"{self._mb}: one request could exhaust the pool with "
+                    f"no one left to preempt"
+                )
+            self.cache: PagedKVCache = init_paged_cache(
+                cfg, num_blocks, block_size, dtype=cache_dtype
+            )
+            self.alloc = BlockAllocator(num_blocks, block_size)
+            #: slot → physical block ids in logical order (host truth);
+            #: the device-side table mirrors it, sentinel-padded
+            self._blocks: list[list[int]] = [[] for _ in range(num_slots)]
+            self._table = np.full((num_slots, self._mb), num_blocks,
+                                  np.int32)
+            #: past-the-table write position — routes a slot's K/V write
+            #: out of bounds so the scatter drops it (idle / mid-prefill)
+            self._oob = self._mb * block_size
+            #: slot → next prefill position (chunked prefill in flight)
+            self._pending: dict[int, int] = {}
+            #: slot → all tokens known at admission (prompt + generated,
+            #: the re-prefill source after a preemption)
+            self._ptoks: dict[int, tuple[int, ...]] = {}
+            self._evictions_seen = 0
+            #: blocks promised to requests approved earlier in the same
+            #: admit cycle (reset each step): the gate must not hand the
+            #: same free blocks to two queue heads
+            self._gate_reserved = 0
+        else:
+            # dense fallback: the PR-1 slot-dense cache, kept as the
+            # exact-parity reference path (docs/serving.md)
+            self.cache: KVCache = init_cache(
+                cfg, num_slots, max_len=M, dtype=cache_dtype
+            )
         self.clock = clock
         # one recorder feeds the scheduler's admit/evict events and the
         # engine's drain event, so the postmortem timeline interleaves
         self.flightrec = (flightrec if flightrec is not None
                           else flightrec_lib.default_recorder())
-        self.sched = Scheduler(num_slots, self.cache.max_len, clock=clock,
-                               max_queue=max_queue, flightrec=self.flightrec)
+        self.sched = Scheduler(
+            num_slots, M, clock=clock, max_queue=max_queue,
+            flightrec=self.flightrec,
+            admission_gate=self._admission_gate if paged else None,
+        )
         self.temperature = temperature
         self.top_k = top_k
         self._rng = jax.random.PRNGKey(seed)
         # per-slot host state: cache write index and most recent token
         self._written = np.zeros(num_slots, np.int32)
         self._last = np.zeros(num_slots, np.int32)
-        self._prefill = decode_lib.jit_prefill(self.model)
-        self._decode = decode_lib.jit_decode_step(self.model)
+        if paged:
+            self._prefill_chunk_fn = decode_lib.jit_paged_prefill_chunk(
+                self.model)
+            self._decode = decode_lib.jit_paged_decode_step(self.model)
+            self._copy_block = decode_lib.jit_copy_block()
+        else:
+            self._prefill = decode_lib.jit_prefill(self.model)
+            self._decode = decode_lib.jit_decode_step(self.model)
         # telemetry: one registry per engine by default (isolated,
         # mergeable upstream); pass obs.default_registry() to publish
         # into the process-wide scrape surface. Handles are resolved
@@ -143,6 +227,24 @@ class ServeEngine:
                 reason=reason)
             for reason in FINISH_REASONS
         }
+        # paged-cache surface (docs/observability.md "Paged KV cache");
+        # registered unconditionally so dashboards see zeros, not holes,
+        # on a dense-fallback engine
+        self._m_blocks_used = r.gauge(
+            "kv_blocks_in_use", "physical KV blocks with refcount > 0")
+        self._m_blocks_free = r.gauge(
+            "kv_blocks_free", "physical KV blocks on the free list")
+        self._m_block_evic = r.counter(
+            "kv_block_evictions_total",
+            "prefix-cache blocks evicted under pool pressure")
+        self._m_reuse = r.counter(
+            "prefix_reuse_hits_total",
+            "physical blocks mapped from the shared-prefix cache at "
+            "admission instead of being prefilled")
+        self._m_chunks = r.counter(
+            "prefill_chunks_total", "prefill chunks run (chunked prefill)")
+        if paged:
+            self._sync_block_metrics()
 
     @classmethod
     def with_random_params(
@@ -175,27 +277,55 @@ class ServeEngine:
         if req is None:
             return False
         self._observe_finish(req, None)
-        self._park_idle_written()
+        self._reconcile_slots()
+        if self.paged:
+            self._sync_block_metrics()
         return True
 
     def step(self) -> StepStats:
-        """Enforce deadlines, admit + prefill newly placed requests,
-        then advance every active slot by one decode token. Returns
-        per-step stats and records them into ``self.registry``."""
+        """Enforce deadlines, admit newly placed requests, run at most
+        ONE prefill chunk per mid-prefill slot (paged — so a long
+        prompt never starves the resident decoders for more than one
+        chunk; dense prefill stays atomic), then advance every
+        decode-ready slot by one token. Returns per-step stats and
+        records them into ``self.registry``."""
         stats = StepStats()
         t0 = self.clock()
         expired = self.sched.expire()
         for req in expired:
             self._observe_finish(req, stats)
         if expired:
-            self._park_idle_written()
-        for slot, req in self.sched.admit():
+            self._reconcile_slots()
+        if self.paged:
+            self._gate_reserved = 0  # fresh admit cycle
+        placed = self.sched.admit()
+        for slot, req in placed:
             stats.admitted += 1
             self._m_admitted.inc()
-            self._m_queue_wait.observe(req.t_admit - req.t_submit)
-            self._do_prefill(slot, req, stats)
+            if req.preemptions == 0:
+                self._m_queue_wait.observe(req.t_admit - req.t_submit)
+            if self.paged:
+                self._begin_paged(slot, req)
+        # occupancy counts every slot WORKING this step — decoding,
+        # mid-chunked-prefill, or just admitted (even if its first
+        # token finishes it before the step ends); measured here, after
+        # admission and before any delivery, so a max_new=1 stream
+        # still reads as a full batch
+        stats.occupancy = (
+            len(self.sched.active_slots()) / self.sched.num_slots
+        )
+        if self.paged:
+            # one chunk per pending slot per step — the interleave bound
+            for slot in sorted(self._pending):
+                if slot in self._pending:  # preemption may drop peers
+                    self._paged_prefill_step(slot, stats)
+        else:
+            for slot, req in placed:
+                self._do_prefill(slot, req, stats)
         t1 = self.clock()
         active = self.sched.active_slots()
+        if self.paged:
+            active = [s for s in active if s not in self._pending]
         if active:
             self._do_decode(active, stats)
         t2 = self.clock()
@@ -203,11 +333,14 @@ class ServeEngine:
         stats.decode_s = t2 - t1
         stats.wall_s = t2 - t0
         self._m_step.observe(stats.wall_s)
-        if stats.admitted:
+        if stats.admitted or stats.prefill_chunks:
             self._m_prefill.observe(stats.prefill_s)
-        if active:
+        if stats.decoded_slots:  # not a step whose decode preempted away
             self._m_decode.observe(stats.decode_s)
+        if stats.occupancy:  # publish prefill-only steps too
             self._m_occupancy.set(stats.occupancy)
+        if self.paged:
+            self._sync_block_metrics()
         return stats
 
     def stream(
@@ -253,9 +386,17 @@ class ServeEngine:
         Returns (and forgets) uid → Request for everything finished."""
         for req in self.sched.close():
             self._observe_finish(req, None)
-        while any(r is not None for r in self.sched.slots):
+        # queue check: close() emptied it, but a paged preemption can
+        # push a resident back to the queue head mid-drain
+        while any(r is not None for r in self.sched.slots) \
+                or self.sched.queue:
             self.step()
-        self._park_idle_written()
+        self._reconcile_slots()
+        if self.paged:
+            # shutdown is the leak audit: drop the prefix cache's refs
+            # too, so a clean drain leaves the allocator ALL-free
+            self.alloc.flush_prefix_cache()
+            self._sync_block_metrics()
         self._m_occupancy.set(0.0)
         done = self.sched.drain_finished()
         self.flightrec.emit("serve_drain", finished=len(done))
@@ -270,6 +411,186 @@ class ServeEngine:
         for i, req in enumerate(self.sched.slots):
             if req is None:
                 self._written[i] = 0
+
+    def _reconcile_slots(self) -> None:
+        """Bring engine host state into line with the scheduler after
+        any out-of-band eviction (timeout, cancel, close): every slot
+        the scheduler freed gives its blocks back and parks its write
+        index — the no-leaked-blocks bottleneck for non-token-driven
+        eviction paths."""
+        if self.paged:
+            for i, req in enumerate(self.sched.slots):
+                if req is None and (self._blocks[i] or i in self._pending):
+                    self._release_slot(i)
+        self._park_idle_written()
+
+    # -- paged internals ---------------------------------------------------
+
+    def _sync_block_metrics(self) -> None:
+        self._m_blocks_used.set(float(self.alloc.blocks_in_use))
+        self._m_blocks_free.set(float(self.alloc.blocks_free))
+        d = self.alloc.evictions - self._evictions_seen
+        if d:
+            self._m_block_evic.inc(d)
+            self._evictions_seen = self.alloc.evictions
+
+    def _admission_gate(self, req: Request) -> bool:
+        """Admission is gated on KV capacity, not slot count: the
+        request needs blocks for every position it will write through
+        its first decode token — capped at ``max_len``, past which the
+        scheduler finishes it before any write — minus what the prefix
+        cache can supply. ``evictable`` cache blocks count as capacity
+        (alloc reclaims them on demand), excluding the ones the match
+        itself would pin; as a fallback the FULL need may be covered by
+        evicting even the matched entries (reuse then degrades to
+        re-prefill — and a block whose only other holder is the cache
+        is resolved in place by ``_ensure_blocks``, never deadlocked
+        on). ``_gate_reserved`` accounts for requests approved earlier
+        in the SAME admit cycle, whose blocks are not yet taken."""
+        T = len(req.prompt) + len(req.generated)
+        need = -(-min(T + 1, self.sched.max_len) // self.block_size)
+        m = self.alloc.peek_match(req.prompt) if self.prefix_reuse else 0
+        free, ev = self.alloc.blocks_free, self.alloc.evictable()
+        reserved = self._gate_reserved
+        with_reuse = free + max(ev - m, 0) - reserved >= max(need - m, 1)
+        without_reuse = free + ev - reserved >= max(need, 1)
+        if with_reuse or without_reuse:
+            self._gate_reserved += max(need - (m if with_reuse else 0), 1)
+            return True
+        return False
+
+    def _release_slot(self, slot: int) -> None:
+        """Give every block in ``slot``'s table back to the allocator
+        (shared blocks just drop one ref) and reset the slot to the
+        idle sentinel state."""
+        for bid in self._blocks[slot]:
+            self.alloc.decref(bid)
+        self._blocks[slot] = []
+        self._table[slot, :] = self.cache.num_blocks
+        self._written[slot] = 0
+        self._pending.pop(slot, None)
+        self._ptoks.pop(slot, None)
+
+    def _youngest_resident(self, exclude: int) -> int | None:
+        best = None
+        for i, req in enumerate(self.sched.slots):
+            if req is None or i == exclude:
+                continue
+            if best is None or req.uid > self.sched.slots[best].uid:
+                best = i
+        return best
+
+    def _paged_alloc(self, slot: int) -> int:
+        """Allocate one block for ``slot``; on exhaustion, preempt the
+        youngest OTHER resident back to the queue head (its blocks come
+        home, it re-prefills later) and retry. Terminates: num_blocks >=
+        ceil(max_len/block_size) guarantees a lone request always fits
+        once the prefix cache and its peers have been drained."""
+        while True:
+            try:
+                return self.alloc.alloc()
+            except NoFreeBlocks:
+                victim = self._youngest_resident(exclude=slot)
+                if victim is None:
+                    raise
+                self.sched.preempt(victim)
+                self._release_slot(victim)
+
+    def _ensure_blocks(self, slot: int, start: int, end: int) -> None:
+        """Make positions ``[start, end)`` of ``slot`` writable: append
+        fresh blocks past the table's frontier, and copy-on-write any
+        block about to be written whose refcount is > 1 (shared via
+        prefix reuse) — the sharers keep the original, this slot gets a
+        private device-side copy."""
+        bs = self.block_size
+        blocks = self._blocks[slot]
+        for b in range(start // bs, (end - 1) // bs + 1):
+            if b < len(blocks):
+                bid = blocks[b]
+                if self.alloc.refcount(bid) > 1:
+                    try:
+                        new = self._paged_alloc(slot)
+                    except NoFreeBlocks:
+                        # the pool cannot supply a copy and no one is
+                        # preemptible, so the other holder must be the
+                        # prefix cache itself: un-cache the block and
+                        # write in place as sole owner instead
+                        self.alloc.release_cached(bid)
+                        if self.alloc.refcount(bid) != 1:
+                            raise
+                    else:
+                        self.cache = self._copy_block(self.cache, bid, new)
+                        self.alloc.decref(bid)
+                        self.alloc.cow_copies += 1
+                        blocks[b] = new
+                        self._table[slot, b] = new
+            else:
+                new = self._paged_alloc(slot)
+                blocks.append(new)
+                self._table[slot, b] = new
+            # in-place writes land below: weak registrations claiming
+            # the written offsets are stale from here on
+            self.alloc.note_write(blocks[b], max(start - b * bs, 0))
+
+    def _begin_paged(self, slot: int, req: Request) -> None:
+        """Admission bookkeeping for the paged path: map what the
+        prefix cache already holds (never the last known position —
+        its logits must be recomputed to sample the next token) and
+        queue the rest for chunked prefill."""
+        toks = tuple(req.prompt) + tuple(req.generated)
+        blocks: list[int] = []
+        matched = 0
+        if self.prefix_reuse:
+            blocks, matched = self.alloc.match_prefix(toks)
+            matched = min(matched, len(toks) - 1)
+            if blocks:
+                self._m_reuse.inc(len(blocks))
+        self._blocks[slot] = blocks
+        self._table[slot, :] = self.cache.num_blocks
+        self._table[slot, :len(blocks)] = blocks
+        self._written[slot] = matched
+        self._pending[slot] = matched
+        self._ptoks[slot] = toks
+
+    def _paged_prefill_step(self, slot: int, stats: StepStats) -> None:
+        """Run ONE prefill chunk for ``slot``; on the final chunk,
+        sample the first token, publish the prompt's blocks for prefix
+        reuse, and hand the slot to the decode phase."""
+        req = self.sched.slots[slot]
+        toks = self._ptoks[slot]
+        T = len(toks)
+        start = self._pending[slot]
+        end = min(start + self.prefill_chunk, T)
+        self._ensure_blocks(slot, start, end)
+        buf = np.zeros(self.prefill_chunk, np.int32)
+        buf[: end - start] = toks[start:end]
+        logits, self.cache = self._prefill_chunk_fn(
+            self.params, self.cache, jnp.asarray(self._table[slot]),
+            jnp.asarray(buf), start, end - start,
+        )
+        stats.prefill_chunks += 1
+        self._m_chunks.inc()
+        self.flightrec.emit("serve_prefill_chunk", uid=req.uid, slot=slot,
+                            start=start, n=end - start)
+        self._written[slot] = end
+        if end < T:
+            self._pending[slot] = end
+            return
+        del self._pending[slot]
+        if self.prefix_reuse:
+            P = len(req.prompt)
+            n_prompt_blocks = -(-P // self.block_size)
+            self.alloc.register_prefix(
+                req.prompt, self._blocks[slot][:n_prompt_blocks]
+            )
+        tok = int(
+            sampling.sample(
+                logits, self._next_rng(),
+                temperature=self.temperature, top_k=self.top_k,
+            )
+        )
+        self._last[slot] = tok
+        self._deliver(slot, tok, stats)
 
     def _observe_finish(self, req: Request, stats: StepStats | None) -> None:
         """The ONE terminal observation per finished request, whatever
@@ -321,6 +642,8 @@ class ServeEngine:
         if len(req.generated) == 1:
             self._m_ttft.observe(req.t_first_token - req.t_submit)
         if finished is not None:
+            if self.paged:
+                self._release_slot(slot)  # blocks home before slot reuse
             self._written[slot] = 0  # idle slots park their write index at 0
             self._observe_finish(finished, stats)
 
@@ -343,12 +666,35 @@ class ServeEngine:
         self._deliver(slot, tok, stats)
 
     def _do_decode(self, active: list[int], stats: StepStats) -> None:
+        if self.paged:
+            # make each decoding slot's write position privately owned
+            # (fresh block at a boundary, COW off a shared block);
+            # allocation pressure may preempt the youngest residents, so
+            # re-filter afterwards
+            for slot in active:
+                if self.sched.slots[slot] is not None:
+                    w = int(self._written[slot])
+                    self._ensure_blocks(slot, w, w + 1)
+            active = [s for s in active if self.sched.slots[s] is not None]
+            if not active:
+                return
         stats.decoded_slots = len(active)
-        stats.occupancy = len(active) / self.sched.num_slots
-        logits, self.cache = self._decode(
-            self.params, self.cache,
-            jnp.asarray(self._last), jnp.asarray(self._written),
-        )
+        if self.paged:
+            # non-decoding slots write through the past-the-table
+            # sentinel — their garbage token must not touch a live
+            # (possibly shared) block
+            lens = np.full(self.sched.num_slots, self._oob, np.int32)
+            for slot in active:
+                lens[slot] = self._written[slot]
+            logits, self.cache = self._decode(
+                self.params, self.cache, jnp.asarray(self._table),
+                jnp.asarray(self._last), jnp.asarray(lens),
+            )
+        else:
+            logits, self.cache = self._decode(
+                self.params, self.cache,
+                jnp.asarray(self._last), jnp.asarray(self._written),
+            )
         toks = np.asarray(
             sampling.sample(
                 logits, self._next_rng(),

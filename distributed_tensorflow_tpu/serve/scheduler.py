@@ -83,6 +83,10 @@ class Request:
     t_deadline: float | None = None
     generated: list[int] = dataclasses.field(default_factory=list)
     finish_reason: str | None = None
+    #: times this request was preempted back to the queue head (paged
+    #: block exhaustion — engine re-prefills prompt+generated on
+    #: re-admission); ``t_admit`` keeps its FIRST admission stamp
+    preemptions: int = 0
     # lifecycle timestamps (scheduler clock), the raw material for the
     # serve latency metrics (docs/observability.md): queue wait =
     # t_admit - t_submit, TTFT = t_first_token - t_submit, per-token
@@ -103,7 +107,8 @@ class Scheduler:
 
     def __init__(self, num_slots: int, max_len: int,
                  clock: Callable[[], float] = time.perf_counter,
-                 max_queue: int | None = None, flightrec=None):
+                 max_queue: int | None = None, flightrec=None,
+                 admission_gate: Callable[[Request], bool] | None = None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if max_queue is not None and max_queue < 1:
@@ -111,6 +116,12 @@ class Scheduler:
         self.num_slots = num_slots
         self.max_len = max_len
         self.max_queue = max_queue
+        #: extra admission predicate beyond "a slot is free" — the paged
+        #: engine installs a free-BLOCKS check here, so admission is
+        #: gated on actual KV capacity, not slot count. Head-of-line
+        #: blocking is deliberate: skipping past a starved head would
+        #: break FIFO fairness.
+        self.admission_gate = admission_gate
         self.clock = clock  # injectable for deterministic latency tests
         #: flight recorder for admit/evict/close lifecycle events
         #: (obs/flightrec.py — stdlib-only, so this stays jax-free)
@@ -180,8 +191,12 @@ class Scheduler:
             if not self.queue:
                 break
             if self.slots[slot] is None:
+                if self.admission_gate is not None \
+                        and not self.admission_gate(self.queue[0]):
+                    break  # head-of-line blocked on capacity, stay FIFO
                 req = self.queue.popleft()
-                req.t_admit = self.clock()
+                if req.t_admit is None:  # keep the FIRST admission stamp
+                    req.t_admit = self.clock()
                 self.slots[slot] = req
                 placed.append((slot, req))
                 self.flightrec.emit("serve_admit", uid=req.uid, slot=slot)
@@ -215,6 +230,22 @@ class Scheduler:
                 self._finish(req, FINISH_CANCELLED)
                 return req
         return None
+
+    def preempt(self, slot: int) -> Request:
+        """Evict the request in ``slot`` back to the FRONT of the queue
+        (it keeps its uid, prompt, and generated tokens — on
+        re-admission the engine re-prefills everything it already knows
+        and decoding continues where it left off). This is the paged
+        engine's block-exhaustion pressure valve: the request is NOT
+        finished, so no terminal accounting fires."""
+        req = self.slots[slot]
+        if req is None:
+            raise ValueError(f"preempt on empty slot {slot}")
+        self.slots[slot] = None
+        req.preemptions += 1
+        self.queue.appendleft(req)
+        self.flightrec.emit("serve_preempt", uid=req.uid, slot=slot)
+        return req
 
     def expire(self) -> list[Request]:
         """Evict every request whose absolute deadline has passed, with

@@ -4,11 +4,15 @@
 Loads a tiny random-weight causal decoder, submits a few token-id
 prompts, and streams greedy completions from the continuous-batching
 engine (there is no tokenizer in this framework — prompts and outputs
-are vocabulary ids, which is all the serving stack deals in).
+are vocabulary ids, which is all the serving stack deals in). The
+engine defaults to the PAGED KV cache (block pool + copy-on-write
+prefix reuse + chunked prefill — docs/serving.md); ``--dense`` is the
+one-flag escape hatch back to the PR-1 slot-dense cache.
 
 Usage:
     JAX_PLATFORMS=cpu python examples/serve.py
     python examples/serve.py --prompts 5 --max-new 24 --temperature 0.8
+    python examples/serve.py --dense   # slot-dense fallback
 """
 
 import argparse
@@ -30,6 +34,13 @@ def main(argv=None):
                     help="0 = greedy")
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--dense", action="store_true",
+                    help="escape hatch: the PR-1 slot-dense KV cache "
+                         "instead of the default paged block pool")
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="tokens per KV block (paged cache)")
+    ap.add_argument("--prefill-chunk", type=int, default=32,
+                    help="prefill chunk size (paged cache)")
     args = ap.parse_args(argv)
 
     from distributed_tensorflow_tpu import serve
@@ -42,6 +53,8 @@ def main(argv=None):
     eng = serve.ServeEngine.with_random_params(
         cfg, seed=args.seed, num_slots=args.slots,
         temperature=args.temperature, top_k=args.top_k,
+        paged=not args.dense, block_size=args.block_size,
+        prefill_chunk=args.prefill_chunk,
     )
 
     rng = random.Random(args.seed)
@@ -75,6 +88,16 @@ def main(argv=None):
           f"p99={ms(ttft.percentile(0.99))}  "
           f"tpot p50={ms(tpot.percentile(0.5))}  "
           f"tokens={int(reg.get('serve_tokens_total').value)}")
+    if not args.dense:
+        # the paged cache's own surface (docs/serving.md "Paged KV")
+        print(f"paged kv: block_size={args.block_size} "
+              f"pool={eng.cache.num_blocks} blocks  "
+              f"reuse_hits={int(reg.get('prefix_reuse_hits_total').value)}  "
+              f"prefill_chunks={int(reg.get('prefill_chunks_total').value)}  "
+              f"cow_copies={eng.alloc.cow_copies}")
+        eng.drain()
+        assert eng.alloc.blocks_free == eng.cache.num_blocks, \
+            "block leak at shutdown"
 
 
 if __name__ == "__main__":

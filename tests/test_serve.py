@@ -471,6 +471,370 @@ def test_engine_deadline_mid_decode_eviction(decoder):
 
 
 # ---------------------------------------------------------------------------
+# Paged KV cache (docs/serving.md "Paged KV cache"): block allocator
+# invariants, paged/dense parity, prefix reuse + copy-on-write, chunked
+# prefill interleave, block-gated admission + preemption
+# ---------------------------------------------------------------------------
+
+
+def _paged_engine(cfg, params, **kw):
+    base = dict(paged=True, block_size=8, prefill_chunk=8)
+    base.update(kw)
+    return serve.ServeEngine(cfg, params, **base)
+
+
+def test_block_allocator_invariants():
+    """Pure host-side accounting: used + free == pool size through
+    alloc/incref/decref, refcount errors raise, LRU prefix-cache
+    eviction frees exactly the cache-only blocks, flush returns the
+    allocator to all-free."""
+    a = serve.BlockAllocator(4, block_size=4)
+    assert a.blocks_free == 4 and a.blocks_in_use == 0
+    b0, b1 = a.alloc(), a.alloc()
+    assert (a.blocks_in_use, a.blocks_free) == (2, 2)
+    a.incref(b0)
+    assert not a.decref(b0) and a.refcount(b0) == 1
+    assert a.decref(b0) and a.blocks_free == 3
+    with pytest.raises(ValueError):
+        a.decref(b0)  # already free
+    with pytest.raises(ValueError):
+        a.incref(b0)  # can't revive a free block
+
+    # register a 2-block prefix: one full block (cached, +1 ref) and a
+    # partial tail (weak, no ref)
+    toks = tuple(range(6))  # 4 full + 2 tail at block_size=4
+    b2 = a.alloc()
+    a.register_prefix(toks, [b1, b2])
+    assert a.refcount(b1) == 2 and a.refcount(b2) == 1
+    blocks, matched = a.match_prefix(toks)
+    assert blocks == [b1, b2] and matched == 6
+    assert a.refcount(b1) == 3 and a.refcount(b2) == 2
+    for bid in blocks:
+        a.decref(bid)
+    # the original owner releases; the cache still pins the full block
+    a.decref(b1), a.decref(b2)
+    assert a.refcount(b1) == 1 and a.refcount(b2) == 0
+    assert a.evictable() == 1
+    # exhaust the pool: alloc must evict the cached block, not raise
+    got = [a.alloc() for _ in range(a.blocks_free + 1)]
+    assert a.blocks_free == 0 and a.evictions == 1 and len(got) == 4
+    with pytest.raises(serve.NoFreeBlocks):
+        a.alloc()
+    # a freed-then-reallocated block's weak partial entry is stale
+    assert a.match_prefix(toks) == ([], 0)
+    for bid in got:
+        a.decref(bid)
+    assert a.flush_prefix_cache() == 0  # cache was already evicted
+    assert a.blocks_free == 4
+    assert all(a.refcount(i) == 0 for i in range(4))
+
+
+def test_block_allocator_partial_entries_bounded_and_longest_match():
+    """Weak partial-tail entries pick the LONGEST matching candidate,
+    and the map sweeps stale entries so host memory stays bounded even
+    for prompts nobody ever repeats."""
+    a = serve.BlockAllocator(4, block_size=8)
+    b0, b1 = a.alloc(), a.alloc()
+    a.register_prefix((1, 2), [b0])          # tail candidate: 2 tokens
+    a.register_prefix((1, 5, 6, 7), [b1])    # same first token, 4 tokens
+    blocks, matched = a.match_prefix((1, 5, 6, 7, 8))
+    assert blocks == [b1] and matched == 4   # longest match, not first
+    a.decref(b1)
+
+    bound = max(64, 2 * a.num_blocks)
+    for i in range(3 * bound):
+        bid = a.alloc()
+        a.register_prefix((1000 + i, 1001 + i), [bid])
+        a.decref(bid)  # freed immediately: the entry is instantly stale
+    assert sum(len(c) for c in a._partial.values()) <= bound + 1
+
+
+def test_block_allocator_note_write_invalidates_overwritten_tail():
+    """A divergent in-place write into a registered partial-tail block
+    must kill the weak entry: a later identical prompt would otherwise
+    map K/V that no longer holds the registered content."""
+    a = serve.BlockAllocator(4, block_size=4)
+    b0 = a.alloc()
+    a.register_prefix((1, 2), [b0])  # tail content (1, 2) at offsets 0-1
+    # sole owner appends at offset 2 (past the registered fill): valid
+    a.note_write(b0, 2)
+    blocks, matched = a.match_prefix((1, 2, 9))
+    assert blocks == [b0] and matched == 2
+    for bid in blocks:
+        a.decref(bid)
+    # sole owner REWRITES offset 1 in place (divergence): entry dies
+    a.note_write(b0, 1)
+    assert a.match_prefix((1, 2, 9)) == ([], 0)
+    a.decref(b0)
+    assert a.blocks_free == 4
+
+
+def test_paged_greedy_parity_with_dense(decoder):
+    """Acceptance gate: 64-step greedy decode through the paged path
+    (chunked prefill + block-table gather) is token-identical to the
+    dense slot cache, which is itself logit-checked against the
+    uncached forward above — both paths exercised on the same params."""
+    cfg, _, params = decoder
+    prompt = [5, 17, 3, 99, 42, 7, 11]
+    dense = serve.ServeEngine(cfg, params, num_slots=1, paged=False)
+    want = list(dense.stream(prompt, max_new_tokens=64))
+    paged = _paged_engine(cfg, params, num_slots=1)
+    got = list(paged.stream(prompt, max_new_tokens=64))
+    assert len(want) == 64 and got == want
+
+    # a long prompt (multiple chunks) must agree too
+    long_prompt = [(7 * i + 3) % cfg.vocab_size for i in range(40)]
+    dense = serve.ServeEngine(cfg, params, num_slots=1, paged=False)
+    want = list(dense.stream(long_prompt, max_new_tokens=24))
+    paged = _paged_engine(cfg, params, num_slots=1)
+    got = list(paged.stream(long_prompt, max_new_tokens=24))
+    assert got == want
+
+
+@pytest.mark.parametrize("paged", [True, False])
+def test_engine_request_isolation_both_paths(decoder, paged):
+    """Slot reuse must not leak state across requests on either cache
+    layout: each request's greedy completion equals its solo run."""
+    cfg, _, params = decoder
+    prompts = [[5, 17, 3], [88, 12, 61, 40, 2], [7], [33, 33, 9, 1]]
+    solo = []
+    for p in prompts:
+        eng = serve.ServeEngine(cfg, params, num_slots=1, paged=paged)
+        solo.append(list(eng.stream(p, max_new_tokens=12)))
+    eng = serve.ServeEngine(cfg, params, num_slots=2, paged=paged)
+    uids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+    done = eng.run()
+    for uid, want in zip(uids, solo):
+        assert done[uid].generated == want
+
+
+def test_paged_prefix_reuse_and_cow(decoder):
+    """Requests sharing a prompt map the same physical blocks (reuse
+    hits > 0, strictly lower peak block usage than reuse disabled), the
+    first divergent write triggers a copy-on-write block copy, and the
+    shared path stays token-identical to the solo run."""
+    cfg, _, params = decoder
+    sys_prefix = list(range(1, 25))  # 3 full blocks at block_size=8
+    warm = sys_prefix + [50]
+
+    def drive(reuse):
+        eng = _paged_engine(cfg, params, num_slots=4, prefix_reuse=reuse)
+        for _ in eng.stream(warm, max_new_tokens=4):
+            pass  # warm request registers the prefix (when enabled)
+        uids = [eng.submit(sys_prefix + [60 + i], max_new_tokens=6)
+                for i in range(4)]
+        peak = 0
+        while eng.sched.has_work:
+            eng.step()
+            peak = max(peak, eng.alloc.blocks_in_use)
+        done = eng.sched.drain_finished()
+        outs = [done[u].generated for u in uids]
+        hits = int(eng.registry.get("prefix_reuse_hits_total").value)
+        eng.drain()
+        assert eng.alloc.blocks_free == eng.cache.num_blocks  # no leaks
+        return outs, peak, hits
+
+    outs_on, peak_on, hits_on = drive(True)
+    outs_off, peak_off, hits_off = drive(False)
+    assert outs_on == outs_off  # sharing must not change a single token
+    assert hits_on > 0 and hits_off == 0
+    assert peak_on < peak_off  # strictly lower block usage
+
+    # copy-on-write: an identical prompt maps the sharer's partially
+    # filled tail block; the first divergent write must copy it
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]  # 1 full block + 2-token tail
+    solo = serve.ServeEngine(cfg, params, num_slots=1, paged=False)
+    want = list(solo.stream(prompt, max_new_tokens=12))
+    eng = _paged_engine(cfg, params, num_slots=2)
+    a = eng.submit(prompt, max_new_tokens=12)
+    eng.step(), eng.step()  # A prefilled + registered, mid-decode
+    b = eng.submit(prompt, max_new_tokens=12)
+    done = eng.run()
+    assert eng.alloc.cow_copies >= 1
+    assert done[a].generated == want and done[b].generated == want
+    eng.drain()
+    assert eng.alloc.blocks_free == eng.cache.num_blocks
+    assert all(eng.alloc.refcount(i) == 0
+               for i in range(eng.cache.num_blocks))
+
+
+def test_paged_chunked_prefill_interleaves_decode(decoder):
+    """A long prompt prefills in fixed-size chunks interleaved with
+    decode: the resident request gains one token EVERY step of the long
+    prefill (TTFT of residents is bounded by one chunk), and the chunk
+    events land in the flight recorder."""
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+
+    cfg, _, params = decoder
+    rec = FlightRecorder(capacity=256)
+    eng = _paged_engine(cfg, params, num_slots=2, flightrec=rec)
+    short = eng.submit([5, 17, 3], max_new_tokens=40)
+    eng.step()  # short is resident and decoding
+    short_req = eng.sched.slots[eng.sched.active_slots()[0]]
+    assert short_req.uid == short
+    eng.submit(list(range(1, 65)), max_new_tokens=4)  # 64 tokens: 8 chunks
+    chunk_steps = 0
+    while True:
+        before = len(short_req.generated)
+        stats = eng.step()
+        if stats.prefill_chunks == 0:
+            break  # the long prefill completed on an earlier step
+        assert stats.prefill_chunks == 1  # one chunk per pending slot
+        assert stats.decoded_slots >= 1  # decode ran in the SAME step
+        assert len(short_req.generated) == before + 1  # no starvation
+        chunk_steps += 1
+    assert chunk_steps == 8  # ceil(64 / prefill_chunk=8)
+    # 9 total: the short prompt's own prefill was one chunk too
+    assert int(eng.registry.get("prefill_chunks_total").value) == 9
+    kinds = [e["kind"] for e in rec.events()]
+    assert kinds.count("serve_prefill_chunk") == 9
+    eng.drain()
+    assert eng.alloc.blocks_free == eng.cache.num_blocks
+
+
+def test_paged_admission_gated_on_blocks(decoder):
+    """Admission is gated on free KV blocks, not free slots: with a
+    tight pool a queued request waits even though a slot is empty, and
+    is admitted once blocks come home."""
+    cfg, _, params = decoder
+    # pool of 4 blocks = 32 tokens; max_len=32 so MB=4 (one request may
+    # need the whole pool)
+    eng = _paged_engine(cfg, params, num_slots=2, max_len=32,
+                        num_blocks=4, prefix_reuse=False)
+    a = eng.submit([1] * 20, max_new_tokens=4)  # needs 3 blocks
+    for _ in range(3):  # 3 chunks: a fully prefilled, holds 3 blocks
+        eng.step()
+    b = eng.submit([2] * 20, max_new_tokens=4)  # needs 3 more: gated
+    stats = eng.step()
+    assert stats.admitted == 0  # a slot is free, but the pool is not
+    assert eng.sched.slots[1] is None and eng.sched.queue
+    done = eng.run()  # a finishes, blocks free, b admits and finishes
+    assert done[a].finish_reason == sched_lib.FINISH_MAX_NEW
+    assert done[b].finish_reason == sched_lib.FINISH_MAX_NEW
+    eng.drain()
+    assert eng.alloc.blocks_free == 4
+
+    with pytest.raises(ValueError):
+        _paged_engine(cfg, params, max_len=32, num_blocks=3)  # < MB
+
+    # the gate caps its demand at max_len: a full-context prompt (legal;
+    # finishes at its first token via max_len) must ADMIT, not wedge the
+    # queue head forever asking for ceil((max_len+1)/bs) blocks
+    eng = _paged_engine(cfg, params, num_slots=1, max_len=32, num_blocks=4)
+    uid = eng.submit([3] * 32, max_new_tokens=8)
+    done = eng.run()
+    assert done[uid].finish_reason == sched_lib.FINISH_MAX_LEN
+    assert len(done[uid].generated) == 1
+    eng.drain()
+    assert eng.alloc.blocks_free == 4
+
+
+def test_paged_fully_cached_prompt_never_deadlocks(decoder):
+    """When a finished prompt's blocks fill the ENTIRE pool as cache
+    entries, resubmitting that exact prompt must still admit and finish
+    (evict-matched gate fallback + in-place un-cache when the COW copy
+    cannot be allocated) — not wedge the queue head forever."""
+    cfg, _, params = decoder
+    eng = _paged_engine(cfg, params, num_slots=1, max_len=32, num_blocks=4)
+    prompt = [5] * 32  # exactly max_len: 4 full blocks = the whole pool
+    a = eng.submit(prompt, max_new_tokens=4)
+    done1 = eng.run()
+    assert done1[a].finish_reason == sched_lib.FINISH_MAX_LEN
+    b = eng.submit(prompt, max_new_tokens=4)
+    for _ in range(50):
+        eng.step()
+        if b in eng.sched.finished:
+            break
+    else:
+        pytest.fail("fully-cached prompt was never admitted (gate wedge)")
+    done2 = eng.sched.drain_finished()
+    assert done2[b].generated == done1[a].generated
+    eng.drain()
+    assert eng.alloc.blocks_free == 4
+
+
+def test_paged_preemption_exact_parity(decoder):
+    """Block exhaustion mid-decode preempts the youngest resident back
+    to the queue head; it re-prefills prompt + generated and finishes
+    with EXACTLY the tokens an uncontended engine produces."""
+    cfg, _, params = decoder
+
+    def drive(num_blocks):
+        eng = _paged_engine(cfg, params, num_slots=2, max_len=32,
+                            num_blocks=num_blocks, prefix_reuse=False)
+        uids = [eng.submit([10 + i] * 10, max_new_tokens=20)
+                for i in range(3)]
+        done = eng.run()
+        outs = [done[u].generated for u in uids]
+        pre = sum(done[u].preemptions for u in uids)
+        eng.drain()
+        assert eng.alloc.blocks_free == eng.cache.num_blocks
+        return outs, pre
+
+    ample, pre_ample = drive(8)
+    tight, pre_tight = drive(5)
+    assert pre_ample == 0 and pre_tight > 0
+    assert ample == tight  # preemption is invisible in the tokens
+
+
+def test_paged_block_accounting_chaos(decoder):
+    """The block-accounting invariant under a chaotic stream (mixed
+    lengths, shared prefixes, deadlines, cancels, preemption pressure):
+    used + free == pool size at EVERY step, and every eviction path —
+    finish, timeout, cancel, drain/close — returns its blocks."""
+    from distributed_tensorflow_tpu.resilience import FaultClock
+
+    cfg, _, params = decoder
+    rng = random.Random(20260804)
+    clk = FaultClock()
+    eng = _paged_engine(cfg, params, num_slots=3, max_len=48,
+                        num_blocks=10, max_queue=8, clock=clk)
+    shared = [7, 8, 9, 10, 11, 12, 13, 14, 15, 16]
+    submitted: list[int] = []
+    for step in range(400):
+        for _ in range(rng.randint(0, 2) if len(submitted) < 40 else 0):
+            plen = rng.choice([3, 9, 18, 30])
+            prompt = (shared[:8] + [rng.randrange(100)] * (plen - 8)
+                      if plen > 8 and rng.random() < 0.5
+                      else [rng.randrange(100) for _ in range(plen)])
+            try:
+                submitted.append(eng.submit(
+                    prompt, max_new_tokens=rng.randint(1, 8),
+                    deadline_s=rng.uniform(0.5, 4.0)
+                    if rng.random() < 0.3 else None,
+                ))
+            except sched_lib.QueueFull:
+                pass
+        if submitted and rng.random() < 0.1:
+            eng.cancel(rng.choice(submitted))
+        clk.advance(rng.uniform(0.0, 0.4))
+        eng.step()
+        a = eng.alloc
+        assert a.blocks_in_use + a.blocks_free == a.num_blocks
+        assert all(a.refcount(i) >= 0 for i in range(a.num_blocks))
+        if len(submitted) >= 40 and not eng.sched.has_work:
+            break
+    assert not eng.sched.has_work, "chaos stream did not drain"
+    eng.drain()
+    assert eng.alloc.blocks_free == eng.alloc.num_blocks  # zero leaks
+    assert all(eng.alloc.refcount(i) == 0
+               for i in range(eng.alloc.num_blocks))
+    # telemetry invariant survives the paged refactor: one TTFT + one
+    # TPOT observation per finished request, whatever evicted it
+    _assert_telemetry_invariant(
+        eng, sum(_finished_totals(eng.registry).values()))
+
+
+def test_paged_cache_specs_follow_sharding_rules():
+    """The pool shards heads over `model` like the dense cache; the
+    blocks dim is replicated (blocks are shared across requests, so
+    they must not scatter over the batch axes)."""
+    spec = serve.paged_cache_specs()
+    assert spec.k == P(None, None, "model", None, None)
+    assert spec.v == spec.k
+
+
+# ---------------------------------------------------------------------------
 # Cache sharding + sampling
 # ---------------------------------------------------------------------------
 

@@ -10,15 +10,36 @@ random-weight decoder and reports from the engine's obs registry
 - ``ttft_p50_ms/p99``    — submit → first token percentiles
 - ``tpot_p50_ms/p99``    — mean per-output-token decode latency
 - ``queue_wait_p50_ms``  — submit → slot admission
-- ``mean_occupancy``     — mean active-slots / num_slots over decode steps
-- ``full_batch_steps``   — steps that decoded with every slot live
+- ``mean_occupancy``     — mean working-slots / num_slots over steps
 - ``full_batch_frac``    — the acceptance gate: with a backlog queued,
-                           the scheduler must keep the decode batch full
-                           (ISSUE 1 acceptance criterion)
+                           the scheduler must keep the batch full
+                           (``full_batch_frac_backlog`` restricts the
+                           denominator to steps that HAD a backlog)
+
+Presets:
+
+- ``steady`` (default) — uniform short prompts, the PR-1 throughput rig.
+- ``chaos``  — the paged-cache acceptance rig (ISSUE 13): short/long
+  mixed traffic behind a shared system prefix (the seeded chaos-stream
+  idiom of resilience/faults.py), a slice of requests carrying
+  deadlines, seeded mid-flight cancels, and a KV-footprint report:
+  measured KV bytes per resident request (paged: blocks actually held ×
+  block bytes) against the dense layout's per-slot ``max_len`` row,
+  plus prefix-reuse hits and the per-step starvation bound (no resident
+  decoder goes more than one step between tokens — chunked prefill
+  interleaves instead of stalling the batch).
+
+Both presets end with the chaos epilogue (timeout + cancel on the SAME
+engine, re-checking histogram-counts == Σ serve_finished_total), and a
+paged engine must shut down leak-free: after ``drain()`` the block
+allocator is back to all-free. ``--parity-check`` additionally gates
+64-token greedy parity of the paged path against the dense fallback on
+the same weights (the ci_fast.sh smoke runs it).
 
 Usage:
     JAX_PLATFORMS=cpu python tools/bench_serve.py
-    python tools/bench_serve.py --requests 32 --slots 8 --json out.json
+    python tools/bench_serve.py --preset chaos --requests 24 --json out.json
+    python tools/bench_serve.py --dense   # the PR-1 slot-dense cache
 """
 
 import argparse
@@ -30,12 +51,60 @@ import time
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
+def _make_engine(cfg, serve, args, seed):
+    return serve.ServeEngine.with_random_params(
+        cfg, seed=seed, num_slots=args.slots, paged=not args.dense,
+        block_size=args.block_size, num_blocks=args.blocks,
+        prefill_chunk=args.prefill_chunk,
+        prefix_reuse=not args.no_reuse,
+    )
+
+
+def _parity_check(cfg, serve, args):
+    """64-step greedy decode must be token-identical through the paged
+    and dense paths — the bench-side twin of the test-suite gate."""
+    import jax
+
+    from distributed_tensorflow_tpu.models import transformer as tfm
+
+    model = tfm.Transformer(cfg)
+    params, _ = tfm.make_init_fn(model, 8)(jax.random.PRNGKey(args.seed))
+    prompt = [5, 17, 3, 99, 42, 7, 11]
+    dense = serve.ServeEngine(cfg, params, num_slots=1, paged=False)
+    want = list(dense.stream(prompt, max_new_tokens=64))
+    paged = serve.ServeEngine(
+        cfg, params, num_slots=1, paged=True,
+        block_size=args.block_size, prefill_chunk=args.prefill_chunk)
+    got = list(paged.stream(prompt, max_new_tokens=64))
+    assert got == want, (
+        f"paged/dense greedy divergence at step "
+        f"{next(i for i, (a, b) in enumerate(zip(got, want)) if a != b)}"
+    )
+    paged.drain()
+    assert paged.alloc.blocks_free == paged.cache.num_blocks, \
+        "parity engine leaked blocks"
+    print("parity-check: 64-step paged == dense", file=sys.stderr)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--requests", type=int, default=16)
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--max-new", type=int, default=24)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--preset", choices=("steady", "chaos"),
+                    default="steady")
+    ap.add_argument("--dense", action="store_true",
+                    help="PR-1 slot-dense cache (the exact-parity "
+                         "fallback) instead of the paged pool")
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--blocks", type=int, default=None,
+                    help="pool size (default: dense-equivalent capacity)")
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--no-reuse", action="store_true",
+                    help="disable copy-on-write prefix reuse")
+    ap.add_argument("--parity-check", action="store_true",
+                    help="gate 64-step greedy parity paged vs dense")
     ap.add_argument("--json", type=str, default=None,
                     help="also write the result dict to this path")
     args = ap.parse_args(argv)
@@ -47,33 +116,92 @@ def main(argv=None):
         vocab_size=256, max_len=128, num_layers=2, d_model=64, num_heads=4,
         d_ff=128, dropout=0.0, dtype="float32", causal=True, pre_ln=True,
     )
-    eng = serve.ServeEngine.with_random_params(
-        cfg, seed=args.seed, num_slots=args.slots
-    )
+    if args.parity_check:
+        _parity_check(cfg, serve, args)
+    eng = _make_engine(cfg, serve, args, args.seed)
 
     rng = random.Random(args.seed)
-    prompts = [
-        [rng.randrange(cfg.vocab_size) for _ in range(rng.randint(4, 16))]
-        for _ in range(args.requests)
-    ]
+    sys_prefix = [rng.randrange(cfg.vocab_size) for _ in range(24)]
+    if args.preset == "chaos":
+        # mixed-length stream behind one shared system prefix: the
+        # short/long mix is what a dense cache wastes max_len rows on
+        prompts, deadlines = [], []
+        # keep "long" strictly longer than the short band even when a
+        # large --max-new squeezes the headroom (never a silent
+        # degenerate range)
+        long_hi = max(cfg.max_len - len(sys_prefix) - args.max_new - 1, 17)
+        for _ in range(args.requests):
+            if rng.random() < 0.6:
+                body = rng.randint(4, 16)
+            else:
+                body = rng.randint(min(40, long_hi), long_hi)
+            prompts.append(
+                sys_prefix + [rng.randrange(cfg.vocab_size)
+                              for _ in range(body)])
+            deadlines.append(rng.uniform(0.5, 2.0)
+                             if rng.random() < 0.2 else None)
+    else:
+        prompts = [
+            [rng.randrange(cfg.vocab_size) for _ in range(rng.randint(4, 16))]
+            for _ in range(args.requests)
+        ]
+        deadlines = [None] * args.requests
 
     # warmup on the SAME engine: jit tracing is cached per wrapper, so a
-    # fresh ServeEngine would recompile inside the timed loop. Hit the
-    # decode step and every prefill bucket the stream will use, drain,
-    # then time (warmup requests are drained out of the stats entirely).
-    for b in sorted({serve.prefill_bucket(len(p)) for p in prompts}):
-        eng.submit([rng.randrange(cfg.vocab_size) for _ in range(b)],
-                   max_new_tokens=2)
-    eng.run()
+    # fresh ServeEngine would recompile inside the timed loop. The paged
+    # path compiles ONE chunk program + one decode program; the dense
+    # path needs every prefill bucket the stream will use. Warmup
+    # requests drain out of the stats entirely.
+    if args.dense:
+        for b in sorted({serve.prefill_bucket(len(p)) for p in prompts}):
+            eng.submit([rng.randrange(cfg.vocab_size) for _ in range(b)],
+                       max_new_tokens=2)
+        eng.run()
+    else:
+        # two identical full-block prompts back to back: the second
+        # matches the first's cached blocks and its capped last-position
+        # rewrite triggers a copy-on-write, so copy_block compiles
+        # during warmup too, not inside the timed loop
+        wp = [rng.randrange(cfg.vocab_size)
+              for _ in range(2 * args.block_size)]
+        for _ in range(2):
+            eng.submit(wp, max_new_tokens=2)
+            eng.run()
+        # keep measured reuse honest: drop what warmup cached
+        eng.alloc.flush_prefix_cache()
     eng.registry.reset()  # drop warmup/compile observations
+    # cow_copies lives on the allocator, not the registry: snapshot it
+    # here so the report counts only the measured window, like the
+    # registry-sourced counters beside it
+    cow_at_reset = 0 if args.dense else eng.alloc.cow_copies
 
-    for p in prompts:
-        eng.submit(p, max_new_tokens=args.max_new)
+    uids = [eng.submit(p, max_new_tokens=args.max_new, deadline_s=dl)
+            for p, dl in zip(prompts, deadlines)]
+    # seeded mid-flight cancels (chaos): step index → victim uid
+    cancel_at = ({rng.randrange(2, 40): rng.choice(uids)
+                  for _ in range(2)}
+                 if args.preset == "chaos" else {})
 
     t0 = time.perf_counter()
     stats = []
+    kv_samples = []  # (blocks_in_use, residents) per decode step
+    backlog = []     # queue non-empty at step start?
+    last_seen: dict[int, int] = {}
+    max_gap = 0
     while eng.sched.has_work:
-        stats.append(eng.step())
+        step_i = len(stats)
+        if step_i in cancel_at:
+            eng.cancel(cancel_at[step_i])
+        backlog.append(bool(eng.sched.queue))
+        st = eng.step()
+        stats.append(st)
+        residents = len(eng.sched.active_slots())
+        if st.decoded_slots and not args.dense:
+            kv_samples.append((eng.alloc.blocks_in_use, residents))
+        for uid, _tok in st.tokens:
+            if uid in last_seen:
+                max_gap = max(max_gap, step_i - last_seen[uid])
+            last_seen[uid] = step_i
     wall = time.perf_counter() - t0
 
     from distributed_tensorflow_tpu.obs import goodput
@@ -90,7 +218,9 @@ def main(argv=None):
     )
 
     decode_steps = [s for s in stats if s.decoded_slots]
-    full = sum(1 for s in decode_steps if s.occupancy == 1.0)
+    full = sum(1 for s in stats if s.occupancy == 1.0)
+    backlog_steps = [s for s, b in zip(stats, backlog) if b]
+    full_backlog = sum(1 for s in backlog_steps if s.occupancy == 1.0)
     # percentile read-back via the SHARED helper (obs/goodput.py): one
     # formula for the printed numbers and any registry consumer
     pct = lambda name, qs=(0.5, 0.99): goodput.latency_percentiles_ms(  # noqa: E731
@@ -103,6 +233,8 @@ def main(argv=None):
     # provenance block (obs/scaling.py): every serve-bench row carries
     # its backend context, same stamp as bench.py / tools/sweep.py
     result = scaling.stamp_provenance({
+        "preset": args.preset,
+        "kv_layout": "dense" if args.dense else "paged",
         "requests": args.requests,
         "slots": args.slots,
         "steps": len(stats),
@@ -116,10 +248,41 @@ def main(argv=None):
         "queue_wait_p50_ms": qwait_ms["p50_ms"],
         "mean_occupancy": round(
             sum(s.occupancy for s in decode_steps) / len(decode_steps), 3
-        ),
+        ) if decode_steps else None,
         "full_batch_steps": full,
-        "full_batch_frac": round(full / len(decode_steps), 3),
+        "full_batch_frac": round(full / len(stats), 3),
+        "full_batch_frac_backlog": round(
+            full_backlog / len(backlog_steps), 3) if backlog_steps else None,
+        # starvation bound: steps between consecutive tokens of one
+        # request — chunked prefill must interleave, never stall decode
+        "max_intertoken_steps": max_gap,
     })
+    if not args.dense and kv_samples:
+        # KV footprint: what a resident request actually costs, vs the
+        # max_len row the dense layout would pin for it (kv_samples is
+        # empty when every request finished at its prefill token — no
+        # decode step ever sampled the pool)
+        bpb = eng.cache.block_nbytes()
+        # what the dense layout pins per resident: a full max_len row
+        dense_per_req = bpb // args.block_size * cfg.max_len
+        per_res = [u * bpb / r for u, r in kv_samples if r]
+        result.update({
+            "block_size": args.block_size,
+            "num_blocks": eng.cache.num_blocks,
+            "kv_block_bytes": bpb,
+            "kv_blocks_peak": max(u for u, _ in kv_samples),
+            "kv_bytes_per_resident_request": round(
+                sum(per_res) / len(per_res)),
+            "kv_bytes_per_request_dense": dense_per_req,
+            "kv_bytes_saved_frac": round(
+                1.0 - sum(per_res) / len(per_res) / dense_per_req, 3),
+            "prefix_reuse_hits": int(
+                reg.get("prefix_reuse_hits_total").value),
+            "prefill_chunks": int(reg.get("prefill_chunks_total").value),
+            "kv_block_evictions": int(
+                reg.get("kv_block_evictions_total").value),
+            "cow_copies": eng.alloc.cow_copies - cow_at_reset,
+        })
     # Chaos epilogue (ISSUE 3 acceptance): exercise the timeout and
     # cancel eviction paths on the SAME engine and re-check the
     # histogram-counts == Σ serve_finished_total invariant with the new
@@ -146,6 +309,14 @@ def main(argv=None):
     assert reg.get("serve_tpot_seconds").count == total, (
         f"tpot count != finished after timeout/cancel evictions ({reasons})"
     )
+    # leak gate: a drained paged engine hands EVERY block back
+    eng.drain()
+    if not args.dense:
+        assert eng.alloc.blocks_free == eng.cache.num_blocks, (
+            f"leaked blocks: {eng.alloc.blocks_in_use} still referenced "
+            f"after drain"
+        )
+        result["leak_free_shutdown"] = True
 
     print(json.dumps(result, indent=2))
     if args.json:
@@ -154,6 +325,17 @@ def main(argv=None):
     if result["full_batch_steps"] == 0:
         print("FAIL: never sustained a full decode batch", file=sys.stderr)
         return 1
+    if args.preset == "chaos":
+        frac = result["full_batch_frac_backlog"]
+        if frac is not None and frac < 0.9:
+            print(f"FAIL: full_batch_frac_backlog={frac} < 0.9 under "
+                  f"chaos traffic", file=sys.stderr)
+            return 1
+        if result["max_intertoken_steps"] > 1 and not args.dense \
+                and args.blocks is None:
+            print(f"FAIL: a resident decoder starved for "
+                  f"{result['max_intertoken_steps']} steps", file=sys.stderr)
+            return 1
     return 0
 
 

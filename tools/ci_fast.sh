@@ -47,6 +47,13 @@
 #      ckpt_restore → fleet restart — in causal order (ISSUE 8), and
 #      the elastic round's dump the resize story — worker dead →
 #      fleet_shrink → fleet_rejoin → fleet_done (ISSUE 12)
+#   7. tools/bench_serve.py  — paged-KV serve smoke (ISSUE 13): the
+#      mixed-length chaos preset on the tiny model, chaos epilogue
+#      included, gating (a) 64-step greedy parity of the paged path
+#      against the dense fallback (--parity-check), (b) leak-free
+#      shutdown (the block allocator back to all-free after drain),
+#      and (c) full-batch occupancy under backlog + the one-chunk
+#      starvation bound for resident decoders
 #
 # Usage: tools/ci_fast.sh   (extra args are passed to smoke_collect)
 set -euo pipefail
@@ -75,4 +82,6 @@ env JAX_PLATFORMS=cpu python tools/postmortem.py \
 env JAX_PLATFORMS=cpu python tools/postmortem.py \
   "${DTF_ELASTIC_POSTMORTEM:-artifacts/elastic_postmortem.jsonl}" --quiet \
   --expect 'fleet_worker_dead,fleet_shrink,fleet_rejoin,fleet_done'
+env JAX_PLATFORMS=cpu python tools/bench_serve.py --preset chaos \
+  --requests 10 --slots 4 --max-new 8 --parity-check >/dev/null
 echo "ci_fast: all gates passed"
