@@ -23,6 +23,13 @@ FIRES_MARKER = "# fires-here"
 FIXTURE_PATHS: dict[str, str] = {
     "wall-clock-in-seam":
         "distributed_tensorflow_tpu/data/_fixture_{corpus}.py",
+    # axis literals are checked only inside the mesh-consuming dirs
+    "mesh-axis-closed-vocab":
+        "distributed_tensorflow_tpu/parallel/_fixture_{corpus}.py",
+    # placement constructions are checked across the package dirs,
+    # outside the seam file itself
+    "sharding-seam-bypass":
+        "distributed_tensorflow_tpu/serve/_fixture_{corpus}.py",
 }
 
 
@@ -135,6 +142,36 @@ class Worker:
         self._m_restarts = registry.counter(  # fires-here
             "worker_restarts", "restarts observed")
 ''',
+    "shard-rules-coverage": '''\
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.sharding import partition_rules
+
+TABLE = partition_rules(
+    "fixture-model",
+    (
+        (r"kernel$", P(None, "model")),
+        (r"kernle$", P("model")),  # fires-here
+        (r".*", P()),
+    ),
+    coverage=("layer_0/kernel", "layer_0/bias"),
+)
+''',
+    "mesh-axis-closed-vocab": '''\
+from jax import lax
+
+
+def global_sum(x):
+    return lax.psum(x, "dtaa")  # fires-here
+''',
+    "sharding-seam-bypass": '''\
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def place_batch(mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P("data")))  # fires-here
+''',
 }
 
 
@@ -245,6 +282,55 @@ class Worker:
         self._m_occupancy = registry.gauge(
             "worker_occupancy", "active slots at the last step")
 ''',
+    "shard-rules-coverage": '''\
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.sharding import partition_rules
+
+TABLE = partition_rules(
+    "fixture-model",
+    (
+        (r"kernel$", P(None, "model")),
+        (r".*", P()),
+    ),
+    coverage=("layer_0/kernel", "layer_0/bias"),
+)
+''',
+    "mesh-axis-closed-vocab": '''\
+from jax import lax
+
+from ..parallel import mesh as mesh_lib
+
+
+def global_sum(x):
+    # vocabulary axes — as literals or (better) the mesh_lib constants
+    partial = lax.psum(x, "data")
+    return lax.psum(partial, mesh_lib.MODEL)
+''',
+    "sharding-seam-bypass": '''\
+from jax.sharding import PartitionSpec as P
+
+from ..parallel import sharding
+from ..utils.compat import shard_map
+
+
+def cache_rules():
+    # carve-out (a): *_rules row builders compose partition tables
+    return ((r"(^|/)(k|v)$", P(None, "model")),)
+
+
+def island_mean(mesh, x):
+    # carve-out (b): specs inside a shard_map island describe the
+    # island's local view, not persistent placement
+    f = shard_map(lambda a: a.mean(), mesh=mesh,
+                  in_specs=P("data"), out_specs=P())
+    return f(x)
+
+
+def place_batch(mesh, x):
+    # persistent placement goes through the seam helpers
+    return sharding.shard_leading_dim(x, mesh, "data")
+''',
 }
 
 
@@ -333,6 +419,40 @@ class Worker:
         # legacy dashboard name, reviewed
         self._m_restarts = registry.counter(  # dtflint: disable=metric-naming
             "worker_restarts", "restarts observed")
+''',
+    "shard-rules-coverage": '''\
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.sharding import partition_rules
+
+TABLE = partition_rules(
+    "fixture-model",
+    (
+        (r"kernel$", P(None, "model")),
+        # variant row kept for an out-of-run tree, reviewed
+        (r"kernle$", P("model")),  # dtflint: disable=shard-rules-coverage
+        (r".*", P()),
+    ),
+    coverage=("layer_0/kernel", "layer_0/bias"),
+)
+''',
+    "mesh-axis-closed-vocab": '''\
+from jax import lax
+
+
+def global_sum(x):
+    # dynamically bound sub-axis, reviewed
+    return lax.psum(x, "dtaa")  # dtflint: disable=mesh-axis-closed-vocab
+''',
+    "sharding-seam-bypass": '''\
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def place_batch(mesh, x):
+    # transitional call site, reviewed — migrating to the seam next PR
+    # dtflint: disable=sharding-seam-bypass
+    return jax.device_put(x, NamedSharding(mesh, P("data")))
 ''',
 }
 

@@ -5,7 +5,10 @@ One module per rule family; each rule's docstring is its catalog entry
 The v1 five (host-sync, donation, locks, vocab, exceptions) are joined
 by the v2 contract rules (determinism, durability, naming), and the
 reachability rules now run on the analysis/callgraph.py project-scope
-engine.
+engine. The v3 partitioning family (shard-rules-coverage,
+mesh-axis-closed-vocab, sharding-seam-bypass) audits the sharding seam:
+rules tables total and live, axis names in the closed mesh vocabulary,
+placement constructed only at parallel/sharding.py.
 """
 
 from . import (  # noqa: F401
@@ -16,10 +19,11 @@ from . import (  # noqa: F401
     host_sync,
     locks,
     naming,
+    partitioning,
     vocab,
 )
 
 __all__ = [
     "determinism", "donation", "durability", "exceptions",
-    "host_sync", "locks", "naming", "vocab",
+    "host_sync", "locks", "naming", "partitioning", "vocab",
 ]

@@ -1,0 +1,489 @@
+"""The sharding seam's three static contracts (PR 14, ROADMAP item 3).
+
+The partition-rules engine (parallel/sharding.py ``partition_rules`` /
+``match_partition_rules``) makes sharding assignment declarative: every
+shipped model resolves its specs through a named (regex → PartitionSpec)
+table with a static ``coverage`` param-path fixture. These rules keep
+that seam honest without importing jax:
+
+- **shard-rules-coverage** — every statically-readable
+  ``partition_rules(...)`` table in the run compiles, ships a coverage
+  fixture, and satisfies the totality/liveness contract against it under
+  first-match precedence: every coverage path is won by some row, every
+  row wins at least one path. A rotted regex (or a row shadowed by an
+  earlier one — the pre-engine wide_deep ``table_\\d+`` swallowing every
+  ``wide_table_`` param) is a lint error here before it is a runtime
+  ``PartitionCoverageError`` anywhere. Table names must be unique across
+  the run. ``partition_rules`` calls are resolved through the
+  PR 10 cross-module call graph (import-aliased and module-qualified
+  spellings all land on ``parallel.sharding.partition_rules``); a bare
+  ``partition_rules``/``*.partition_rules`` call that the graph cannot
+  resolve is still checked (fixtures, scratch trees). Rows whose pattern
+  is not a string literal make a table non-simulatable: its match checks
+  are skipped (regexes that ARE literal still compile-check).
+
+- **mesh-axis-closed-vocab** — every axis name appearing as a STRING
+  LITERAL in a ``PartitionSpec(...)`` construction or in a collective's
+  axis argument (``lax.psum(x, "data")``, ``axis_name=...``) inside the
+  mesh-consuming dirs (parallel/, ops/, train/, serve/, models/ — the
+  rules tables live there) must belong to
+  the declared vocabulary ``parallel/mesh.AXIS_NAMES`` (parsed, never
+  imported). A typo'd axis is a lint error, not a runtime unbound-axis
+  crash — or worse, a collective over the wrong axis that HANGS a pod.
+  Axis names carried by ``mesh_lib.MODEL``-style constants are already
+  import-checked; dynamic names (``factor_mesh_axis`` sub-axes) are
+  invisible by design.
+
+- **sharding-seam-bypass** — constructing ``NamedSharding`` or
+  ``PartitionSpec`` inside the package, outside the seam, is an error:
+  all persistent-state placement flows through parallel/sharding.py and
+  the rules tables. Two reviewed carve-outs, both structural: (a) rows
+  of a rules table — arguments of a ``partition_rules(...)`` call, or
+  any function named ``*_rules`` (the composable row builders:
+  ops/moe.moe_rules); (b) shard_map island layouts — specs built inside
+  a function that itself calls ``shard_map`` describe that island's
+  local view, not persistent placement (ring_attention / pipeline /
+  fused-BN entry specs). Everything else routes through the seam's
+  helpers (``named_sharding`` / ``tree_shardings`` / ``shard_tree`` /
+  ``replicated_specs`` / ``shard_leading_dim``) — pre-fix examples:
+  ops/embedding.to_mod_sharded's ad-hoc device_put,
+  train/checkpoint._restore_step's inline NamedSharding map.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..callgraph import get_callgraph, module_name
+from ..core import (
+    Finding, LintContext, Module, Rule, dotted_name, register, seam_match,
+)
+
+MESH_PATH = "distributed_tensorflow_tpu/parallel/mesh.py"
+SHARDING_MODULE = "distributed_tensorflow_tpu.parallel.sharding"
+
+#: dirs whose code consumes mesh axes (the mesh-axis-closed-vocab
+#: scope): the ISSUE-named four plus models/ — the rules tables living
+#: there spell axes as mesh_lib constants, but a literal typo in a
+#: table row would be exactly the crash class this rule exists to stop
+AXIS_SCOPE = ("parallel/", "ops/", "train/", "serve/", "models/")
+
+#: the seam file — the one place free to construct placement objects
+SEAM_FILE = ("parallel/sharding.py",)
+
+#: package dirs in the seam-bypass scope: the repo-rooted package plus
+#: its subpackages, so package-relative invocations (``dtf_lint serve/``)
+#: stay covered, mirroring core.seam_match's contract
+PACKAGE_DIRS = (
+    "distributed_tensorflow_tpu/", "models/", "ops/", "parallel/",
+    "serve/", "train/", "data/", "obs/", "resilience/", "runtime/",
+    "workloads/", "utils/",
+)
+
+#: collective verbs whose axis argument (2nd positional, or the
+#: axis/axis_name keyword) names mesh axes — jax.lax primitives plus the
+#: parallel/collectives.py vocabulary built on them
+COLLECTIVE_NAMES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pshuffle", "axis_index", "axis_size",
+    "all_reduce", "all_mean", "reduce_scatter", "broadcast_from",
+    "barrier_sum",
+})
+
+_AXIS_KEYWORDS = frozenset({"axis", "axis_name", "axis_names"})
+
+
+# ---------------------------------------------------------------------------
+# shared extraction helpers
+# ---------------------------------------------------------------------------
+
+
+def _axis_vocab(ctx: LintContext) -> frozenset | None:
+    """parallel/mesh.AXIS_NAMES, parsed once per run."""
+    if "mesh_axis_vocab" in ctx.scratch:
+        return ctx.scratch["mesh_axis_vocab"]
+    vocab = None
+    src = ctx.read_repo_file(MESH_PATH)
+    if src:
+        for node in ast.parse(src).body:
+            # both spellings: AXIS_NAMES = (...) and the annotated
+            # AXIS_NAMES: tuple[str, ...] = (...) mesh.py actually uses
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target, value = node.target, node.value
+            else:
+                continue
+            if (isinstance(target, ast.Name)
+                    and target.id == "AXIS_NAMES"
+                    and isinstance(value, (ast.Tuple, ast.List))):
+                vals = [e.value for e in value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+                vocab = frozenset(vals)
+    ctx.scratch["mesh_axis_vocab"] = vocab
+    return vocab
+
+
+def _spec_ctor_names(module: Module) -> dict[str, str]:
+    """Local name → canonical ctor ('PartitionSpec'/'NamedSharding')
+    for names this module binds from jax.sharding (``from jax.sharding
+    import PartitionSpec as P``), read off the import statements — no
+    jax import needed."""
+    names: dict[str, str] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == "jax.sharding":
+            for a in node.names:
+                if a.name in ("PartitionSpec", "NamedSharding"):
+                    names[a.asname or a.name] = a.name
+    return names
+
+
+def _ctor_kind(call: ast.Call, ctors: dict[str, str]) -> str | None:
+    """'PartitionSpec' / 'NamedSharding' when ``call`` constructs one:
+    a name this module imported from jax.sharding (any alias), or a
+    dotted spelling whose LEAF is the canonical class name
+    (``jax.sharding.PartitionSpec``). A module-local rebind
+    (``SpecCls = PartitionSpec``) or a re-exported alias on another
+    module (``somemod.P``) is not resolved — the repo idiom is the
+    direct import, and the heuristic is documented as such."""
+    dn = dotted_name(call.func)
+    if dn is None:
+        return None
+    if dn in ctors:
+        return ctors[dn]
+    leaf = dn.rpartition(".")[2]
+    if leaf in ("PartitionSpec", "NamedSharding") and "." in dn:
+        return leaf
+    return None
+
+
+def _is_partition_rules_call(call: ast.Call, module: Module,
+                             ctx: LintContext) -> bool:
+    """Does ``call`` invoke parallel.sharding.partition_rules? Resolved
+    through the cross-module call graph when the import chain is in the
+    run; name-matched otherwise (fixtures lint standalone)."""
+    dn = dotted_name(call.func)
+    if dn is None or dn.rpartition(".")[2] != "partition_rules":
+        return False
+    graph = get_callgraph(ctx)
+    mnode = graph.nodes.get(module_name(module.path))
+    if mnode is not None:
+        target = graph.resolve_callable(mnode, dn)
+        if target is not None:
+            tmod, tfn = target
+            # package-relative invocations (``dtf_lint parallel/``)
+            # name the seam module without the repo-rooted prefix
+            return tfn == "partition_rules" \
+                and tmod.endswith("parallel.sharding")
+    return True  # unresolvable: trust the distinctive name
+
+
+def _literal_strings(node: ast.AST) -> Iterator[tuple[str, ast.AST]]:
+    """String constants in ``node``, descending through tuples/lists
+    (PartitionSpec entries may be tuples of axis names)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            yield from _literal_strings(e)
+
+
+# ---------------------------------------------------------------------------
+# shard-rules-coverage
+# ---------------------------------------------------------------------------
+
+
+class _TableRow:
+    def __init__(self, node: ast.AST, pattern: str | None):
+        self.node = node
+        self.pattern = pattern  # None = dynamic (not a string literal)
+
+
+def _module_constant_node(module: Module, name: str) -> ast.AST | None:
+    """The value node of a module-level ``NAME = <expr>`` binding —
+    plain or annotated (``NAME: tuple[str, ...] = <expr>``), like
+    ``_axis_vocab``, so an annotated coverage constant cannot silently
+    opt a table out of the simulation."""
+    for node in module.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name):
+            return node.value
+        if (isinstance(node, ast.AnnAssign) and node.value is not None
+                and isinstance(node.target, ast.Name)
+                and node.target.id == name):
+            return node.value
+    return None
+
+
+def _extract_table(call: ast.Call, module: Module):
+    """(name, rows, coverage, coverage_node) from a partition_rules
+    call. A ``coverage=NAME`` reference resolves through the module's
+    own constants (the shipped tables freeze their fixture as a literal
+    module-level tuple next to the table). ``coverage`` comes back as a
+    list of (path, anchor-node) pairs, ``None`` when the expression is
+    not statically readable."""
+    name = None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        name = call.args[0].value
+    rules_node = call.args[1] if len(call.args) >= 2 else None
+    coverage_node = None
+    for kw in call.keywords:
+        if kw.arg == "rules" and rules_node is None:
+            rules_node = kw.value
+        if kw.arg == "coverage":
+            coverage_node = kw.value
+    rows: list[_TableRow] = []
+    if isinstance(rules_node, (ast.Tuple, ast.List)):
+        for elt in rules_node.elts:
+            if isinstance(elt, (ast.Tuple, ast.List)) and elt.elts:
+                first = elt.elts[0]
+                if isinstance(first, ast.Constant) \
+                        and isinstance(first.value, str):
+                    pattern = first.value
+                elif dotted_name(first) is not None and \
+                        dotted_name(first).rpartition(".")[2] == "CATCH_ALL":
+                    # the seam's declared catch-all constant — resolve it
+                    # so the conventional final row does not turn the
+                    # whole table non-simulatable
+                    pattern = r".*"
+                else:
+                    pattern = None
+                rows.append(_TableRow(elt, pattern))
+            else:
+                rows.append(_TableRow(elt, None))
+    resolved = coverage_node
+    if isinstance(resolved, ast.Name):
+        resolved = _module_constant_node(module, resolved.id)
+    coverage: list[tuple[str, ast.AST]] | None = []
+    if isinstance(resolved, (ast.Tuple, ast.List)):
+        for s, n in _literal_strings(resolved):
+            coverage.append((s, n))
+        if len(coverage) != len(resolved.elts):
+            coverage = None  # some entries are computed: opaque
+    elif coverage_node is not None:
+        coverage = None
+    return name, rows, coverage, coverage_node
+
+
+@register
+class ShardRulesCoverageRule(Rule):
+    name = "shard-rules-coverage"
+    summary = ("every partition_rules table compiles, ships a coverage "
+               "fixture, and is total with no dead rules against it "
+               "(first-match precedence)")
+
+    def check_module(self, module: Module,
+                     ctx: LintContext) -> Iterator[Finding]:
+        tables = ctx.scratch.setdefault("partition_tables", {})
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) \
+                    or not _is_partition_rules_call(node, module, ctx):
+                continue
+            name, rows, coverage, coverage_node = _extract_table(
+                node, module)
+            if name is not None:
+                prev = tables.get(name)
+                if prev is not None and prev != (module.path, node.lineno):
+                    yield Finding(
+                        self.name, module.path, node.lineno,
+                        node.col_offset,
+                        f"partition rules table name {name!r} is already "
+                        f"defined at {prev[0]}:{prev[1]} — table names "
+                        f"are the attribution/debugging handle and must "
+                        f"be unique across the tree",
+                    )
+                else:
+                    tables[name] = (module.path, node.lineno)
+            compiled: list[re.Pattern | None] = []
+            simulatable = True
+            for row in rows:
+                if row.pattern is None:
+                    simulatable = False
+                    compiled.append(None)
+                    continue
+                try:
+                    compiled.append(re.compile(row.pattern))
+                except re.error as e:
+                    yield Finding(
+                        self.name, module.path, row.node.lineno,
+                        row.node.col_offset,
+                        f"rule pattern {row.pattern!r} in table "
+                        f"{name!r} does not compile: {e}",
+                    )
+                    simulatable = False
+                    compiled.append(None)
+            if coverage_node is None or coverage == []:
+                yield Finding(
+                    self.name, module.path, node.lineno, node.col_offset,
+                    f"partition rules table {name!r} ships no coverage "
+                    f"fixture — with no static param-path listing, "
+                    f"totality and dead-rule liveness cannot be checked "
+                    f"until a training run crashes; freeze the served "
+                    f"tree's paths into coverage=(...)",
+                )
+                continue
+            if coverage is None or not simulatable or not rows:
+                continue  # opaque coverage/rows: compile checks only
+            won: set[int] = set()
+            for path, pnode in coverage:
+                for i, rx in enumerate(compiled):
+                    if rx is not None and rx.search(path):
+                        won.add(i)
+                        break
+                else:
+                    yield Finding(
+                        self.name, module.path, pnode.lineno,
+                        pnode.col_offset,
+                        f"coverage path {path!r} matches NO rule of "
+                        f"table {name!r} — the table is not total; at "
+                        f"runtime this param would raise "
+                        f"PartitionCoverageError (declare the "
+                        f"replicated remainder with a catch-all row)",
+                    )
+            for i, row in enumerate(rows):
+                if i not in won and row.pattern is not None:
+                    yield Finding(
+                        self.name, module.path, row.node.lineno,
+                        row.node.col_offset,
+                        f"rule {row.pattern!r} in table {name!r} wins "
+                        f"no coverage path under first-match precedence "
+                        f"— a dead rule is a typo or is shadowed by an "
+                        f"earlier row; fix the pattern, reorder, or "
+                        f"delete it",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis-closed-vocab
+# ---------------------------------------------------------------------------
+
+
+@register
+class MeshAxisClosedVocabRule(Rule):
+    name = "mesh-axis-closed-vocab"
+    summary = ("axis-name string literals in PartitionSpec constructions "
+               "and collective axis arguments (parallel/, ops/, train/, "
+               "serve/, models/) must be in parallel/mesh.AXIS_NAMES")
+
+    def check_module(self, module: Module,
+                     ctx: LintContext) -> Iterator[Finding]:
+        if not seam_match(module.path, AXIS_SCOPE):
+            return
+        vocab = _axis_vocab(ctx)
+        if not vocab:
+            return  # vocabulary unreadable: stay silent, never guess
+        ctors = _spec_ctor_names(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            leaf = dn.rpartition(".")[2] if dn else None
+            kind = _ctor_kind(node, ctors)
+            checks: list[tuple[str, ast.AST, str]] = []
+            if kind == "PartitionSpec":
+                for s, n in (x for a in node.args
+                             for x in _literal_strings(a)):
+                    checks.append((s, n, "PartitionSpec entry"))
+            if leaf in COLLECTIVE_NAMES:
+                if len(node.args) >= 2:
+                    for s, n in _literal_strings(node.args[1]):
+                        checks.append((s, n, f"{leaf}() axis"))
+            for kw in node.keywords:
+                if kw.arg in _AXIS_KEYWORDS and (
+                        leaf in COLLECTIVE_NAMES
+                        or kind == "PartitionSpec"):
+                    for s, n in _literal_strings(kw.value):
+                        checks.append((s, n, f"{kw.arg}="))
+            for axis, anchor, where in checks:
+                if axis not in vocab:
+                    yield Finding(
+                        self.name, module.path, anchor.lineno,
+                        anchor.col_offset,
+                        f"axis name {axis!r} ({where}) is not in the "
+                        f"declared mesh-axis vocabulary "
+                        f"{sorted(vocab)} (parallel/mesh.AXIS_NAMES) — "
+                        f"a typo'd axis is an unbound-axis crash or a "
+                        f"collective over the WRONG axis that hangs a "
+                        f"pod; use the mesh_lib constants",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# sharding-seam-bypass
+# ---------------------------------------------------------------------------
+
+
+def _contains_shard_map(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn is not None and dn.rpartition(".")[2] == "shard_map":
+                return True
+    return False
+
+
+@register
+class ShardingSeamBypassRule(Rule):
+    name = "sharding-seam-bypass"
+    summary = ("NamedSharding/PartitionSpec are constructed only in "
+               "parallel/sharding.py, rules tables, and shard_map "
+               "island layouts — placement flows through the seam")
+
+    def check_module(self, module: Module,
+                     ctx: LintContext) -> Iterator[Finding]:
+        path = module.path.replace("\\", "/")
+        if not seam_match(path, PACKAGE_DIRS) \
+                or seam_match(path, SEAM_FILE) \
+                or "/analysis/" in f"/{path}" \
+                or "/tests/" in f"/{path}":
+            return
+        ctors = _spec_ctor_names(module)
+        if not ctors and "PartitionSpec" not in module.source \
+                and "NamedSharding" not in module.source:
+            return  # cheap pre-filter: nothing to construct one with
+        findings: list[Finding] = []
+        shard_map_cache: dict[ast.AST, bool] = {}
+
+        def fn_allows(fn: ast.AST) -> bool:
+            if fn not in shard_map_cache:
+                shard_map_cache[fn] = (
+                    fn.name.endswith("_rules")
+                    or _contains_shard_map(fn)
+                )
+            return shard_map_cache[fn]
+
+        def visit(node: ast.AST, fn_stack: tuple, in_table: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_stack = fn_stack + (node,)
+            if isinstance(node, ast.Call):
+                if _is_partition_rules_call(node, module, ctx):
+                    in_table = True
+                kind = _ctor_kind(node, ctors)
+                if kind is not None and not in_table \
+                        and not any(fn_allows(f) for f in fn_stack):
+                    helper = ("sharding.named_sharding / tree_shardings "
+                              "/ shard_tree / shard_leading_dim"
+                              if kind == "NamedSharding" else
+                              "a partition_rules table, "
+                              "sharding.REPLICATED / replicated_specs, "
+                              "or a seam helper")
+                    findings.append(Finding(
+                        self.name, module.path, node.lineno,
+                        node.col_offset,
+                        f"{kind} constructed outside the sharding seam "
+                        f"— all placement assignment flows through "
+                        f"parallel/sharding.py and the rules tables "
+                        f"(carve-outs: *_rules row builders, shard_map "
+                        f"island layouts); use {helper}",
+                    ))
+            for child in ast.iter_child_nodes(node):
+                visit(child, fn_stack, in_table)
+
+        visit(module.tree, (), False)
+        yield from findings

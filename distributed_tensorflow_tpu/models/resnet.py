@@ -19,7 +19,44 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..parallel import sharding
 from ..utils.compat import shard_map
+
+#: Coverage fixture: the stage_sizes=(1, 1) tree (every param family the
+#: full ResNet-50 tree repeats — stem, bottleneck convs/BNs incl. the
+#: projection shortcut, head). Pinned to the live model by
+#: tests/test_sharding.py::test_resnet_coverage_fixture_is_live.
+#: (fully literal — the dtflint shard-rules-coverage rule reads it
+#: statically)
+_RESNET_COVERAGE = (
+    "head/bias", "head/kernel",
+    "stage0_block0/bn1/bias", "stage0_block0/bn1/scale",
+    "stage0_block0/bn2/bias", "stage0_block0/bn2/scale",
+    "stage0_block0/bn3/bias", "stage0_block0/bn3/scale",
+    "stage0_block0/conv1/kernel", "stage0_block0/conv2/kernel",
+    "stage0_block0/conv3/kernel",
+    "stage0_block0/proj_bn/bias", "stage0_block0/proj_bn/scale",
+    "stage0_block0/proj_conv/kernel",
+    "stage1_block0/bn1/bias", "stage1_block0/bn1/scale",
+    "stage1_block0/bn2/bias", "stage1_block0/bn2/scale",
+    "stage1_block0/bn3/bias", "stage1_block0/bn3/scale",
+    "stage1_block0/conv1/kernel", "stage1_block0/conv2/kernel",
+    "stage1_block0/conv3/kernel",
+    "stage1_block0/proj_bn/bias", "stage1_block0/proj_bn/scale",
+    "stage1_block0/proj_conv/kernel",
+    "stem_bn/bias", "stem_bn/scale", "stem_conv/kernel",
+)
+
+#: Partition-rules table: ResNet trains pure data-parallel — every param
+#: is DECLARED replicated (batch sharding rides (data, fsdp) via
+#: batch_spec; BatchNorm syncs for free under GSPMD). A one-row table is
+#: still the seam: adding a sharded param family later means adding a
+#: row here, not hand-authoring a spec tree.
+RESNET_RULES = sharding.partition_rules(
+    "resnet",
+    ((sharding.CATCH_ALL, sharding.REPLICATED),),
+    coverage=_RESNET_COVERAGE,
+)
 
 
 @dataclasses.dataclass(frozen=True)

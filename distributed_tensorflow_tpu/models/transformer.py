@@ -23,7 +23,6 @@ choices here:
 from __future__ import annotations
 
 import dataclasses
-import re
 from typing import Any
 
 import flax.linen as nn
@@ -36,6 +35,7 @@ from ..ops.attention import attention_reference, blockwise_attention
 from ..ops.flash_attention import flash_attention
 from ..ops.moe import collect_aux_loss
 from ..parallel import mesh as mesh_lib
+from ..parallel import sharding
 from ..parallel.ring_attention import sequence_parallel_attention
 from ..utils import flops as flops_lib
 
@@ -120,32 +120,102 @@ def gpt_small(causal_len: int = 1024) -> TransformerConfig:
 
 
 # ---------------------------------------------------------------------------
-# Tensor-parallel layout (megatron column/row pattern)
+# Tensor-parallel layout (megatron column/row pattern) — the rules table
 # ---------------------------------------------------------------------------
 
-#: Path-regex sharding rules for any Transformer tree (sharding.PathRules).
-#: Column-parallel in (output dim over `model`), row-parallel out (input dim
-#: over `model`) — one all-reduce per block half, placed by GSPMD on ICI.
-TP_PATH_RULES = (
-    (r"(query|key|value)/kernel", P(None, mesh_lib.MODEL)),
-    (r"(query|key|value)/bias", P(mesh_lib.MODEL)),
-    (r"qkv/kernel", P(None, mesh_lib.MODEL)),  # fused_qkv layout
-    (r"qkv/bias", P(mesh_lib.MODEL)),
-    (r"attn_out/kernel", P(mesh_lib.MODEL, None)),
-    (r"mlp_in/kernel", P(None, mesh_lib.MODEL)),
-    (r"mlp_in/bias", P(mesh_lib.MODEL)),
-    (r"mlp_out/kernel", P(mesh_lib.MODEL, None)),
-    (r"tok_embed/embedding", P(mesh_lib.MODEL, None)),  # vocab-sharded
-    (r"mlm_bias", P(mesh_lib.MODEL)),
+#: Static param-path coverage fixture for TRANSFORMER_RULES: the UNION of
+#: the three shipped tree variants at num_layers=2 — BERT encoder
+#: (post-LN, split q/k/v, dense MLP), causal pre-LN decoder with
+#: fused_qkv, and the MoE interleave (num_experts>0, moe_every=2).
+#: tests/test_sharding.py::test_transformer_coverage_fixture_is_live
+#: regenerates this union from the real models and pins it; the dtflint
+#: shard-rules-coverage rule re-checks totality/liveness against it on
+#: every lint run.
+#: (fully literal — the dtflint shard-rules-coverage rule reads it
+#: statically, so no comprehension/format indirection)
+_TRANSFORMER_COVERAGE = (
+    "embed_ln/bias", "embed_ln/scale", "final_ln/bias", "final_ln/scale",
+    "layer_0/attn/attn_out/bias", "layer_0/attn/attn_out/kernel",
+    "layer_0/attn/key/bias", "layer_0/attn/key/kernel",
+    "layer_0/attn/qkv/bias", "layer_0/attn/qkv/kernel",
+    "layer_0/attn/query/bias", "layer_0/attn/query/kernel",
+    "layer_0/attn/value/bias", "layer_0/attn/value/kernel",
+    "layer_0/ln1/bias", "layer_0/ln1/scale", "layer_0/ln2/bias",
+    "layer_0/ln2/scale", "layer_0/mlp_in/bias", "layer_0/mlp_in/kernel",
+    "layer_0/mlp_out/bias", "layer_0/mlp_out/kernel",
+    "layer_1/attn/attn_out/bias", "layer_1/attn/attn_out/kernel",
+    "layer_1/attn/key/bias", "layer_1/attn/key/kernel",
+    "layer_1/attn/qkv/bias", "layer_1/attn/qkv/kernel",
+    "layer_1/attn/query/bias", "layer_1/attn/query/kernel",
+    "layer_1/attn/value/bias", "layer_1/attn/value/kernel",
+    "layer_1/ln1/bias", "layer_1/ln1/scale", "layer_1/ln2/bias",
+    "layer_1/ln2/scale", "layer_1/mlp_in/bias", "layer_1/mlp_in/kernel",
+    "layer_1/mlp_out/bias", "layer_1/mlp_out/kernel", "layer_1/moe/b_in",
+    "layer_1/moe/b_out", "layer_1/moe/router/bias",
+    "layer_1/moe/router/kernel", "layer_1/moe/w_in", "layer_1/moe/w_out",
+    "mlm_bias", "mlm_ln/bias", "mlm_ln/scale", "mlm_transform/bias",
+    "mlm_transform/kernel", "pos_embed", "tok_embed/embedding",
+)
+
+#: The Transformer family's partition-rules table (parallel/sharding.py
+#: engine; docs/parallelism.md "Authoring partition-rules tables").
+#: Column-parallel in (output dim over `model`), row-parallel out (input
+#: dim over `model`) — one all-reduce per block half, placed by GSPMD on
+#: ICI. Variant-conditional rows carry tags; ``transformer_rules(cfg)``
+#: selects the exact table for a config, so a dead row (or a param the
+#: table forgot) is a hard PartitionCoverageError, not a silent layout.
+#: The four MoE rows mirror ops.moe.moe_rules() (pinned by
+#: tests/test_sharding.py::test_transformer_moe_rows_mirror_moe_rules).
+TRANSFORMER_RULES = sharding.partition_rules(
+    "transformer",
+    (
+        # MoE experts first: "moe/w_in" must not fall through to the
+        # dense "mlp_in" patterns (first-match precedence)
+        (r"(^|/)w_in$", P(mesh_lib.EXPERT, None, mesh_lib.MODEL), "moe"),
+        (r"(^|/)b_in$", P(mesh_lib.EXPERT, mesh_lib.MODEL), "moe"),
+        (r"(^|/)w_out$", P(mesh_lib.EXPERT, mesh_lib.MODEL, None), "moe"),
+        (r"(^|/)b_out$", P(mesh_lib.EXPERT, None), "moe"),
+        (r"(query|key|value)/kernel", P(None, mesh_lib.MODEL), "split_qkv"),
+        (r"(query|key|value)/bias", P(mesh_lib.MODEL), "split_qkv"),
+        (r"qkv/kernel", P(None, mesh_lib.MODEL), "fused_qkv"),
+        (r"qkv/bias", P(mesh_lib.MODEL), "fused_qkv"),
+        (r"attn_out/kernel", P(mesh_lib.MODEL, None)),
+        (r"mlp_in/kernel", P(None, mesh_lib.MODEL), "dense_mlp"),
+        (r"mlp_in/bias", P(mesh_lib.MODEL), "dense_mlp"),
+        (r"mlp_out/kernel", P(mesh_lib.MODEL, None), "dense_mlp"),
+        (r"tok_embed/embedding", P(mesh_lib.MODEL, None)),  # vocab-sharded
+        (r"mlm_bias", P(mesh_lib.MODEL)),
+        # everything else (LayerNorms, pos_embed, biases of row-parallel
+        # projections, the MoE router) is DECLARED replicated
+        (sharding.CATCH_ALL, sharding.REPLICATED),
+    ),
+    coverage=_TRANSFORMER_COVERAGE,
 )
 
 
-def tp_rules():
-    from ..ops.moe import moe_rules
+def transformer_rules(cfg: TransformerConfig) -> sharding.PartitionRules:
+    """The exact rules table for ``cfg``'s param tree: variant rows
+    (split vs fused QKV, MoE experts, dense MLP) selected so that
+    match_partition_rules' dead-rule check holds — a config/table
+    mismatch fails loudly with the full attribution listing."""
+    tags = ["fused_qkv" if cfg.fused_qkv else "split_qkv"]
+    n_moe = sum(
+        1 for i in range(cfg.num_layers)
+        if cfg.num_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
+    )
+    if n_moe:
+        tags.append("moe")
+    if n_moe < cfg.num_layers:
+        tags.append("dense_mlp")
+    return TRANSFORMER_RULES.select(*tags)
 
-    # MoE rules first: "moe/w_in" must not fall through to the dense
-    # "mlp_in" patterns (first-match-wins in specs_from_path_rules)
-    return tuple(moe_rules()) + TP_PATH_RULES
+
+def tp_rules():
+    """Legacy soft form of :data:`TRANSFORMER_RULES` (every variant row,
+    replicate-on-miss semantics) — kept for ad-hoc trees and the
+    pre-engine call sites; shipped workloads use
+    :func:`transformer_rules`."""
+    return TRANSFORMER_RULES.as_path_rules()
 
 
 # ---------------------------------------------------------------------------
@@ -711,34 +781,21 @@ def pipeline_param_specs(pparams: Any, *, tp: bool = False) -> Any:
     out of scope for the PP path).
 
     ``tp=True`` additionally places the `model` axis on each stacked block
-    leaf — the megatron layout of TP_PATH_RULES shifted past the leading
-    [n_stages(, n_virtual), layers_per_stage] stacking dims: column-
-    parallel kernels/biases (query/key/value/mlp_in) shard their LAST dim,
-    row-parallel kernels (attn_out/mlp_out) their second-to-last, and
-    row-parallel biases + LayerNorms stay replicated. Must match
-    ``Block(tp_shards=...)``'s local-slice expectations exactly."""
-    from ..parallel.pipeline import stage_param_specs
-
-    if not tp:
-        blocks = stage_param_specs(pparams["blocks"])
-    else:
-        col = re.compile(r"(query|key|value|mlp_in)/(kernel|bias)$")
-        row = re.compile(r"(attn_out|mlp_out)/kernel$")
-
-        def assign(path, leaf):
-            name = "/".join(
-                k.key for k in path if hasattr(k, "key")
-            )
-            spec = [mesh_lib.PIPE] + [None] * (jnp.ndim(leaf) - 1)
-            if col.search(name):
-                spec[-1] = mesh_lib.MODEL
-            elif row.search(name):
-                spec[-2] = mesh_lib.MODEL
-            return P(*spec)
-
-        blocks = jax.tree_util.tree_map_with_path(assign, pparams["blocks"])
+    leaf — the megatron layout of TRANSFORMER_RULES shifted past the
+    leading [n_stages(, n_virtual), layers_per_stage] stacking dims:
+    column-parallel kernels/biases (query/key/value/mlp_in) shard their
+    LAST dim, row-parallel kernels (attn_out/mlp_out) their
+    second-to-last, and row-parallel biases + LayerNorms stay
+    replicated. Must match ``Block(tp_shards=...)``'s local-slice
+    expectations exactly. Spec construction itself lives at the seam
+    (sharding.stacked_stage_specs)."""
+    blocks = sharding.stacked_stage_specs(
+        pparams["blocks"],
+        col=r"(query|key|value|mlp_in)/(kernel|bias)$" if tp else None,
+        row=r"(attn_out|mlp_out)/kernel$" if tp else None,
+    )
     return {
-        "ends": jax.tree.map(lambda _: P(), pparams["ends"]),
+        "ends": sharding.replicated_specs(pparams["ends"]),
         "blocks": blocks,
     }
 

@@ -45,6 +45,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
+from ..parallel import sharding
 from ..utils import metrics as metrics_lib
 
 
@@ -62,14 +63,43 @@ class WideDeepConfig:
     embed_impl: str = "take"
 
 
+#: Coverage fixture: the default WideDeepConfig's param tree (5 vocab
+#: features, 3 hidden layers), fully literal so the dtflint
+#: shard-rules-coverage rule reads it statically — pinned to the live
+#: model by tests/test_sharding.py::test_wide_deep_coverage_fixture_is_live.
+_WIDE_DEEP_COVERAGE = (
+    "deep_0/bias", "deep_0/kernel", "deep_1/bias", "deep_1/kernel",
+    "deep_2/bias", "deep_2/kernel", "deep_out/bias", "deep_out/kernel",
+    "table_0", "table_1", "table_2", "table_3", "table_4",
+    "wide_dense/bias", "wide_dense/kernel",
+    "wide_table_0", "wide_table_1", "wide_table_2", "wide_table_3",
+    "wide_table_4",
+)
+
+#: Partition-rules table: vocab-shard every table (deep embeddings AND
+#: wide linear columns) over `model`; the MLP is declared replicated
+#: (recommender MLPs are small — DP/fsdp handles them). Patterns are
+#: segment-anchored: the engine's dead-rule check exposed that the old
+#: un-anchored ``table_\d+`` row also swallowed every ``wide_table_``
+#: param, leaving the wide row permanently dead (same spec, so no
+#: behavior change — but a rotted rule all the same).
+WIDE_DEEP_RULES = sharding.partition_rules(
+    "wide-deep",
+    (
+        (r"(^|/)table_\d+$", P(mesh_lib.MODEL, None)),
+        (r"(^|/)wide_table_\d+$", P(mesh_lib.MODEL, None)),
+        (sharding.CATCH_ALL, sharding.REPLICATED),
+    ),
+    coverage=_WIDE_DEEP_COVERAGE,
+)
+
+
 def embedding_rules() -> list[tuple[str, P]]:
-    """Path rules: vocab-shard every table (deep embeddings AND wide
-    linear columns) over `model`; MLP replicated (recommender MLPs are
-    small — DP/fsdp handles them)."""
-    return [
-        (r"table_\d+", P(mesh_lib.MODEL, None)),
-        (r"wide_table_\d+", P(mesh_lib.MODEL, None)),
-    ]
+    """Legacy soft form of :data:`WIDE_DEEP_RULES` (the two table rows,
+    replicate-on-miss) — pre-engine call sites; the shipped workload
+    passes the table itself."""
+    return [(r.pattern, r.spec) for r in WIDE_DEEP_RULES.rows
+            if r.pattern != sharding.CATCH_ALL]
 
 
 class WideDeep(nn.Module):

@@ -35,10 +35,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from ..utils.compat import axis_size, shard_map
 
 from ..parallel import mesh as mesh_lib
+from ..parallel import sharding as sharding_lib
 
 
 def shard_vocab(vocab_size: int, n_shards: int) -> int:
@@ -165,8 +166,9 @@ def make_range_sharded_lookup(mesh: Mesh, axis: str = mesh_lib.MODEL):
 def to_mod_sharded(table: jax.Array, mesh: Mesh, axis: str = mesh_lib.MODEL):
     """Re-layout a replicated [V, D] table into the mod-sharded global array
     expected by ``make_sharded_lookup`` (dim 0 = n shards × rows-per-shard),
-    placed with dim 0 over ``axis``."""
+    placed with dim 0 over ``axis`` (through the sharding seam — no
+    ad-hoc NamedSharding here)."""
     n = mesh.shape[axis]
     shards = [local_rows(table, s, n) for s in range(n)]
     global_ = jnp.concatenate(shards, axis=0)
-    return jax.device_put(global_, NamedSharding(mesh, P(axis, None)))
+    return sharding_lib.shard_leading_dim(global_, mesh, axis)

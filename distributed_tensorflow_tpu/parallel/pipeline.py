@@ -64,14 +64,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import mesh as mesh_lib
+from . import sharding
 from ..utils.compat import shard_map
 
 
 def stage_param_specs(stage_params: Any) -> Any:
-    """P('pipe', None, ...) for every leaf (leading dim = stage)."""
-    return jax.tree.map(
-        lambda x: P(mesh_lib.PIPE, *([None] * (jnp.ndim(x) - 1))), stage_params
-    )
+    """P('pipe', None, ...) for every leaf (leading dim = stage) —
+    constructed at the sharding seam (sharding.stacked_stage_specs)."""
+    return sharding.stacked_stage_specs(stage_params)
 
 
 def stack_stages(per_stage: list) -> Any:

@@ -10,16 +10,31 @@ out over the mesh*, and XLA materializes the movement. Three pieces:
    with a logical name ("embed", "mlp", "heads", "vocab", …); a rule table
    maps logical names to mesh axes. Swapping parallelism strategy = swapping
    the table, not the model (the flax `logical axis` idiom, generalized).
-2. **Path rules** — regex over the parameter path → PartitionSpec, for
-   models that don't carry logical annotations.
-3. **Tree utilities** — build NamedShardings for whole pytrees, shard/assert
-   helpers, batch sharding over the (data, fsdp) axes.
+2. **Partition-rules tables** — the declarative engine
+   (:func:`partition_rules` / :func:`match_partition_rules`): an ordered,
+   named table of ``(path-regex, PartitionSpec)`` rows resolved over the
+   param pytree with first-match precedence. Unlike the legacy soft form
+   below, a table is a *contract*: an unmatched param or a dead rule is a
+   hard error carrying the full per-param attribution listing, and each
+   shipped table carries a static ``coverage`` fixture of param paths that
+   the dtflint ``shard-rules-coverage`` rule re-checks on every CI run.
+   Onboarding a model or a parallelism strategy = writing a table
+   (docs/parallelism.md "Authoring partition-rules tables").
+3. **Legacy path rules** — :func:`specs_from_path_rules`, the pre-engine
+   soft form (unmatched params silently replicate). Kept for ad-hoc
+   trees; shipped models route through tables.
+4. **Tree utilities** — build NamedShardings for whole pytrees, shard/assert
+   helpers, batch sharding over the (data, fsdp) axes. This module is the
+   single sharding-assignment seam: constructing ``NamedSharding`` /
+   ``PartitionSpec`` for persistent state anywhere else is a dtflint
+   error (``sharding-seam-bypass``).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import re
-from typing import Any, Mapping, Sequence
+from typing import Any, Iterable, Mapping, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -79,6 +94,10 @@ def _path_str(path) -> str:
             parts.append(str(k.key))
         elif hasattr(k, "idx"):
             parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            # GetAttrKey — registered-dataclass pytrees (serve.KVCache):
+            # field name without the "." prefix, so rules match "k"/"v"
+            parts.append(str(k.name))
         else:
             parts.append(str(k))
     return "/".join(parts)
@@ -99,6 +118,321 @@ def specs_from_path_rules(tree: Any, rules: PathRules) -> Any:
         return P()  # replicated
 
     return jax.tree_util.tree_map_with_path(assign, tree)
+
+
+# ---------------------------------------------------------------------------
+# Partition-rules engine: named tables, hard coverage errors, attribution
+# ---------------------------------------------------------------------------
+
+#: The replicated spec. Seam consumers reference this instead of
+#: constructing ``P()`` (the sharding-seam-bypass lint contract).
+REPLICATED = P()
+
+#: Conventional final row of a total table: everything the named rows did
+#: not claim is replicated — DECLARED, not silently defaulted.
+CATCH_ALL = r".*"
+
+
+class PartitionCoverageError(ValueError):
+    """A rules table failed its totality/liveness contract: some param
+    matched no rule, or some rule matched no param. Carries the full
+    attribution listing so the failure is debuggable at a glance."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionRow:
+    """One table row. ``tag`` marks a variant-conditional row (e.g. the
+    fused-QKV layout): :meth:`PartitionRules.select` keeps untagged rows
+    plus the rows whose tag was selected, so the table handed to
+    :func:`match_partition_rules` is exact for the tree it serves."""
+
+    pattern: str
+    spec: P
+    tag: str | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleMatch:
+    """Attribution of one param path: which row won it (first match).
+    ``rule_index`` is -1 (``pattern``/``spec`` None) for an unmatched
+    path — the hard-error case of :func:`match_partition_rules`."""
+
+    path: str
+    rule_index: int
+    pattern: str | None
+    spec: P | None
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionRules:
+    """A named, ordered partition-rules table (see module docstring §2).
+
+    ``coverage`` is the table's static param-path fixture: the full path
+    listing of the tree(s) the table serves (union over variants),
+    frozen at authoring time. Construction re-runs the totality/liveness
+    check against it, and the dtflint ``shard-rules-coverage`` rule
+    re-checks the same contract statically on every lint run; a test
+    pins each shipped coverage list to the live model's param tree."""
+
+    name: str
+    rows: tuple[PartitionRow, ...]
+    coverage: tuple[str, ...] = ()
+
+    def select(self, *tags: str) -> "PartitionRules":
+        """Variant view: untagged rows plus rows tagged with any of
+        ``tags``, original order preserved. The derived table drops the
+        union coverage (it describes all variants at once); the strict
+        per-tree check happens in :func:`match_partition_rules`."""
+        keep = tuple(r for r in self.rows if r.tag is None or r.tag in tags)
+        suffix = "+".join(sorted(tags))
+        return PartitionRules(
+            name=f"{self.name}[{suffix}]" if suffix else self.name,
+            rows=keep,
+        )
+
+    def as_path_rules(self) -> PathRules:
+        """The table's rows in the legacy ``specs_from_path_rules`` form
+        (soft fallback semantics) — the back-compat bridge for callers
+        that predate the engine."""
+        return tuple((r.pattern, r.spec) for r in self.rows)
+
+
+def partition_rules(
+    name: str,
+    rules: Sequence[tuple],
+    *,
+    coverage: Sequence[str] = (),
+) -> PartitionRules:
+    """Build (and validate) a :class:`PartitionRules` table.
+
+    ``rules`` rows are ``(pattern, spec)`` or ``(pattern, spec, tag)``
+    tuples, matched against ``_path_str`` param paths with
+    ``re.search``, first match wins. Every pattern must compile; when
+    ``coverage`` is given, the totality/liveness contract is enforced
+    right here — a table that cannot cover its own fixture fails at
+    import time, not at first training run."""
+    built: list[PartitionRow] = []
+    for i, row in enumerate(rules):
+        if len(row) not in (2, 3):
+            raise ValueError(
+                f"partition_rules({name!r}): row {i} must be "
+                f"(pattern, spec[, tag]), got {row!r}"
+            )
+        pattern, spec = row[0], row[1]
+        tag = row[2] if len(row) == 3 else None
+        try:
+            re.compile(pattern)
+        except re.error as e:
+            raise ValueError(
+                f"partition_rules({name!r}): row {i} pattern "
+                f"{pattern!r} does not compile: {e}"
+            ) from e
+        if not isinstance(spec, P):
+            raise ValueError(
+                f"partition_rules({name!r}): row {i} spec must be a "
+                f"PartitionSpec, got {type(spec).__name__}"
+            )
+        built.append(PartitionRow(pattern, spec, tag))
+    table = PartitionRules(name, tuple(built), tuple(coverage))
+    if table.coverage:
+        _check_coverage(table, table.coverage)
+    return table
+
+
+def _attribute_paths(
+    rows: Sequence[PartitionRow], paths: Iterable[str]
+) -> list[RuleMatch]:
+    compiled = [re.compile(r.pattern) for r in rows]
+    out: list[RuleMatch] = []
+    for path in paths:
+        for i, rx in enumerate(compiled):
+            if rx.search(path):
+                out.append(RuleMatch(path, i, rows[i].pattern, rows[i].spec))
+                break
+        else:
+            out.append(RuleMatch(path, -1, None, None))
+    return out
+
+
+def format_attribution(
+    table: PartitionRules, matches: Sequence[RuleMatch]
+) -> str:
+    """The full per-param listing (one line per path: winning rule
+    index, pattern, spec — or UNMATCHED), plus a dead-rule trailer.
+    Shared by the hard-error message and ``show_sharding --rules``."""
+    won = {m.rule_index for m in matches if m.rule_index >= 0}
+    lines = [f"table {table.name!r}: {len(table.rows)} rule(s), "
+             f"{len(matches)} param(s)"]
+    for m in matches:
+        if m.rule_index < 0:
+            lines.append(f"  {m.path}  <-  UNMATCHED")
+        else:
+            lines.append(
+                f"  {m.path}  <-  rule[{m.rule_index}] "
+                f"{m.pattern!r} -> {m.spec}"
+            )
+    for i, row in enumerate(table.rows):
+        if i not in won:
+            lines.append(
+                f"  rule[{i}] {row.pattern!r} -> {row.spec}  DEAD "
+                f"(matched no param)"
+            )
+    return "\n".join(lines)
+
+
+def _coverage_violations(
+    table: PartitionRules, paths: Sequence[str]
+) -> tuple[list[RuleMatch], list[str], list[int]]:
+    """(matches, unmatched paths, dead rule indices) — the ONE place
+    the totality/liveness contract is computed, shared by the
+    construction-time check and match_partition_rules so the two can
+    never drift."""
+    matches = _attribute_paths(table.rows, paths)
+    unmatched = [m.path for m in matches if m.rule_index < 0]
+    won = {m.rule_index for m in matches if m.rule_index >= 0}
+    dead = [i for i in range(len(table.rows)) if i not in won]
+    return matches, unmatched, dead
+
+
+def _check_coverage(table: PartitionRules, paths: Sequence[str]) -> None:
+    matches, unmatched, dead = _coverage_violations(table, paths)
+    if unmatched or dead:
+        raise PartitionCoverageError(
+            f"partition rules table {table.name!r} violates its "
+            f"coverage contract: {len(unmatched)} unmatched param(s), "
+            f"{len(dead)} dead rule(s).\n"
+            + format_attribution(table, matches)
+        )
+
+
+def attribute_partition_rules(
+    rules: "PartitionRules | PathRules", tree: Any
+) -> list[RuleMatch]:
+    """First-match attribution of every leaf path in ``tree`` — the
+    debuggable view behind ``tools/show_sharding.py --rules``. Accepts
+    a table or legacy path rules; never raises on coverage gaps."""
+    rows = (rules.rows if isinstance(rules, PartitionRules)
+            else tuple(PartitionRow(p, s) for p, s in rules))
+    paths = [
+        _path_str(p)
+        for p, _ in jax.tree_util.tree_leaves_with_path(tree)
+    ]
+    return _attribute_paths(rows, paths)
+
+
+def match_partition_rules(table: PartitionRules, tree: Any) -> Any:
+    """Resolve ``table`` over ``tree`` with the hard contract: every
+    leaf must match a rule and every rule must match a leaf, else
+    :class:`PartitionCoverageError` with the full attribution listing.
+    This — not :func:`specs_from_path_rules` — is how shipped models
+    get their specs (SNIPPETS.md [2] ``match_partition_rules``, with
+    the dead-rule half added)."""
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    matches, unmatched, dead = _coverage_violations(
+        table, [_path_str(p) for p, _ in leaves])
+    if unmatched or dead:
+        raise PartitionCoverageError(
+            f"partition rules table {table.name!r} does not cover this "
+            f"param tree: {len(unmatched)} unmatched param(s), "
+            f"{len(dead)} dead rule(s). Add/repair rows (a final "
+            f"(sharding.CATCH_ALL, sharding.REPLICATED) row declares "
+            f"the replicated remainder) or fix the variant selection.\n"
+            + format_attribution(table, matches)
+        )
+    return jax.tree_util.tree_unflatten(
+        treedef, [m.spec for m in matches]
+    )
+
+
+def specs_from_rules(tree: Any, rules: "PartitionRules | PathRules") -> Any:
+    """Dispatch seam used by ``train/step.init_train_state`` and the
+    tools: a :class:`PartitionRules` table resolves strictly
+    (:func:`match_partition_rules`); a legacy rule sequence keeps the
+    soft replicate-on-miss semantics."""
+    if isinstance(rules, PartitionRules):
+        return match_partition_rules(rules, tree)
+    return specs_from_path_rules(tree, rules)
+
+
+def replicated_specs(tree: Any) -> Any:
+    """A spec tree replicating every leaf of ``tree``."""
+    return jax.tree.map(lambda _: REPLICATED, tree)
+
+
+def merge_specs(explicit: Any, auto: Any) -> Any:
+    """Per-leaf merge of two spec trees: the explicit spec wins unless
+    it is replicated, where ``auto`` (e.g. :func:`auto_fsdp_specs`)
+    fills in. The one merge used by ``init_train_state`` and
+    ``show_sharding`` — factored here so the precedence cannot drift."""
+    return jax.tree.map(
+        lambda e, a: a if e == REPLICATED else e,
+        explicit, auto, is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def opt_state_specs(opt_state: Any, params: Any, param_specs: Any) -> Any:
+    """PartitionSpec tree for an optax state: sub-trees shaped like the
+    param tree inherit the param specs (momentum/second-moment slots —
+    the reference's PS-resident 'slot variables'), scalars replicated.
+
+    This is the weight-update-sharding hook (arXiv:2004.13336): pass
+    fsdp-sharded param_specs and the optimizer state shards with them."""
+    import optax  # deferred: parallel/ stays importable without the train deps
+
+    param_treedef = jax.tree.structure(params)
+    masked_leaf = lambda x: isinstance(x, optax.MaskedNode)
+
+    def rec(node):
+        try:
+            if jax.tree.structure(node) == param_treedef:
+                return param_specs
+        except (ValueError, TypeError):
+            pass
+        # optax.masked (the building block of multi_transform) replaces
+        # out-of-group params with empty MaskedNode containers; such a
+        # sub-tree still inherits the in-group param specs — mirror the
+        # MaskedNodes into the spec tree so treedefs stay identical
+        try:
+            if jax.tree.structure(node, is_leaf=masked_leaf) == param_treedef:
+                return jax.tree.map(
+                    lambda n, s: n if masked_leaf(n) else s,
+                    node, param_specs, is_leaf=masked_leaf,
+                )
+        except (ValueError, TypeError):
+            pass
+        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*(rec(c) for c in node))
+        if isinstance(node, (tuple, list)):
+            return type(node)(rec(c) for c in node)
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return REPLICATED  # scalar leaf (counts, schedules)
+
+    return rec(opt_state)
+
+
+def stacked_stage_specs(stage_params: Any, *, col: str | None = None,
+                        row: str | None = None) -> Any:
+    """Specs for a pipeline-stacked param tree: every leaf leads with
+    the ``pipe`` axis (leading [n_stages(, n_virtual), layers] stacking
+    dims). ``col``/``row`` optionally add megatron tensor parallelism by
+    path regex — column-parallel leaves shard their LAST dim over
+    ``model``, row-parallel their second-to-last. The seam home of what
+    ``parallel/pipeline.py`` and ``models/transformer.py`` previously
+    each hand-built."""
+    col_rx = re.compile(col) if col else None
+    row_rx = re.compile(row) if row else None
+
+    def assign(path, leaf):
+        name = _path_str(path)
+        spec = [mesh_lib.PIPE] + [None] * (jnp.ndim(leaf) - 1)
+        if col_rx is not None and col_rx.search(name):
+            spec[-1] = mesh_lib.MODEL
+        elif row_rx is not None and row_rx.search(name):
+            spec[-2] = mesh_lib.MODEL
+        return P(*spec)
+
+    return jax.tree_util.tree_map_with_path(assign, stage_params)
 
 
 # ---------------------------------------------------------------------------
@@ -154,6 +488,15 @@ def replicate(tree: Any, mesh: Mesh) -> Any:
     return jax.device_put(
         tree, jax.tree.map(lambda _: NamedSharding(mesh, P()), tree)
     )
+
+
+def shard_leading_dim(x: Any, mesh: Mesh, axis: str) -> Any:
+    """Place ``x`` with dim 0 sharded over the named ``axis``, every
+    other dim replicated — the seam form of the one-off
+    ``device_put(x, NamedSharding(mesh, P(axis, None, ...)))`` pattern
+    (ops/embedding.to_mod_sharded)."""
+    spec = P(axis, *([None] * (jnp.ndim(x) - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
 
 
 def auto_fsdp_specs(params: Any, mesh: Mesh, *, min_size: int = 2**14) -> Any:

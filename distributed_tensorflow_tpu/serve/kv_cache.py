@@ -41,8 +41,10 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ..models.transformer import TransformerConfig
+from ..parallel import mesh as mesh_lib
 from ..parallel import sharding
 
 
@@ -73,6 +75,20 @@ jax.tree_util.register_dataclass(
 #: tables that place the model weights (sharding.spec_from_logical).
 CACHE_LOGICAL = ("layers", "batch", "heads", "len", "kv")
 
+#: Partition-rules table for the dense cache (the default layout of
+#: ``cache_specs``): heads → ``model`` exactly as the attention weights
+#: under TRANSFORMER_RULES (a TP shard holds the K/V of its own heads),
+#: slots → the batch axes like any input batch. Equal by construction
+#: to ``spec_from_logical(CACHE_LOGICAL, TP_RULES)`` — pinned by
+#: tests/test_serve.py::test_cache_specs_match_rules_table.
+KV_CACHE_RULES = sharding.partition_rules(
+    "serve-kv-cache",
+    ((r"^(k|v)$",
+      P(None, (mesh_lib.DATA, mesh_lib.FSDP), mesh_lib.MODEL,
+        None, None)),),
+    coverage=("k", "v"),
+)
+
 
 def init_cache(
     cfg: TransformerConfig,
@@ -96,10 +112,17 @@ def init_cache(
 
 
 def cache_specs(rules: sharding.LogicalRules | None = None) -> KVCache:
-    """PartitionSpec pytree for the cache under ``rules`` (default
-    TP_RULES: heads → ``model``, slots → ``(data, fsdp)``). Feed to
-    ``sharding.shard_tree`` / ``jax.jit`` in/out shardings."""
-    rules = sharding.TP_RULES if rules is None else rules
+    """PartitionSpec pytree for the cache. The default is the
+    KV_CACHE_RULES partition-rules table (heads → ``model``, slots →
+    ``(data, fsdp)``) resolved under the engine's strict coverage
+    contract; passing explicit logical ``rules`` keeps the
+    spec_from_logical escape hatch (tests re-derive the layout from
+    custom tables). Feed to ``sharding.shard_tree`` / ``jax.jit``
+    in/out shardings."""
+    if rules is None:
+        return sharding.match_partition_rules(
+            KV_CACHE_RULES, KVCache(k=0, v=0)
+        )
     spec = sharding.spec_from_logical(CACHE_LOGICAL, rules)
     return KVCache(k=spec, v=spec)
 
@@ -162,6 +185,17 @@ jax.tree_util.register_dataclass(
 #: still shard over ``model`` exactly like the dense cache.
 PAGED_CACHE_LOGICAL = ("layers", "kv_blocks", "heads", "len", "kv")
 
+#: Partition-rules table for the block pool (default of
+#: ``paged_cache_specs``): heads → ``model``, blocks REPLICATED — a
+#: request's blocks must not scatter over the batch axes. Pinned to the
+#: logical-rules derivation by
+#: tests/test_serve.py::test_paged_cache_specs_match_rules_table.
+PAGED_KV_CACHE_RULES = sharding.partition_rules(
+    "serve-paged-kv-cache",
+    ((r"^(k|v)$", P(None, None, mesh_lib.MODEL, None, None)),),
+    coverage=("k", "v"),
+)
+
 
 def init_paged_cache(
     cfg: TransformerConfig,
@@ -187,8 +221,12 @@ def paged_cache_specs(
     rules: sharding.LogicalRules | None = None,
 ) -> PagedKVCache:
     """PartitionSpec pytree for the block pool (heads → ``model``,
-    blocks replicated)."""
-    rules = sharding.TP_RULES if rules is None else rules
+    blocks replicated) — PAGED_KV_CACHE_RULES by default, explicit
+    logical ``rules`` as the escape hatch."""
+    if rules is None:
+        return sharding.match_partition_rules(
+            PAGED_KV_CACHE_RULES, PagedKVCache(k=0, v=0)
+        )
     spec = sharding.spec_from_logical(PAGED_CACHE_LOGICAL, rules)
     return PagedKVCache(k=spec, v=spec)
 

@@ -29,10 +29,11 @@ from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..obs import flightrec as flightrec_lib
 from ..parallel import cluster
+from ..parallel import sharding as sharding_lib
 # submodule import: resilience/retry.py has no train/ dependency, so this
 # cannot cycle even though resilience/__init__ imports train.callbacks
 from ..resilience.retry import RetryExhausted, RetryPolicy, retry_call
@@ -532,13 +533,14 @@ class Checkpointer:
 
     def _restore_step(self, step: int, abstract_state: Any) -> Any:
         if self.spec_tree is not None:
+            shardings = sharding_lib.tree_shardings(self.mesh, self.spec_tree)
             target = jax.tree.map(
-                lambda s, spec: jax.ShapeDtypeStruct(
-                    s.shape, s.dtype, sharding=NamedSharding(self.mesh, spec)
+                lambda s, shd: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=shd
                 ),
                 abstract_state,
-                self.spec_tree,
-                is_leaf=lambda x: isinstance(x, (jax.ShapeDtypeStruct, P)),
+                shardings,
+                is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
             )
         else:
             target = abstract_state

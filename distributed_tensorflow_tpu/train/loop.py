@@ -18,7 +18,7 @@ import logging
 from typing import Any, Callable, Iterable, Sequence
 
 import jax
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh
 
 from ..obs import flightrec as flightrec_lib
 from ..parallel import sharding as sh

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import struct
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..parallel import sharding as sh
 
@@ -54,53 +54,20 @@ class TrainState:
     rng: jax.Array  # base key; per-step keys are fold_in(rng, step)
 
 
-def opt_state_specs(opt_state: Any, params: Any, param_specs: Any) -> Any:
-    """PartitionSpec tree for an optax state: sub-trees shaped like the param
-    tree inherit the param specs (momentum/second-moment slots — the
-    reference's PS-resident 'slot variables'), scalars are replicated.
-
-    This is the weight-update-sharding hook (arXiv:2004.13336): pass fsdp-
-    sharded param_specs and the optimizer state shards with them."""
-    param_treedef = jax.tree.structure(params)
-    masked_leaf = lambda x: isinstance(x, optax.MaskedNode)
-
-    def rec(node):
-        try:
-            if jax.tree.structure(node) == param_treedef:
-                return param_specs
-        except (ValueError, TypeError):
-            pass
-        # optax.masked (the building block of multi_transform) replaces
-        # out-of-group params with empty MaskedNode containers; such a
-        # sub-tree still inherits the in-group param specs — mirror the
-        # MaskedNodes into the spec tree so treedefs stay identical
-        try:
-            if jax.tree.structure(node, is_leaf=masked_leaf) == param_treedef:
-                return jax.tree.map(
-                    lambda n, s: n if masked_leaf(n) else s,
-                    node, param_specs, is_leaf=masked_leaf,
-                )
-        except (ValueError, TypeError):
-            pass
-        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
-            return type(node)(*(rec(c) for c in node))
-        if isinstance(node, (tuple, list)):
-            return type(node)(rec(c) for c in node)
-        if isinstance(node, dict):
-            return {k: rec(v) for k, v in node.items()}
-        return P()  # scalar leaf (counts, schedules) — replicated
-
-    return rec(opt_state)
+#: re-export — the optax spec-inheritance logic lives at the sharding
+#: seam now (parallel/sharding.py), next to every other spec producer
+opt_state_specs = sh.opt_state_specs
 
 
 def state_specs(state_shape: TrainState, param_specs: Any) -> TrainState:
     """PartitionSpec tree covering the whole TrainState."""
     return TrainState(
-        step=P(),
+        step=sh.REPLICATED,
         params=param_specs,
-        opt_state=opt_state_specs(state_shape.opt_state, state_shape.params, param_specs),
-        model_state=jax.tree.map(lambda _: P(), state_shape.model_state),
-        rng=P(),
+        opt_state=sh.opt_state_specs(
+            state_shape.opt_state, state_shape.params, param_specs),
+        model_state=sh.replicated_specs(state_shape.model_state),
+        rng=sh.REPLICATED,
     )
 
 
@@ -122,7 +89,9 @@ def init_train_state(
     ``Scaffold``/init_op dance ($TF monitored_session.py:52): there is no
     chief — every process runs the same jit-ed init and XLA places shards.
 
-    ``param_rules``: regex path rules (sharding.specs_from_path_rules);
+    ``param_rules``: a sharding.PartitionRules table (strict
+    match_partition_rules contract) or legacy regex path rules
+    (sharding.specs_from_path_rules);
     ``param_specs``: explicit spec tree (wins over rules);
     ``fsdp``: additionally shard unmatched params via auto_fsdp_specs.
     """
@@ -141,22 +110,14 @@ def init_train_state(
     abstract = jax.eval_shape(full_init, rng)
     if param_specs is None:
         if param_rules is not None:
-            param_specs = sh.specs_from_path_rules(abstract.params, param_rules)
+            param_specs = sh.specs_from_rules(abstract.params, param_rules)
         else:
-            param_specs = jax.tree.map(lambda _: P(), abstract.params)
+            param_specs = sh.replicated_specs(abstract.params)
     if fsdp:
         auto = sh.auto_fsdp_specs(abstract.params, mesh, min_size=fsdp_min_size)
-        param_specs = jax.tree.map(
-            lambda explicit, a: a if explicit == P() else explicit,
-            param_specs,
-            auto,
-            is_leaf=lambda x: isinstance(x, P),
-        )
+        param_specs = sh.merge_specs(param_specs, auto)
     specs = state_specs(abstract, param_specs)
-    shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    shardings = sh.tree_shardings(mesh, specs)
     state = jax.jit(full_init, out_shardings=shardings)(rng)
     return state, specs
 
@@ -328,10 +289,7 @@ def jit_train_step(step_fn, mesh: Mesh, spec_tree: TrainState):
 
     Donation makes the update in-place in HBM — without it, peak memory
     doubles (params + new params live simultaneously)."""
-    state_shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), spec_tree,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    state_shardings = sh.tree_shardings(mesh, spec_tree)
     return jax.jit(
         step_fn,
         in_shardings=(state_shardings, None),

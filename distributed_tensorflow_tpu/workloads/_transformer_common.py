@@ -98,7 +98,7 @@ def transformer_parts(cfg: RunConfig, mesh, *, mlm: bool) -> WorkloadParts:
                  else tfm.causal_lm_loss(model, mcfg.xent_chunk)),
         eval_fn=(tfm.mlm_eval_fn(model) if mlm
                  else tfm.lm_eval_fn(model, mcfg.xent_chunk)),
-        param_rules=tfm.tp_rules(),
+        param_rules=tfm.transformer_rules(mcfg),
         fsdp=True,
         **common,
     )

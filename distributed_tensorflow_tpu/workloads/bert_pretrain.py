@@ -4,7 +4,7 @@
 Reference analog: the harness's BERT script — PS/worker sync replicas at
 512 tokens (SURVEY.md §2a). TPU-native: one jit SPMD step over a
 data×fsdp×model mesh; tensor parallelism via the megatron path rules
-(models/transformer.TP_PATH_RULES), optional sequence parallelism
+(models/transformer.TRANSFORMER_RULES), optional sequence parallelism
 (cfg.model.seq_impl + mesh seq axis) for long-context variants
 (SURVEY.md §5.7: 512-token baseline doesn't need SP; the plumbing is
 first-class here and gated by config)."""

@@ -10,7 +10,9 @@ from __future__ import annotations
 
 from ..data import DataConfig, make_dataset
 from ..models import common
-from ..models.resnet import ResNet50, ResNetConfig, flops_per_example
+from ..models.resnet import (
+    RESNET_RULES, ResNet50, ResNetConfig, flops_per_example,
+)
 from ..parallel import MeshSpec
 from ..train import OptimizerConfig
 from .runner import RunConfig, TrainSection, WorkloadParts
@@ -54,5 +56,8 @@ def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
             cfg.data, n, index_offset=10**6, train=False),
         flops_per_step=flops_per_example(cfg.model, cfg.data.image_size)
         * cfg.data.global_batch_size,
+        # pure DP: the one-row catch-all table — same replicated layout
+        # as before, but now DECLARED through the rules engine
+        param_rules=RESNET_RULES,
         batch_size=cfg.data.global_batch_size,
     )

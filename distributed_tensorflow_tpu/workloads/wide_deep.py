@@ -113,7 +113,7 @@ def build(cfg: RunConfig, mesh=None) -> WorkloadParts:
         eval_dataset_fn=eval_fn_,
         flops_per_step=wd.flops_per_example(cfg.model)
         * cfg.data.global_batch_size,
-        param_rules=wd.embedding_rules(),
+        param_rules=wd.WIDE_DEEP_RULES,
         batch_size=cfg.data.global_batch_size,
         # "train_" when eval draws from the training ctr file — a
         # train-set metric must not masquerade as generalization

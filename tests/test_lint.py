@@ -854,3 +854,209 @@ def test_shipped_tree_is_clean():
         os.path.join(REPO, "bench.py"),
     ])
     assert found == [], "\n".join(f.format() for f in found)
+
+
+# ---- v3 partitioning family (PR 14) ------------------------------------
+
+
+def test_shard_rules_table_name_must_be_unique_across_run():
+    table = '''
+        from jax.sharding import PartitionSpec as P
+        from distributed_tensorflow_tpu.parallel.sharding import \\
+            partition_rules
+
+        T = partition_rules(
+            "dup-model", ((r".*", P()),), coverage=("a/kernel",))
+    '''
+    found = lint_sources({
+        "models/a.py": textwrap.dedent(table),
+        "models/b.py": textwrap.dedent(table),
+    }, rules=["shard-rules-coverage"])
+    assert [f.rule for f in found] == ["shard-rules-coverage"]
+    assert "already defined at models/a.py" in found[0].message
+    assert found[0].path == "models/b.py"
+
+
+def test_shard_rules_missing_coverage_fixture_flagged():
+    found = lint_snippet('''
+        from jax.sharding import PartitionSpec as P
+        from distributed_tensorflow_tpu.parallel.sharding import \\
+            partition_rules
+
+        T = partition_rules("no-cov", ((r".*", P()),))
+    ''', rules=["shard-rules-coverage"])
+    assert len(found) == 1
+    assert "ships no coverage fixture" in found[0].message
+
+
+def test_shard_rules_catch_all_constant_resolved_not_opaque():
+    """A symbolic sharding.CATCH_ALL final row must not disable the
+    simulation — the dead rule hiding above it is still found."""
+    found = lint_snippet('''
+        from jax.sharding import PartitionSpec as P
+        from distributed_tensorflow_tpu.parallel import sharding
+
+        T = sharding.partition_rules(
+            "cdm",
+            (
+                (r"kernel$", P(None, "model")),
+                (r"kernle$", P("model")),
+                (sharding.CATCH_ALL, sharding.REPLICATED),
+            ),
+            coverage=("layer/kernel", "layer/bias"),
+        )
+    ''', rules=["shard-rules-coverage"])
+    assert len(found) == 1
+    assert "'kernle$'" in found[0].message
+    assert "dead rule" in found[0].message
+
+
+def test_shard_rules_shadowed_row_is_dead_the_wide_deep_regression():
+    """The pre-engine wide&deep bug, now a lint error: an unanchored
+    earlier row swallows every path the later row was written for."""
+    found = lint_snippet('''
+        from jax.sharding import PartitionSpec as P
+        from distributed_tensorflow_tpu.parallel.sharding import \\
+            partition_rules
+
+        T = partition_rules(
+            "wd-regression",
+            (
+                (r"table_\\d+", P("model", None)),
+                (r"wide_table_\\d+", P("model", None)),
+                (r".*", P()),
+            ),
+            coverage=("table_0", "wide_table_0", "deep_0/kernel"),
+        )
+    ''', rules=["shard-rules-coverage"])
+    assert len(found) == 1
+    assert "wide_table_" in found[0].message
+    assert "shadowed" in found[0].message
+
+
+def test_shard_rules_coverage_resolves_module_constant():
+    found = lint_snippet('''
+        from jax.sharding import PartitionSpec as P
+        from distributed_tensorflow_tpu.parallel.sharding import \\
+            partition_rules
+
+        _COV = ("layer/kernel", "layer/bias")
+
+        T = partition_rules(
+            "const-cov", ((r"kernel$", P(None, "model")),), coverage=_COV)
+    ''', rules=["shard-rules-coverage"])
+    # bias path unmatched — found THROUGH the constant reference
+    assert len(found) == 1
+    assert "'layer/bias'" in found[0].message and "not total" in found[0].message
+
+
+def test_mesh_axis_vocab_tuple_entries_and_scope():
+    src = '''
+        from jax.sharding import PartitionSpec as P
+
+        GOOD = P(("data", "fsdp"), None)
+        BAD = P(("data", "fsdpp"), None)
+    '''
+    # in scope: only the typo'd tuple entry fires
+    found = lint_sources(
+        {"distributed_tensorflow_tpu/train/x.py": textwrap.dedent(src)},
+        rules=["mesh-axis-closed-vocab"])
+    assert [f.line for f in found] == [5]
+    assert "'fsdpp'" in found[0].message
+    # outside the mesh-consuming dirs: silent
+    assert lint_sources(
+        {"distributed_tensorflow_tpu/obs/x.py": textwrap.dedent(src)},
+        rules=["mesh-axis-closed-vocab"]) == []
+
+
+def test_mesh_axis_collective_positional_and_keyword():
+    found = lint_sources({"ops/x.py": textwrap.dedent('''
+        from jax import lax
+
+        from distributed_tensorflow_tpu.parallel import collectives as col
+
+
+        def f(x):
+            a = lax.psum(x, "data")            # fine
+            b = col.all_reduce(x, "modell")    # typo, positional
+            return lax.pmean(b, axis_name="bad_axis")
+    ''')}, rules=["mesh-axis-closed-vocab"])
+    assert [(f.line, "modell" in f.message or "bad_axis" in f.message)
+            for f in found] == [(9, True), (10, True)]
+
+
+def test_mesh_axis_silent_when_vocab_unreadable(tmp_path):
+    """No mesh.py to parse (foreign tree) → stay silent, never guess."""
+    found = lint_sources(
+        {"parallel/x.py": 'from jax import lax\n'
+                          'def f(x):\n'
+                          '    return lax.psum(x, "dtaa")\n'},
+        rules=["mesh-axis-closed-vocab"], root=str(tmp_path))
+    assert found == []
+
+
+def test_seam_bypass_carve_outs_and_scope():
+    body = '''
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from distributed_tensorflow_tpu.utils.compat import shard_map
+
+
+        def attn_rules():
+            return ((r"kernel$", P(None, "model")),)
+
+
+        def island(mesh, x):
+            f = shard_map(lambda a: a, mesh=mesh,
+                          in_specs=P("data"), out_specs=P("data"))
+            return f(x)
+
+
+        def bypass(mesh, x):
+            import jax
+            return jax.device_put(x, NamedSharding(mesh, P("data")))
+    '''
+    found = lint_sources(
+        {"distributed_tensorflow_tpu/serve/x.py": textwrap.dedent(body)},
+        rules=["sharding-seam-bypass"])
+    # only the bypass function fires (NamedSharding + P on line 18)
+    assert {f.line for f in found} == {18}
+    assert len(found) == 2
+    # the seam file itself, analysis/, and tests/ are exempt
+    for exempt in ("distributed_tensorflow_tpu/parallel/sharding.py",
+                   "distributed_tensorflow_tpu/analysis/x.py",
+                   "tests/x.py"):
+        assert lint_sources(
+            {exempt: textwrap.dedent(body)},
+            rules=["sharding-seam-bypass"]) == [], exempt
+
+
+def test_seam_bypass_rules_table_rows_exempt():
+    found = lint_sources({"distributed_tensorflow_tpu/models/m.py":
+        textwrap.dedent('''
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel import sharding
+
+        TABLE = sharding.partition_rules(
+            "m", ((r"kernel$", P(None, "model")),
+                  (sharding.CATCH_ALL, sharding.REPLICATED)),
+            coverage=("a/kernel", "a/bias"))
+    ''')}, rules=["sharding-seam-bypass"])
+    assert found == []
+
+
+def test_shard_rules_coverage_resolves_annotated_constant():
+    """An annotated module constant (`_COV: tuple = (...)`) must not
+    silently opt the table out of the simulation."""
+    found = lint_snippet('''
+        from jax.sharding import PartitionSpec as P
+        from distributed_tensorflow_tpu.parallel.sharding import \\
+            partition_rules
+
+        _COV: tuple = ("layer/kernel", "layer/bias")
+
+        T = partition_rules(
+            "ann-cov", ((r"kernel$", P(None, "model")),), coverage=_COV)
+    ''', rules=["shard-rules-coverage"])
+    assert len(found) == 1
+    assert "'layer/bias'" in found[0].message and "not total" in found[0].message

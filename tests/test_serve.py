@@ -871,3 +871,26 @@ def test_sampling_modes():
 
     with pytest.raises(ValueError):
         serve.sample(logits, None, temperature=1.0)
+
+
+def test_cache_specs_match_rules_table():
+    """Migration parity (PR 14): the KV_CACHE_RULES table derives the
+    exact spec tree the pre-engine logical-rules path produced."""
+    from distributed_tensorflow_tpu.parallel import sharding as sh
+    from distributed_tensorflow_tpu.serve import kv_cache as kv
+
+    table_specs = serve.cache_specs()  # default: the rules table
+    legacy = sh.spec_from_logical(kv.CACHE_LOGICAL, sh.TP_RULES)
+    assert table_specs.k == legacy and table_specs.v == legacy
+    # the explicit logical-rules escape hatch still resolves identically
+    assert serve.cache_specs(sh.TP_RULES) == table_specs
+
+
+def test_paged_cache_specs_match_rules_table():
+    from distributed_tensorflow_tpu.parallel import sharding as sh
+    from distributed_tensorflow_tpu.serve import kv_cache as kv
+
+    table_specs = serve.paged_cache_specs()
+    legacy = sh.spec_from_logical(kv.PAGED_CACHE_LOGICAL, sh.TP_RULES)
+    assert table_specs.k == legacy and table_specs.v == legacy
+    assert serve.paged_cache_specs(sh.TP_RULES) == table_specs

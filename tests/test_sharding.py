@@ -56,3 +56,266 @@ def test_replicate(mesh8):
     tree = {"w": jnp.ones((4, 4))}
     rep = sh.replicate(tree, mesh8)
     assert rep["w"].sharding.spec == P()
+
+
+# ---------------------------------------------------------------------------
+# Partition-rules engine (PR 14): tables, coverage contract, attribution
+# ---------------------------------------------------------------------------
+
+import pytest
+
+from distributed_tensorflow_tpu.models import resnet as resnet_lib
+from distributed_tensorflow_tpu.models import transformer as tfm
+from distributed_tensorflow_tpu.models import wide_deep as wd
+from distributed_tensorflow_tpu.models import common as models_common
+from distributed_tensorflow_tpu.ops.moe import moe_rules
+
+
+def _leaf(*shape):
+    return jnp.zeros(shape or (2,))
+
+
+def test_partition_rules_first_match_precedence():
+    """Overlapping regexes: the earlier row wins the contested path,
+    the later row stays alive on the paths the earlier one misses."""
+    table = sh.partition_rules(
+        "t-precedence",
+        (
+            (r"a/kernel", P(None, MODEL)),
+            (r"kernel", P(MODEL, None)),   # also matches a/kernel
+            (sh.CATCH_ALL, sh.REPLICATED),
+        ),
+    )
+    tree = {"a": {"kernel": _leaf(4, 4)}, "b": {"kernel": _leaf(4, 4)},
+            "c": {"bias": _leaf()}}
+    specs = sh.match_partition_rules(table, tree)
+    assert specs["a"]["kernel"] == P(None, MODEL)   # rule 0, not rule 1
+    assert specs["b"]["kernel"] == P(MODEL, None)
+    assert specs["c"]["bias"] == P()
+
+
+def test_partition_rules_unmatched_param_is_hard_error():
+    table = sh.partition_rules(
+        "t-unmatched", ((r"kernel$", P(None, MODEL)),))
+    tree = {"a": {"kernel": _leaf(4, 4)}, "b": {"bias": _leaf()}}
+    with pytest.raises(sh.PartitionCoverageError) as ei:
+        sh.match_partition_rules(table, tree)
+    msg = str(ei.value)
+    # the full attribution listing names the orphan and the winner
+    assert "b/bias  <-  UNMATCHED" in msg
+    assert "a/kernel" in msg and "rule[0]" in msg
+    assert "1 unmatched param(s)" in msg
+
+
+def test_partition_rules_dead_rule_is_hard_error():
+    table = sh.partition_rules(
+        "t-dead",
+        ((r"kernel$", P(None, MODEL)),
+         (r"kernle$", P(MODEL, None)),    # typo: matches nothing
+         (sh.CATCH_ALL, sh.REPLICATED)),
+    )
+    tree = {"a": {"kernel": _leaf(4, 4)}, "b": {"bias": _leaf()}}
+    with pytest.raises(sh.PartitionCoverageError) as ei:
+        sh.match_partition_rules(table, tree)
+    msg = str(ei.value)
+    assert "1 dead rule(s)" in msg
+    assert "'kernle$'" in msg and "DEAD" in msg
+
+
+def test_partition_rules_construction_validation():
+    with pytest.raises(ValueError, match="does not compile"):
+        sh.partition_rules("t-bad-rx", ((r"kernel[", P()),))
+    with pytest.raises(ValueError, match="must be a PartitionSpec"):
+        sh.partition_rules("t-bad-spec", ((r"kernel", "model"),))
+    with pytest.raises(ValueError, match="must be"):
+        sh.partition_rules("t-bad-arity", ((r"kernel",),))
+
+
+def test_partition_rules_coverage_contract_checked_at_construction():
+    """A table that cannot cover its own static fixture fails at
+    authoring time, not at the first training run."""
+    with pytest.raises(sh.PartitionCoverageError, match="coverage contract"):
+        sh.partition_rules(
+            "t-cov", ((r"kernel$", P(None, MODEL)),),
+            coverage=("a/kernel", "a/bias"))
+    # total + live: constructs fine
+    t = sh.partition_rules(
+        "t-cov-ok",
+        ((r"kernel$", P(None, MODEL)), (sh.CATCH_ALL, sh.REPLICATED)),
+        coverage=("a/kernel", "a/bias"))
+    assert t.coverage == ("a/kernel", "a/bias")
+
+
+def test_partition_rules_select_variants():
+    table = sh.partition_rules(
+        "t-var",
+        ((r"qkv/kernel", P(None, MODEL), "fused"),
+         (r"(query|key|value)/kernel", P(None, MODEL), "split"),
+         (sh.CATCH_ALL, sh.REPLICATED)),
+    )
+    fused = table.select("fused")
+    assert [r.pattern for r in fused.rows] == [r"qkv/kernel", sh.CATCH_ALL]
+    assert fused.name == "t-var[fused]"
+    # the un-selected variant row would be dead on a fused tree — and
+    # with select() it is gone instead
+    tree = {"attn": {"qkv": {"kernel": _leaf(4, 12)}}, "ln": {"b": _leaf()}}
+    specs = sh.match_partition_rules(fused, tree)
+    assert specs["attn"]["qkv"]["kernel"] == P(None, MODEL)
+    with pytest.raises(sh.PartitionCoverageError):
+        sh.match_partition_rules(table, tree)  # unselected: dead rows
+
+
+def test_attribution_listing_and_soft_dispatch():
+    table = sh.partition_rules(
+        "t-attr", ((r"kernel$", P(None, MODEL)),))
+    tree = {"a": {"kernel": _leaf(4, 4)}, "b": {"bias": _leaf()}}
+    matches = sh.attribute_partition_rules(table, tree)
+    assert [(m.path, m.rule_index) for m in matches] == [
+        ("a/kernel", 0), ("b/bias", -1)]
+    listing = sh.format_attribution(table, matches)
+    assert "a/kernel  <-  rule[0] 'kernel$'" in listing
+    assert "b/bias  <-  UNMATCHED" in listing
+    # specs_from_rules: tables are strict, legacy sequences stay soft
+    with pytest.raises(sh.PartitionCoverageError):
+        sh.specs_from_rules(tree, table)
+    soft = sh.specs_from_rules(tree, table.as_path_rules())
+    assert soft["b"]["bias"] == P()  # replicate-on-miss
+
+
+# ---------------------------------------------------------------------------
+# Migration parity: rules-table specs == the pre-engine hand-authored ones
+# ---------------------------------------------------------------------------
+
+#: The pre-PR-14 hand-authored megatron rules (models/transformer.py
+#: TP_PATH_RULES at PR 13), frozen here verbatim as the parity oracle.
+_LEGACY_TP_PATH_RULES = (
+    (r"(query|key|value)/kernel", P(None, "model")),
+    (r"(query|key|value)/bias", P("model")),
+    (r"qkv/kernel", P(None, "model")),
+    (r"qkv/bias", P("model")),
+    (r"attn_out/kernel", P("model", None)),
+    (r"mlp_in/kernel", P(None, "model")),
+    (r"mlp_in/bias", P("model")),
+    (r"mlp_out/kernel", P("model", None)),
+    (r"tok_embed/embedding", P("model", None)),
+    (r"mlm_bias", P("model")),
+)
+
+
+def _tiny_tfm_cfg(**kw):
+    base = dict(vocab_size=64, max_len=32, num_layers=2, d_model=32,
+                num_heads=4, d_ff=64, dropout=0.0, dtype="float32")
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+_TFM_VARIANTS = {
+    "bert": {},
+    "causal_fused": dict(causal=True, pre_ln=True, fused_qkv=True),
+    "moe": dict(num_experts=4, moe_every=2),
+}
+
+
+def _tfm_abstract_params(cfg):
+    init_fn = tfm.make_init_fn(tfm.Transformer(cfg), 16)
+    return jax.eval_shape(init_fn, jax.random.PRNGKey(0))[0]
+
+
+@pytest.mark.parametrize("variant", sorted(_TFM_VARIANTS))
+def test_transformer_rules_match_legacy_hand_authored_specs(variant):
+    """match_partition_rules(transformer_rules(cfg)) is bit-identical to
+    the PR 13 soft path-rules resolution for every shipped variant."""
+    cfg = _tiny_tfm_cfg(**_TFM_VARIANTS[variant])
+    params = _tfm_abstract_params(cfg)
+    got = sh.match_partition_rules(tfm.transformer_rules(cfg), params)
+    want = sh.specs_from_path_rules(
+        params, tuple(moe_rules()) + _LEGACY_TP_PATH_RULES)
+    assert jax.tree.structure(got, is_leaf=lambda x: isinstance(x, P)) \
+        == jax.tree.structure(want, is_leaf=lambda x: isinstance(x, P))
+    mismatches = [
+        (sh._path_str(p), a, b)
+        for (p, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(got),
+            jax.tree_util.tree_leaves_with_path(want))
+        if a != b
+    ]
+    assert mismatches == []
+
+
+def test_resnet_rules_match_legacy_replicated_specs():
+    """ResNet previously shipped NO param rules (everything replicated);
+    the one-row catch-all table must derive the identical spec tree."""
+    cfg = resnet_lib.ResNetConfig(stage_sizes=(1, 1), width=8,
+                                  num_classes=10, dtype="float32")
+    model = resnet_lib.ResNet50(cfg)
+    params = jax.eval_shape(
+        models_common.make_init_fn(model, (16, 16, 3)),
+        jax.random.PRNGKey(0))[0]
+    got = sh.match_partition_rules(resnet_lib.RESNET_RULES, params)
+    want = sh.replicated_specs(params)
+    assert all(jax.tree.leaves(jax.tree.map(
+        lambda a, b: a == b, got, want,
+        is_leaf=lambda x: isinstance(x, P))))
+
+
+def test_wide_deep_rules_match_legacy_embedding_rules():
+    """The pre-PR-14 wide&deep path rules, frozen verbatim: unanchored
+    table_\\d+ (which also swallowed wide_table_*, same spec) + the soft
+    replicate-on-miss default."""
+    legacy = (
+        (r"table_\d+", P("model", None)),
+        (r"wide_table_\d+", P("model", None)),
+    )
+    params = jax.eval_shape(
+        wd.make_init_fn(wd.WideDeepConfig()), jax.random.PRNGKey(0))[0]
+    got = sh.match_partition_rules(wd.WIDE_DEEP_RULES, params)
+    want = sh.specs_from_path_rules(params, legacy)
+    mismatches = [
+        (sh._path_str(p), a, b)
+        for (p, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(got),
+            jax.tree_util.tree_leaves_with_path(want))
+        if a != b
+    ]
+    assert mismatches == []
+
+
+def test_transformer_moe_rows_mirror_moe_rules():
+    """The four 'moe'-tagged table rows are exactly ops.moe.moe_rules()
+    (pattern AND spec) — the table cannot drift from the op's layout."""
+    tagged = [(r.pattern, r.spec) for r in tfm.TRANSFORMER_RULES.rows
+              if r.tag == "moe"]
+    assert tagged == list(map(tuple, moe_rules()))
+
+
+# ---------------------------------------------------------------------------
+# Coverage fixtures are live: the frozen path lists == the real models
+# ---------------------------------------------------------------------------
+
+
+def _paths(tree):
+    return sorted(
+        sh._path_str(p)
+        for p, _ in jax.tree_util.tree_leaves_with_path(tree))
+
+
+def test_transformer_coverage_fixture_is_live():
+    union = set()
+    for kw in _TFM_VARIANTS.values():
+        union.update(_paths(_tfm_abstract_params(_tiny_tfm_cfg(**kw))))
+    assert sorted(union) == sorted(tfm.TRANSFORMER_RULES.coverage)
+
+
+def test_resnet_coverage_fixture_is_live():
+    cfg = resnet_lib.ResNetConfig(stage_sizes=(1, 1), width=8,
+                                  num_classes=10, dtype="float32")
+    params = jax.eval_shape(
+        models_common.make_init_fn(resnet_lib.ResNet50(cfg), (16, 16, 3)),
+        jax.random.PRNGKey(0))[0]
+    assert _paths(params) == sorted(resnet_lib.RESNET_RULES.coverage)
+
+
+def test_wide_deep_coverage_fixture_is_live():
+    params = jax.eval_shape(
+        wd.make_init_fn(wd.WideDeepConfig()), jax.random.PRNGKey(0))[0]
+    assert _paths(params) == sorted(wd.WIDE_DEEP_RULES.coverage)

@@ -58,3 +58,20 @@ def test_wildcard_mesh_with_nondividing_fixed_axis():
         "--data.seq_len=16", "--model.max_len=16",
     )
     assert "pipe=3" in out
+
+
+def test_rules_attribution_view():
+    """--rules prints which table row won each param (index, regex,
+    spec) — the coverage-failure debugging surface."""
+    out = _run("wide_deep", "--rules", "--mesh.data=2", "--mesh.model=4")
+    assert "table 'wide-deep': 3 rule(s)" in out
+    assert (
+        "table_0  <-  rule[0] '(^|/)table_\\\\d+$' "
+        "-> PartitionSpec('model', None)" in out
+    )
+    assert (
+        "wide_table_0  <-  rule[1] '(^|/)wide_table_\\\\d+$' "
+        "-> PartitionSpec('model', None)" in out
+    )
+    assert "deep_0/kernel  <-  rule[2] '.*' -> PartitionSpec()" in out
+    assert "UNMATCHED" not in out and "DEAD" not in out

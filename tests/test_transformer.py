@@ -629,3 +629,38 @@ def test_bf16_head_dtype():
     ld, _ = tfm.lm_loss_fn(m16)(params, {}, batch, rng)
     lc, _ = tfm.chunked_lm_loss_fn(m16, 4)(params, {}, batch, rng)
     np.testing.assert_allclose(float(lc), float(ld), rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_rules_table_training_bit_identical_to_legacy_path_rules(devices):
+    """PR 14 migration acceptance: the same-seed short train run under
+    the strict transformer_rules table is BIT-identical (params and
+    losses) to the run under the frozen pre-engine soft path rules."""
+    legacy_rules = (
+        (r"(^|/)w_in$", P("expert", None, "model")),
+        (r"(^|/)b_in$", P("expert", "model")),
+        (r"(^|/)w_out$", P("expert", "model", None)),
+        (r"(^|/)b_out$", P("expert", None)),
+        (r"(query|key|value)/kernel", P(None, "model")),
+        (r"(query|key|value)/bias", P("model")),
+        (r"qkv/kernel", P(None, "model")),
+        (r"qkv/bias", P("model")),
+        (r"attn_out/kernel", P("model", None)),
+        (r"mlp_in/kernel", P(None, "model")),
+        (r"mlp_in/bias", P("model")),
+        (r"mlp_out/kernel", P("model", None)),
+        (r"tok_embed/embedding", P("model", None)),
+        (r"mlm_bias", P("model")),
+    )
+    mesh = build_mesh(MeshSpec(data=4, model=2), devices[:8])
+    table = tfm.transformer_rules(tiny_cfg())
+    losses_t, state_t = _run_steps(mesh, table)
+    losses_l, state_l = _run_steps(mesh, legacy_rules)
+    assert losses_t == losses_l  # float-exact, not allclose
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(state_t.params),
+        jax.tree_util.tree_leaves_with_path(state_l.params),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=str(pa))
+        assert a.sharding == b.sharding, pa

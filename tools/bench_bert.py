@@ -123,7 +123,7 @@ def main() -> None:
     ))
     state, specs = init_train_state(
         tfm.make_init_fn(model, seq), tx, mesh, jax.random.PRNGKey(0),
-        param_rules=tfm.tp_rules(),
+        param_rules=tfm.transformer_rules(cfg),
     )
     step = jit_train_step(
         make_train_step(loss_fn, tx, StepOptions()), mesh, specs,

@@ -89,7 +89,7 @@ def main() -> None:
     assert tx is not None
     state, specs = init_train_state(
         wd.make_init_fn(cfg, mesh), tx, mesh, jax.random.PRNGKey(0),
-        param_rules=wd.embedding_rules(),
+        param_rules=wd.WIDE_DEEP_RULES,
     )
     step = jit_train_step(
         make_train_step(wd.ctr_loss_fn(model), tx, StepOptions()),

@@ -6,17 +6,24 @@
 #   2. tools/obs_check.py      — telemetry smoke: registry → Prometheus
 #      exposition render → format lint → JSONL round-trip (ISSUE 2)
 #   3. tools/dtf_lint.py       — framework-aware static analysis
-#      (ISSUE 7, v2 engine ISSUE 10): --self-check first (every rule —
-#      a rule with NO fixture is itself a self-check failure — must
-#      still fire on its shipped fixtures, so the gate cannot rot
-#      silently), then the --strict tree lint (host-sync-in-step and
+#      (ISSUE 7, v2 engine ISSUE 10, v3 sharding auditor ISSUE 14):
+#      --self-check first (every rule — a rule with NO fixture is
+#      itself a self-check failure — must still fire on its shipped
+#      fixtures, so the gate cannot rot silently), then the --strict
+#      tree lint with all 11 rules (host-sync-in-step and
 #      donation-after-use on the cross-module call graph, plus
 #      lock-discipline, closed-vocab, exception-hygiene,
-#      wall-clock-in-seam, atomic-durable-write, metric-naming must
-#      all be clean over the package, tools, and bench.py), then the
-#      determinism rule alone over tests/ — the chaos/replay oracles
-#      must not consume ambient entropy either (relaxed set: pure test
-#      scaffolding is exempt from everything but determinism)
+#      wall-clock-in-seam, atomic-durable-write, metric-naming, and
+#      the v3 partitioning family — shard-rules-coverage totality/
+#      liveness of every partition_rules table, mesh-axis-closed-vocab
+#      over every PartitionSpec/collective axis literal, and
+#      sharding-seam-bypass confining placement construction to
+#      parallel/sharding.py — must all be clean over the package,
+#      tools, and bench.py; an injected unmatched param or out-of-
+#      vocab axis fails here), then the determinism rule alone over
+#      tests/ — the chaos/replay oracles must not consume ambient
+#      entropy either (relaxed set: pure test scaffolding is exempt
+#      from everything but determinism)
 #   4. tools/sweep.py --dryrun — scaling-observatory smoke (ISSUE 11):
 #      a 2-cell mesh×workload sweep (mlp × {1dev, dp8} on 8 fake CPU
 #      devices) that must emit a schema-valid dtf-scaling-1 report,

@@ -24,6 +24,15 @@ hand (rule catalog + pre-fix examples: docs/static-analysis.md):
                          os.replace, never truncated in place
     metric-naming        counters end _total, second-valued histograms
                          end _seconds, kinds match the docs tables
+    shard-rules-coverage every partition_rules table compiles, ships a
+                         coverage fixture, and is total with no dead
+                         rules against it (first-match precedence)
+    mesh-axis-closed-vocab  axis-name literals in PartitionSpec(...)
+                         and collective axis args are in
+                         parallel/mesh.AXIS_NAMES (no typo'd axes)
+    sharding-seam-bypass NamedSharding/PartitionSpec constructed only
+                         in parallel/sharding.py, rules tables, and
+                         shard_map island layouts
 
 Usage:
     tools/dtf_lint.py [--strict] [--json] [--rules a,b] PATH [PATH...]

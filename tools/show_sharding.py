@@ -10,10 +10,17 @@ declarative, so it can be shown before running. Uses the same fake-CPU
 mesh rig as the tests.
 
 Usage:
-  tools/show_sharding.py <workload> [--mesh.data=2 --mesh.model=4 ...]
+  tools/show_sharding.py <workload> [--rules] [--mesh.data=2 ...]
 e.g.
   tools/show_sharding.py bert_pretrain --mesh.data=2 --mesh.fsdp=2 \
       --mesh.model=2
+
+``--rules`` switches to the partition-rules attribution view: one line
+per param naming the table row that won it (rule index, regex,
+resulting spec) plus a DEAD trailer for rows that matched nothing — the
+debugging handle for shard-rules-coverage / PartitionCoverageError
+failures. Params the table misses print as UNMATCHED instead of
+raising, so a broken table is still inspectable.
 """
 
 import os
@@ -66,7 +73,9 @@ import numpy as np
 def main() -> None:
     if len(sys.argv) < 2 or sys.argv[1].startswith("-"):
         raise SystemExit(__doc__)
-    workload, overrides = sys.argv[1], sys.argv[2:]
+    workload = sys.argv[1]
+    rules_view = "--rules" in sys.argv[2:]
+    overrides = [a for a in sys.argv[2:] if a != "--rules"]
 
     from distributed_tensorflow_tpu.parallel import build_mesh, describe
     from distributed_tensorflow_tpu.parallel import sharding as sh
@@ -83,23 +92,48 @@ def main() -> None:
     abstract_params, _ = jax.eval_shape(
         parts.init_fn, jax.random.PRNGKey(0)
     )
-    P = jax.sharding.PartitionSpec
+
+    if rules_view:
+        print(f"workload: {workload}   mesh: {describe(mesh)}")
+        if parts.param_rules is None:
+            what = ("an explicit param_specs tree"
+                    if parts.param_specs is not None
+                    else "no rules (fully replicated"
+                    + (" before auto-FSDP)" if parts.fsdp else ")"))
+            raise SystemExit(
+                f"show_sharding --rules: workload {workload!r} uses "
+                f"{what}; there is no rules table to attribute")
+        table = parts.param_rules
+        if not isinstance(table, sh.PartitionRules):
+            # legacy path-rules sequence: wrap for the same listing
+            table = sh.PartitionRules(
+                "<legacy-path-rules>",
+                tuple(sh.PartitionRow(p, s) for p, s in parts.param_rules),
+            )
+        matches = sh.attribute_partition_rules(table, abstract_params)
+        print(sh.format_attribution(table, matches))
+        if parts.fsdp:
+            print("(fsdp=True: replicated leaves above are then offered "
+                  "to auto_fsdp_specs — run without --rules for the "
+                  "final merged layout)")
+        _ = tx
+        return
+
     if parts.param_specs is not None:
         # explicit spec tree (pipelined stacked layouts) wins, same
         # precedence as init_train_state
         specs = parts.param_specs
     elif parts.param_rules is not None:
-        specs = sh.specs_from_path_rules(abstract_params, parts.param_rules)
+        # tables resolve strictly (coverage contract), legacy path
+        # rules keep replicate-on-miss — same seam as init_train_state
+        specs = sh.specs_from_rules(abstract_params, parts.param_rules)
     else:
-        specs = jax.tree.map(lambda _: P(), abstract_params)
+        specs = sh.replicated_specs(abstract_params)
     if parts.param_specs is None and parts.fsdp:
         # same merge as train/step.init_train_state: rules win, auto-FSDP
         # fills the replicated remainder
-        auto = sh.auto_fsdp_specs(abstract_params, mesh)
-        specs = jax.tree.map(
-            lambda explicit, a: a if explicit == P() else explicit,
-            specs, auto, is_leaf=lambda x: isinstance(x, P),
-        )
+        specs = sh.merge_specs(
+            specs, sh.auto_fsdp_specs(abstract_params, mesh))
 
     print(f"workload: {workload}   mesh: {describe(mesh)}")
     axis_size = dict(mesh.shape)
