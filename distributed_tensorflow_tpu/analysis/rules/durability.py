@@ -4,8 +4,9 @@ Recovery walks a chain of on-disk evidence: checkpoint shards and
 MANIFEST.dtf (runtime/io.py CRC-verified payloads), quarantine.json
 (the trajectory's hole list — a torn write there and every future
 incarnation fetches a different stream), heartbeat/INCARNATION/
-RESTORE_STEP control files (resilience/fleet.py), and postmortem dumps
-(obs/flightrec.py). The framework's ONE idiom for all of them:
+RESTORE_STEP control files (resilience/fleet.py), postmortem dumps
+(obs/flightrec.py), and fleet telemetry snapshots / merged timelines
+(obs/fleetview.py). The framework's ONE idiom for all of them:
 
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -61,6 +62,7 @@ DURABLE_MODULES = (
     "resilience/fleet.py",
     "resilience/anomaly.py",
     "obs/flightrec.py",
+    "obs/fleetview.py",
     "runtime/io.py",
 )
 

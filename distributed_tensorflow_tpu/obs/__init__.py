@@ -42,3 +42,4 @@ from .flightrec import (  # noqa: F401
 )
 from . import goodput  # noqa: F401
 from . import scaling  # noqa: F401
+from . import fleetview  # noqa: F401

@@ -1,0 +1,638 @@
+"""Fleet observatory — cross-worker telemetry snapshots, fleet-wide
+aggregation, and causally merged postmortem timelines.
+
+Every observability surface before this module — the mergeable Registry,
+the flight-recorder ring, the goodput ledger — is per-process, but the
+interesting failures of an N-worker elastic gang (gang stops, shrinks,
+split-gang near-misses) span processes. Three pieces close the gap:
+
+- **SnapshotExporter** (worker side): periodically writes an atomic,
+  schema-versioned telemetry snapshot (``dtf-fleetsnap-1``: registry
+  dump + flight-recorder tail + identity) next to the worker's
+  heartbeat, tmp+fsync+replace so a worker killed mid-export leaves the
+  previous snapshot readable. Driven from the step seam by
+  ``train.callbacks.FleetSnapshotCallback``; the clock is injectable,
+  so the export path is wall-clock-free in the seam.
+- **FleetAggregator** (fleet side): folds the per-worker snapshots into
+  ONE view through the ``Registry.merge`` contract — counters and
+  histogram buckets add, so a fleet-wide p99 read from the merged
+  buckets is the p99 of the union stream (to bucket resolution), which
+  averaging per-worker p99s can never give. The view carries every
+  worker metric re-labeled ``worker=<i>`` plus the unlabeled fleet-wide
+  union (counters/histograms only: a "latest write" gauge has no
+  cross-process union), and is REBUILT from the current snapshots on
+  every poll — folding a live counter into an accumulating registry
+  twice would double-count it. Derived gauges
+  (``fleet_goodput_fraction``, per-worker
+  ``fleet_worker_staleness_seconds`` judged on the aggregator's OWN
+  clock) go to the fleet's registry; the merged view renders over the
+  existing export/scrape path (``obs.render`` / ``obs.serve_http``).
+- **merge_timelines**: renders ONE causally consistent timeline from N
+  per-process flight-recorder dumps. Per-process monotonic clocks do
+  not compare, so alignment anchors on control-plane events both sides
+  already record: a worker's whole life follows its ``fleet_launch``
+  (lower bound on the clock offset), and the fleet's observations of
+  the worker — a ``fleetsnap_merge`` of its export, the relayed
+  ``ckpt_restore``, the resize handshake (``fleet_hold`` →
+  ``elastic_hold`` → ``fleet_shrink``/``fleet_rejoin`` →
+  ``elastic_release``), ``fleet_worker_dead``, ``fleet_done`` — bound
+  it from above. The merger takes the LARGEST lower bound, so every
+  worker event lands at-or-before its true fleet-clock position: any
+  true "worker event before fleet event" relation is preserved, and the
+  anchored "fleet event before worker event" relations are forced —
+  which is exactly what makes ``postmortem.py --merge --expect`` a
+  sound cross-process causal gate. Inconsistent or missing anchors are
+  merge FAILURES, never silently absorbed.
+
+Nothing here imports jax — plain stdlib + the registry, usable from the
+fleet control plane and from tools that never touch a device.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Iterable, Mapping, Sequence
+
+from . import goodput
+from .flightrec import EVENT_KINDS, FlightRecorder, default_recorder
+from .registry import Registry, default_registry
+
+__all__ = [
+    "SCHEMA",
+    "MERGED_SCHEMA",
+    "FLEETSNAP_EXPORTS_TOTAL",
+    "FLEETSNAP_MERGES_TOTAL",
+    "FLEET_GOODPUT_FRACTION",
+    "FLEET_WORKER_STALENESS",
+    "fleetsnap_path",
+    "SnapshotExporter",
+    "read_snapshot",
+    "validate_snapshot",
+    "FleetAggregator",
+    "load_dump",
+    "merge_timelines",
+    "write_merged",
+    "validate_merged_dump",
+]
+
+logger = logging.getLogger(__name__)
+
+#: worker telemetry snapshot schema tag — bump when the layout changes
+SCHEMA = "dtf-fleetsnap-1"
+#: merged cross-worker timeline schema tag
+MERGED_SCHEMA = "dtf-fleetmerge-1"
+
+#: metric names (docs/observability.md "Fleet observability")
+FLEETSNAP_EXPORTS_TOTAL = "fleetsnap_exports_total"
+FLEETSNAP_MERGES_TOTAL = "fleetsnap_merges_total"
+FLEET_GOODPUT_FRACTION = "fleet_goodput_fraction"
+FLEET_WORKER_STALENESS = "fleet_worker_staleness_seconds"
+
+_KNOWN_KINDS = frozenset(EVENT_KINDS)
+
+
+def fleetsnap_path(fleet_dir: str, worker: int) -> str:
+    """The one snapshot file of worker ``worker`` under the fleet dir —
+    the single definition of the layout, shared by exporter, aggregator,
+    and tools/fleet_top.py (it sits next to ``heartbeat-<i>.json``)."""
+    return os.path.join(
+        os.path.abspath(os.path.expanduser(fleet_dir)),
+        f"fleetsnap-{worker}.json",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Worker side: snapshot export
+# ---------------------------------------------------------------------------
+
+
+class SnapshotExporter:
+    """Worker-side telemetry snapshot writer.
+
+    Each ``export()`` bumps a per-process ``seq``, emits a
+    ``fleetsnap_export`` event (the clock anchor the merged timeline
+    pairs with the fleet's ``fleetsnap_merge``), and atomically rewrites
+    the snapshot file: registry dump, flight-recorder tail, and identity
+    (worker, incarnation, pid, seq). tmp+fsync+replace — a worker killed
+    mid-export leaves the previous snapshot readable, never a torn one.
+
+    ``min_interval_s`` rate-limits exports on the injectable ``clock``
+    (a per-step callback cadence can then stay 1 without a disk write
+    per step); ``force=True`` bypasses it for end-of-run exports.
+    """
+
+    def __init__(self, path: str, worker: int, incarnation: int = 0,
+                 registry: Registry | None = None,
+                 flightrec: FlightRecorder | None = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 min_interval_s: float = 0.0, tail: int = 256):
+        if min_interval_s < 0:
+            raise ValueError("min_interval_s must be >= 0")
+        if tail < 1:
+            raise ValueError("tail must be >= 1")
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        self.path = path
+        self.worker = int(worker)
+        self.incarnation = int(incarnation)
+        self.registry = registry if registry is not None else default_registry()
+        self.flightrec = (flightrec if flightrec is not None
+                          else default_recorder())
+        self.clock = clock
+        self.min_interval_s = float(min_interval_s)
+        self.tail = int(tail)
+        self._seq = 0
+        self._t_last: float | None = None
+        self._m_exports = self.registry.counter(
+            FLEETSNAP_EXPORTS_TOTAL,
+            "telemetry snapshots exported to the fleet dir",
+            worker=str(self.worker))
+
+    def export(self, step: int | None = None, phase: str | None = None,
+               force: bool = False) -> str | None:
+        """Write one snapshot; returns its path, or None when the
+        rate limit swallowed the call. Raises OSError on write failure
+        (callers on the step seam catch and log — see
+        ``FleetSnapshotCallback``); the previous snapshot stays intact
+        either way."""
+        now = float(self.clock())
+        if (not force and self._t_last is not None
+                and now - self._t_last < self.min_interval_s):
+            return None
+        self._t_last = now
+        self._seq += 1
+        self._m_exports.inc()
+        # emit BEFORE the write: the export event is then part of the
+        # worker's final dump no matter when the process dies, and the
+        # fleet's fleetsnap_merge observation still strictly follows it
+        self.flightrec.emit("fleetsnap_export", seq=self._seq,
+                            worker=self.worker)
+        payload = {
+            "schema": SCHEMA,
+            "worker": self.worker,
+            "incarnation": self.incarnation,
+            "seq": self._seq,
+            "pid": os.getpid(),
+            "t": now,
+            "step": int(step) if step is not None else None,
+            "phase": phase,
+            "registry": self.registry.snapshot(),
+            "flightrec_tail": self.flightrec.events()[-self.tail:],
+            "flightrec_dropped": self.flightrec.dropped,
+        }
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(payload, sort_keys=True, default=repr))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)  # a torn export must not look complete
+        return self.path
+
+
+def read_snapshot(path: str) -> dict | None:
+    """Decode the snapshot at ``path``; None when absent or unreadable
+    (an interrupted export never replaces the file, so unreadable means
+    external corruption — logged, treated as absent)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            raise ValueError("snapshot is not a JSON object")
+        return data
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        logger.warning("unreadable fleet snapshot %s (%s); treating as "
+                       "absent", path, e)
+        return None
+
+
+def validate_snapshot(snap: Mapping,
+                      expect_worker: int | None = None) -> list[str]:
+    """Schema-check a decoded snapshot; returns failures (empty ==
+    pass). ``expect_worker`` additionally pins the identity: a snapshot
+    claiming another worker's index under this worker's path is a label
+    collision, not a merge input."""
+    failures: list[str] = []
+    if snap.get("schema") != SCHEMA:
+        failures.append(
+            f"snapshot schema {snap.get('schema')!r} != {SCHEMA!r}")
+    for key in ("worker", "incarnation", "seq", "pid"):
+        v = snap.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            failures.append(f"missing/non-int {key!r}: {v!r}")
+    t = snap.get("t")
+    if not isinstance(t, (int, float)) or isinstance(t, bool):
+        failures.append(f"missing/non-numeric 't': {t!r}")
+    if (expect_worker is not None and isinstance(snap.get("worker"), int)
+            and snap["worker"] != expect_worker):
+        failures.append(
+            f"worker label collision: snapshot claims worker "
+            f"{snap['worker']}, expected {expect_worker}")
+    reg = snap.get("registry")
+    if not isinstance(reg, Mapping):
+        failures.append(f"missing/non-dict 'registry': {type(reg).__name__}")
+    else:
+        for key, entry in reg.items():
+            if not isinstance(entry, Mapping) or "kind" not in entry:
+                failures.append(f"registry entry {key!r} has no kind")
+                continue
+            kind = entry["kind"]
+            if kind == "histogram":
+                bounds, counts = entry.get("bounds"), entry.get("counts")
+                if (not isinstance(bounds, list) or not isinstance(counts, list)
+                        or len(counts) != len(bounds) + 1):
+                    failures.append(
+                        f"registry histogram {key!r} bounds/counts "
+                        f"malformed")
+            elif kind in ("counter", "gauge"):
+                if not isinstance(entry.get("value"), (int, float)):
+                    failures.append(
+                        f"registry {kind} {key!r} has no numeric value")
+            else:
+                failures.append(
+                    f"registry entry {key!r} has unknown kind {kind!r}")
+    tail = snap.get("flightrec_tail")
+    if not isinstance(tail, list):
+        failures.append("missing/non-list 'flightrec_tail'")
+    else:
+        for i, e in enumerate(tail):
+            if not isinstance(e, Mapping) \
+                    or e.get("kind") not in _KNOWN_KINDS \
+                    or not isinstance(e.get("t"), (int, float)):
+                failures.append(
+                    f"flightrec_tail[{i}] malformed: {e!r}")
+                break
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# Fleet side: aggregation
+# ---------------------------------------------------------------------------
+
+
+class FleetAggregator:
+    """Folds per-worker snapshots into one fleet-wide registry view.
+
+    ``poll()`` reads every worker's snapshot file, rebuilds the merged
+    view FROM SCRATCH (the scrape-aggregator pattern: re-merging a live
+    counter into an accumulating registry would double-count it), and
+    refreshes the derived gauges on the fleet's own registry. Snapshot
+    freshness is judged by observing ``(pid, seq)`` changes on the
+    aggregator's OWN clock — writer timestamps never cross processes,
+    the same rule the heartbeat monitor follows. Each newly observed
+    snapshot emits ``fleetsnap_merge`` into the fleet's flight recorder:
+    the recurring clock anchor ``merge_timelines`` aligns on.
+    """
+
+    def __init__(self, fleet_dir: str, workers: Sequence[int],
+                 registry: Registry | None = None,
+                 flightrec: FlightRecorder | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.fleet_dir = fleet_dir
+        self.workers = [int(w) for w in workers]
+        self.registry = registry if registry is not None else default_registry()
+        self.flightrec = (flightrec if flightrec is not None
+                          else default_recorder())
+        self.clock = clock
+        self._seen: dict[int, tuple[int, int]] = {}   # worker -> (pid, seq)
+        self._t_new: dict[int, float] = {}  # worker -> own-clock obs time
+        #: latest (worker, incarnation, seq, step, phase) per worker
+        self.status: dict[int, dict] = {}
+        self._view = Registry()
+
+    def poll(self) -> Registry:
+        """One aggregation pass; returns the rebuilt merged view (also
+        available as ``view()`` until the next poll)."""
+        now = float(self.clock())
+        view = Registry()
+        union = Registry()
+        for i in self.workers:
+            snap = read_snapshot(fleetsnap_path(self.fleet_dir, i))
+            if snap is None:
+                continue
+            bad = validate_snapshot(snap, expect_worker=i)
+            if bad:
+                logger.warning("fleet: snapshot for worker %d rejected: %s",
+                               i, bad[0])
+                continue
+            key = (snap["pid"], snap["seq"])
+            if self._seen.get(i) != key:
+                self._seen[i] = key
+                self._t_new[i] = now
+                self.registry.counter(
+                    FLEETSNAP_MERGES_TOTAL,
+                    "new worker snapshots folded into the fleet view",
+                    worker=str(i)).inc()
+                self.flightrec.emit(
+                    "fleetsnap_merge", worker=i, seq=snap["seq"],
+                    pid=snap["pid"], incarnation=snap["incarnation"])
+            self.status[i] = {
+                "worker": i, "incarnation": snap["incarnation"],
+                "seq": snap["seq"], "pid": snap["pid"],
+                "step": snap.get("step"), "phase": snap.get("phase"),
+            }
+            try:
+                view.merge(Registry.from_snapshot(
+                    snap["registry"], labels={"worker": str(i)}))
+                # fleet-wide union: counters/histograms sum exactly; a
+                # "latest write" gauge has no cross-process union and
+                # stays worker-labeled only (merge, not average — and
+                # not pretend). Metrics ALREADY carrying a worker label
+                # (the exporter's own fleetsnap_exports_total{worker=…})
+                # are per-worker by definition and must stay out of the
+                # union: their relabeled copy lands on the same key, so
+                # folding both into the view would double-count them.
+                union_entries = {
+                    k: v for k, v in snap["registry"].items()
+                    if "worker" not in (v.get("labels") or {})}
+                union.merge(Registry.from_snapshot(
+                    union_entries, kinds=("counter", "histogram")))
+            except ValueError as e:
+                logger.warning("fleet: snapshot for worker %d unmergeable: "
+                               "%s", i, e)
+                continue
+        view.merge(union)
+        for i, t0 in self._t_new.items():
+            staleness = max(now - t0, 0.0)
+            for reg in (self.registry, view):
+                reg.gauge(
+                    FLEET_WORKER_STALENESS,
+                    "fleet-clock seconds since this worker's newest "
+                    "snapshot was first observed",
+                    worker=str(i)).set(staleness)
+        productive = union.total(goodput.PRODUCTIVE_SECONDS)
+        wasted = union.total(goodput.WASTED_SECONDS)
+        if productive + wasted > 0:
+            frac = productive / (productive + wasted)
+            for reg in (self.registry, view):
+                reg.gauge(
+                    FLEET_GOODPUT_FRACTION,
+                    "fleet-wide productive / tracked seconds, from "
+                    "MERGED per-worker counters").set(frac)
+        self._view = view
+        return view
+
+    def view(self) -> Registry:
+        """The merged view from the last ``poll()`` — render it over the
+        existing scrape path (``obs.render(agg.view())``)."""
+        return self._view
+
+
+# ---------------------------------------------------------------------------
+# Merged cross-worker timelines
+# ---------------------------------------------------------------------------
+
+
+def load_dump(path: str) -> tuple[dict, list[dict]]:
+    """Read a flight-recorder (or merged) JSONL dump: (header, events).
+    Raises ValueError/OSError on an unreadable dump."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    if not lines:
+        raise ValueError(f"empty dump: {path}")
+    header = json.loads(lines[0])
+    events = [json.loads(line) for line in lines[1:]]
+    return header, events
+
+
+def _first(events: Iterable[Mapping], kind: str, **attrs: Any):
+    for e in events:
+        if e.get("kind") == kind \
+                and all(e.get(k) == v for k, v in attrs.items()):
+            return e
+    return None
+
+
+def _offset_bounds(src: str, header: Mapping, events: Sequence[Mapping],
+                   fleet_events: Sequence[Mapping],
+                   failures: list[str]) -> tuple[float, float]:
+    """Clock-offset bounds (lo, hi) mapping this worker dump onto the
+    fleet clock. Appends to ``failures`` when the required anchor is
+    missing or the bounds are inconsistent."""
+    w, k = header["worker"], header["incarnation"]
+    pid = header.get("pid")
+    first_t, last_t = events[0]["t"], events[-1]["t"]
+    lows: list[float] = []
+    highs: list[float] = []
+
+    # REQUIRED lower anchor: the fleet launched this process before any
+    # of its events. Disambiguate multiple launches of the same slot
+    # (elastic replacement relaunch) by pid.
+    launches = [e for e in fleet_events if e.get("kind") == "fleet_launch"
+                and e.get("worker") == w and e.get("incarnation") == k]
+    by_pid = [e for e in launches if pid is not None
+              and e.get("pid") == pid]
+    if by_pid:
+        launches = by_pid
+    if not launches:
+        failures.append(
+            f"{src}: clock anchor missing — no fleet_launch for worker "
+            f"{w} incarnation {k} (pid {pid}) in the fleet dump")
+        return 0.0, 0.0
+    if len(launches) > 1:
+        failures.append(
+            f"{src}: clock anchor ambiguous — {len(launches)} "
+            f"fleet_launch events for worker {w} incarnation {k} and no "
+            f"pid match")
+        return 0.0, 0.0
+    lows.append(launches[0]["t"] - first_t)
+
+    for fe in fleet_events:
+        kind = fe.get("kind")
+        if kind == "fleet_hold" and fe.get("version") is not None:
+            we = _first(events, "elastic_hold", version=fe["version"])
+            if we is not None:
+                lows.append(fe["t"] - we["t"])
+        elif kind in ("fleet_shrink", "fleet_rejoin") \
+                and fe.get("version") is not None:
+            we = _first(events, "elastic_release", version=fe["version"])
+            if we is not None:
+                lows.append(fe["t"] - we["t"])
+            # the release was written only after the fleet OBSERVED the
+            # holders' barrier acks: their hold precedes it
+            wh = _first(events, "elastic_hold", version=fe["version"] - 1)
+            if wh is not None:
+                highs.append(fe["t"] - wh["t"])
+        elif kind == "fleetsnap_merge" and fe.get("worker") == w \
+                and pid is not None and fe.get("pid") == pid:
+            we = _first(events, "fleetsnap_export", seq=fe.get("seq"))
+            if we is not None:
+                highs.append(fe["t"] - we["t"])
+        elif kind == "ckpt_restore" and fe.get("relayed") \
+                and fe.get("worker") == w and fe.get("incarnation") == k:
+            we = _first(events, "ckpt_restore", step=fe.get("step"))
+            if we is not None:
+                highs.append(fe["t"] - we["t"])
+        elif kind == "fleet_worker_dead" and fe.get("worker") == w \
+                and fe.get("incarnation") == k \
+                and pid is not None and fe.get("pid") == pid:
+            highs.append(fe["t"] - last_t)
+        elif kind == "fleet_done":
+            # fires only after every worker's exit: all events precede
+            highs.append(fe["t"] - last_t)
+
+    lo = max(lows)
+    hi = min(highs) if highs else float("inf")
+    if lo > hi + 1e-9:
+        failures.append(
+            f"{src}: clock anchors inconsistent — offset lower bound "
+            f"{lo:.6f}s exceeds upper bound {hi:.6f}s (the dumps do not "
+            f"describe one causal history)")
+    return lo, hi
+
+
+def merge_timelines(
+    fleet_path: str, worker_paths: Sequence[str], reason: str = "",
+) -> tuple[dict, list[dict], list[str]]:
+    """Merge one fleet dump and N worker dumps into a single
+    fleet-clock timeline. Returns ``(header, events, failures)`` —
+    a non-empty ``failures`` means the merge is unusable (missing
+    worker identity, missing/inconsistent clock anchors, worker label
+    collisions) and header/events are best-effort only.
+
+    Every merged event carries ``src`` (``fleet`` or ``w<i>i<k>``) and a
+    timestamp shifted by that source's anchored offset; ties sort the
+    fleet event first (anchors are happens-before edges FROM the fleet).
+    """
+    failures: list[str] = []
+    try:
+        fleet_header, fleet_events = load_dump(fleet_path)
+    except (OSError, ValueError) as e:
+        return {}, [], [f"unreadable fleet dump {fleet_path}: {e}"]
+    sources: list[dict] = [{
+        "src": "fleet", "offset": 0.0, "events": len(fleet_events),
+        "pid": fleet_header.get("pid"),
+    }]
+    keyed: list[tuple[float, int, int, int, dict]] = []
+    for j, e in enumerate(fleet_events):
+        rec = dict(e)
+        rec["src"] = "fleet"
+        keyed.append((float(e["t"]), 0, 0, j, rec))
+
+    seen: set[tuple[int, int]] = set()
+    for si, path in enumerate(worker_paths, start=1):
+        try:
+            header, events = load_dump(path)
+        except (OSError, ValueError) as e:
+            failures.append(f"unreadable worker dump {path}: {e}")
+            continue
+        w, k = header.get("worker"), header.get("incarnation")
+        if not isinstance(w, int) or not isinstance(k, int):
+            failures.append(
+                f"{path}: dump header lacks worker/incarnation identity "
+                f"(dump with extra={{'worker': i, 'incarnation': k}})")
+            continue
+        src = f"w{w}i{k}"
+        if (w, k) in seen:
+            failures.append(
+                f"worker label collision: two dumps claim worker {w} "
+                f"incarnation {k}")
+            continue
+        seen.add((w, k))
+        if not events:
+            sources.append({"src": src, "offset": 0.0, "events": 0,
+                            "pid": header.get("pid"), "worker": w,
+                            "incarnation": k})
+            continue
+        lo, hi = _offset_bounds(src, header, events, fleet_events, failures)
+        sources.append({
+            "src": src, "offset": lo, "events": len(events),
+            "pid": header.get("pid"), "worker": w, "incarnation": k,
+            "offset_bounds": [lo, hi if hi != float("inf") else None],
+        })
+        for j, e in enumerate(events):
+            rec = dict(e)
+            rec["t"] = float(e["t"]) + lo
+            rec["src"] = src
+            keyed.append((rec["t"], 1, si, j, rec))
+
+    keyed.sort(key=lambda x: x[:4])
+    merged = [x[4] for x in keyed]
+    header = {
+        "schema": MERGED_SCHEMA,
+        "reason": reason,
+        "events": len(merged),
+        "sources": sources,
+    }
+    return header, merged, failures
+
+
+def write_merged(path: str, header: Mapping, events: Sequence[Mapping]) -> str:
+    """Write a merged timeline as JSONL (header line + one event per
+    line), with the same atomic idiom as every postmortem artifact."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(dict(header), sort_keys=True, default=repr) + "\n")
+        for e in events:
+            f.write(json.dumps(dict(e), sort_keys=True, default=repr) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def validate_merged_dump(path: str) -> list[str]:
+    """Schema-check a merged timeline dump; returns failures (empty ==
+    pass). Checks: header schema tag + event count + unique sources
+    (a duplicate (worker, incarnation) is a label collision), and per
+    event: numeric non-decreasing ``t``, known ``kind``, a ``src``
+    declared in the header, int ``step`` when present."""
+    failures: list[str] = []
+    try:
+        header, events = load_dump(path)
+    except (OSError, ValueError) as e:
+        return [f"unreadable merged dump: {e}"]
+    if header.get("schema") != MERGED_SCHEMA:
+        failures.append(
+            f"header schema {header.get('schema')!r} != {MERGED_SCHEMA!r}")
+    if header.get("events") != len(events):
+        failures.append(
+            f"header says {header.get('events')} events, dump has "
+            f"{len(events)}")
+    sources = header.get("sources")
+    srcs: set[str] = set()
+    if not isinstance(sources, list) or not sources:
+        failures.append("header has no sources list")
+    else:
+        ids: set[tuple[int, int]] = set()
+        for s in sources:
+            if not isinstance(s, Mapping) or not isinstance(
+                    s.get("src"), str):
+                failures.append(f"malformed source entry: {s!r}")
+                continue
+            if s["src"] in srcs:
+                failures.append(f"duplicate source {s['src']!r}")
+            srcs.add(s["src"])
+            wk = (s.get("worker"), s.get("incarnation"))
+            if isinstance(wk[0], int) and isinstance(wk[1], int):
+                if wk in ids:
+                    failures.append(
+                        f"worker label collision in sources: worker "
+                        f"{wk[0]} incarnation {wk[1]} appears twice")
+                ids.add(wk)
+            if not isinstance(s.get("offset"), (int, float)):
+                failures.append(f"source {s['src']!r} has no numeric offset")
+    prev_t = None
+    for i, e in enumerate(events, 2):
+        t = e.get("t")
+        if not isinstance(t, (int, float)) or isinstance(t, bool):
+            failures.append(f"line {i}: missing/non-numeric 't': {e!r}")
+        elif prev_t is not None and t < prev_t:
+            failures.append(
+                f"line {i}: timestamp {t} decreases (prev {prev_t})")
+        else:
+            prev_t = t
+        if e.get("kind") not in _KNOWN_KINDS:
+            failures.append(f"line {i}: unknown event kind {e.get('kind')!r}")
+        if not isinstance(e.get("src"), str) or (
+                srcs and e.get("src") not in srcs):
+            failures.append(
+                f"line {i}: src {e.get('src')!r} not declared in header "
+                f"sources")
+        if "step" in e and not isinstance(e["step"], int):
+            failures.append(f"line {i}: non-int step {e['step']!r}")
+    return failures

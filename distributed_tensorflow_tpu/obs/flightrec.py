@@ -91,10 +91,19 @@ EVENT_KINDS = (
     "fleet_worker_dead",    # liveness/exit failure         {worker, cause, detail}
     "fleet_gang_stop",      # gang torn down                {cause, survivors, killed}
     "fleet_restart",        # new gang live after restart   {restart, cause, incarnation}
-    "fleet_shrink",         # elastic shrink released       {worker, world, barrier, cause}
-    "fleet_rejoin",         # replacement rejoined the gang {worker, world, barrier}
+    "fleet_hold",           # resize hold plan written      {version, hold, resize}
+    "fleet_shrink",         # elastic shrink released       {worker, world, barrier, cause, version}
+    "fleet_rejoin",         # replacement rejoined the gang {worker, world, barrier, version}
     "fleet_exhausted",      # fleet restart budget ran out  {cause, restarts}
     "fleet_done",           # every worker finished         {incarnation}
+    # elastic worker client (resilience/fleet.ElasticWorker) — the
+    # worker-side half of the resize handshake, the clock anchors the
+    # merged cross-worker timeline aligns on (obs/fleetview.py)
+    "elastic_hold",         # worker paused at a resize barrier {step, version}
+    "elastic_release",      # worker applied a steady plan  {version, world, barrier, rank}
+    # fleet telemetry snapshots (obs/fleetview.py)
+    "fleetsnap_export",     # worker exported a snapshot    {seq, worker}
+    "fleetsnap_merge",      # fleet folded a new snapshot   {worker, seq, pid, incarnation}
     # serving (serve/scheduler.py, serve/engine.py)
     "serve_admit",          # request placed into a slot    {uid, slot}
     "serve_evict",          # request left (any reason)     {uid, reason}
@@ -181,15 +190,20 @@ class FlightRecorder:
 
     # -- dump --------------------------------------------------------------
 
-    def dump(self, path: str, reason: str = "") -> str:
+    def dump(self, path: str, reason: str = "",
+             extra: Mapping[str, Any] | None = None) -> str:
         """Write the ring as a JSONL postmortem: one header line
         (schema, reason, counts) then one line per event, oldest first.
-        Returns ``path``. Never raises on unserializable attrs — they
-        are repr'd."""
+        ``extra`` adds identity fields to the header (the fleet-merge
+        path stamps ``worker``/``incarnation`` so obs/fleetview.py can
+        pair a dump with its control-plane anchors); core header keys
+        win on collision. Returns ``path``. Never raises on
+        unserializable attrs — they are repr'd."""
         with self._lock:
             events = [dict(e) for e in self._ring]
             dropped = self._dropped
-        header = {
+        header = dict(extra or {})
+        header.update({
             "schema": SCHEMA,
             "reason": reason,
             "dumped_t": float(self.clock()),
@@ -197,7 +211,7 @@ class FlightRecorder:
             "dropped": dropped,
             "capacity": self.capacity,
             "pid": os.getpid(),
-        }
+        })
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
             f.write(json.dumps(header, sort_keys=True, default=repr) + "\n")

@@ -339,7 +339,59 @@ class Registry:
                 }
             else:
                 out[key] = {"kind": m.kind, "value": m.value}
+            if m.labels:
+                # structured identity alongside the flattened key, so a
+                # cross-process consumer (Registry.from_snapshot) never
+                # has to re-parse label values out of the key string
+                out[key]["name"] = m.name
+                out[key]["labels"] = dict(m.labels)
         return out
+
+    @classmethod
+    def from_snapshot(cls, snap: Mapping, labels: Mapping[str, str]
+                      | None = None,
+                      kinds: Iterable[str] | None = None) -> "Registry":
+        """Reconstruct a Registry from a ``snapshot()`` dict — the
+        cross-PROCESS half of the merge contract: a worker ships its
+        snapshot as JSON (obs/fleetview.py), the fleet rebuilds it here
+        and folds it with ``merge()``, so fleet-wide percentiles come
+        from summed buckets, never from averaged percentiles.
+
+        ``labels`` are added to every metric (the fleet's ``worker=``
+        convention; they override same-named labels from the snapshot).
+        ``kinds`` restricts reconstruction (e.g. ``("counter",
+        "histogram")`` for a fleet-wide union, where summing is exact
+        but a "latest" gauge across processes is meaningless). Raises
+        ``ValueError`` on a malformed snapshot."""
+        reg = cls()
+        extra = {str(k): str(v) for k, v in (labels or {}).items()}
+        for key, entry in snap.items():
+            try:
+                kind = entry["kind"]
+                if kinds is not None and kind not in kinds:
+                    continue
+                name = entry.get("name") or key.partition("{")[0]
+                lab = dict(entry.get("labels") or {})
+                lab.update(extra)
+                if kind == "counter":
+                    reg.counter(name, **lab).inc(float(entry["value"]))
+                elif kind == "gauge":
+                    reg.gauge(name, **lab).set(float(entry["value"]))
+                elif kind == "histogram":
+                    h = reg.histogram(name, buckets=entry["bounds"], **lab)
+                    counts = entry["counts"]
+                    if len(counts) != len(h.counts):
+                        raise ValueError(
+                            f"{len(counts)} counts for "
+                            f"{len(h.bounds)} bounds")
+                    h.counts[:] = np.asarray(counts, np.int64)
+                    h.sum = float(entry["sum"])
+                else:
+                    raise ValueError(f"unknown metric kind {kind!r}")
+            except (KeyError, TypeError, ValueError) as e:
+                raise ValueError(
+                    f"malformed snapshot entry {key!r}: {e}") from e
+        return reg
 
     def delta(self, baseline: Mapping) -> dict:
         """What changed since ``baseline`` (a dict from ``snapshot()``),

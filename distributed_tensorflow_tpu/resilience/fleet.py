@@ -64,6 +64,7 @@ import threading
 import time
 from typing import Any, Callable, Sequence
 
+from ..obs import fleetview as fleetview_lib
 from ..obs import flightrec as flightrec_lib
 from ..obs import goodput
 from ..obs.flightrec import FlightRecorder
@@ -691,7 +692,8 @@ class ElasticWorker:
                  | None = None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep,
-                 poll_s: float = 0.05, hold_timeout_s: float = 120.0):
+                 poll_s: float = 0.05, hold_timeout_s: float = 120.0,
+                 flightrec: FlightRecorder | None = None):
         if poll_s <= 0 or hold_timeout_s <= 0:
             raise ValueError("poll_s and hold_timeout_s must be positive")
         self.fleet_dir = fleet_dir
@@ -702,6 +704,11 @@ class ElasticWorker:
         self.sleep = sleep
         self.poll_s = poll_s
         self.hold_timeout_s = hold_timeout_s
+        #: worker-side half of the resize handshake in the causal record
+        #: (elastic_hold / elastic_release — the clock anchors the merged
+        #: cross-worker timeline aligns on, obs/fleetview.py)
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
         #: newest plan version applied (or held at)
         self.applied_version = 0
         #: (rank | None, world) from the newest applied steady plan
@@ -733,6 +740,12 @@ class ElasticWorker:
             # otherwise stick forever and force every later death down
             # the mid-checkpoint gang-stop path
             prev_phase = "train"
+        # emitted AFTER reading the hold plan (the fleet wrote it first)
+        # and BEFORE the barrier beat makes the ack observable: the
+        # fleet's release therefore strictly follows this event — both
+        # sides of the merged timeline's hold anchor hold by
+        # construction, never by racing the fleet's heartbeat poll
+        self.flightrec.emit("elastic_hold", step=step, version=plan.version)
         self.writer.beat(step=step, phase="barrier")
         logger.warning("elastic: worker %d holding at step %s for resize "
                        "(plan v%d)", self.worker, step, plan.version)
@@ -758,6 +771,9 @@ class ElasticWorker:
         rank = plan.ranks.get(self.worker)
         self.assignment = (rank, plan.world)
         self.writer.note_plan(plan.version, plan.world)
+        self.flightrec.emit("elastic_release", version=plan.version,
+                            world=plan.world, barrier=plan.barrier_step,
+                            rank=rank)
         if self.on_reshard is not None:
             self.on_reshard(rank, plan.world, plan.barrier_step)
         logger.info("elastic: worker %d applied plan v%d (rank %s of %d, "
@@ -811,6 +827,12 @@ class FleetConfig:
     #: budget for every member to reach (and be released from) a resize
     #: barrier; an overrun falls back to the gang-stop path
     hold_timeout_s: float = 60.0
+    #: fleet-observatory cadence (obs/fleetview.py): every this many
+    #: seconds the supervisor folds the workers' telemetry snapshots
+    #: into the merged fleet view (fleet_goodput_fraction, per-worker
+    #: staleness gauges, fleetsnap_merge timeline anchors). None
+    #: disables aggregation (workers may still export).
+    snapshot_poll_s: float | None = None
 
     def __post_init__(self):
         if self.max_restarts < 0:
@@ -834,6 +856,10 @@ class FleetConfig:
                 f"hold_timeout_s must be > 0 (members must be released "
                 f"from a barrier or the gang falls back), got "
                 f"{self.hold_timeout_s}")
+        if self.snapshot_poll_s is not None and self.snapshot_poll_s <= 0:
+            raise ValueError(
+                f"snapshot_poll_s must be > 0 when set (None disables "
+                f"aggregation), got {self.snapshot_poll_s}")
 
 
 @dataclasses.dataclass
@@ -934,6 +960,14 @@ class FleetSupervisor:
         self._m_size = self.registry.gauge(
             FLEET_SIZE, "current gang size (members sharing the data "
             "stream; drops on an elastic shrink, recovers on rejoin)")
+        #: fleet observatory (obs/fleetview.py): merged per-worker
+        #: telemetry view, rebuilt every cfg.snapshot_poll_s
+        self.aggregator: fleetview_lib.FleetAggregator | None = None
+        self._t_agg: float | None = None
+        if cfg.snapshot_poll_s is not None:
+            self.aggregator = fleetview_lib.FleetAggregator(
+                self.workdir, range(num_workers), registry=self.registry,
+                flightrec=self.flightrec, clock=self.clock)
 
     # -- interruptible waiting --------------------------------------------
 
@@ -1024,13 +1058,18 @@ class FleetSupervisor:
                 self._wait(self.cfg.poll_s)
                 if self._stop_signal:
                     self._preempted_teardown()
+                self._maybe_aggregate()
                 failure = self._poll_round(pending_restart, relayed)
                 pending_restart, relayed, failed = failure
                 if failed is not None:
                     worker, cause, detail = failed
                     self._m_deaths.inc()
-                    self.flightrec.emit("fleet_worker_dead", worker=worker,
-                                        cause=cause, detail=detail[:200])
+                    self.flightrec.emit(
+                        "fleet_worker_dead", worker=worker, cause=cause,
+                        detail=detail[:200],
+                        incarnation=self.incarnation,
+                        pid=getattr(self._workers[worker].handle, "pid",
+                                    None))
                     logger.error("fleet: worker %d dead [%s]: %s",
                                  worker, cause, detail)
                     if self._absorb_elastically(
@@ -1051,6 +1090,12 @@ class FleetSupervisor:
                         continue
                 if (self._resize is None
                         and all(w.done for w in self._workers)):
+                    if self.aggregator is not None:
+                        # fold the workers' final snapshots before the
+                        # fleet_done marker: the merged view's last state
+                        # covers the whole run, and every final
+                        # fleetsnap_merge anchor precedes fleet_done
+                        self.aggregator.poll()
                     self.flightrec.emit("fleet_done",
                                         incarnation=self.incarnation)
                     logger.info("fleet: all %d workers done (incarnation %d,"
@@ -1083,6 +1128,17 @@ class FleetSupervisor:
 
     # -- one poll round ----------------------------------------------------
 
+    def _maybe_aggregate(self) -> None:
+        """Fold worker telemetry snapshots on the cfg.snapshot_poll_s
+        cadence (no-op when aggregation is disabled)."""
+        if self.aggregator is None:
+            return
+        now = self.clock()
+        if self._t_agg is None \
+                or now - self._t_agg >= self.cfg.snapshot_poll_s:
+            self._t_agg = now
+            self.aggregator.poll()
+
     def _poll_round(
         self, pending_restart: tuple[int, str] | None, relayed: bool,
     ) -> tuple[tuple[int, str] | None, bool,
@@ -1105,7 +1161,7 @@ class FleetSupervisor:
                 self.flightrec.emit(
                     "ckpt_restore", step=hb.restore_step,
                     fallback=bool(hb.restore_fallback), worker=w.index,
-                    relayed=True)
+                    relayed=True, incarnation=self.incarnation)
                 relayed = True
             if rc is not None:
                 w.exit_code = rc
@@ -1373,9 +1429,15 @@ class FleetSupervisor:
             "worker": w.index, "cause": cause, "hold": hold,
             "version": self._plan.version + 1,
         }
-        self._write_plan(dataclasses.replace(
+        plan = dataclasses.replace(
             self._plan, version=self._plan.version + 1, phase=PLAN_HOLD,
-            hold=hold))
+            hold=hold)
+        # anchor BEFORE the plan write: a holder's elastic_hold can only
+        # follow its read of the plan file, so this event strictly
+        # precedes it — the hold anchor of the merged timeline
+        self.flightrec.emit("fleet_hold", version=plan.version,
+                            hold=list(hold), resize="shrink")
+        self._write_plan(plan)
         logger.warning(
             "elastic: shrink begun — worker %d out, holding %s at the "
             "next step boundary (plan v%d)", w.index, list(hold),
@@ -1494,9 +1556,13 @@ class FleetSupervisor:
         }
         self._resize = st
         if holders:
-            self._write_plan(dataclasses.replace(
+            plan = dataclasses.replace(
                 self._plan, version=self._plan.version + 1, phase=PLAN_HOLD,
-                hold=holders))
+                hold=holders)
+            # anchor BEFORE the plan write (see _begin_shrink)
+            self.flightrec.emit("fleet_hold", version=plan.version,
+                                hold=list(holders), resize="rejoin")
+            self._write_plan(plan)
             logger.warning("elastic: rejoin begun — worker %d back, "
                            "holding %s (plan v%d)", joiner.index,
                            list(holders), self._plan.version)
@@ -1526,6 +1592,18 @@ class FleetSupervisor:
             ranks={idx: r for r, idx in enumerate(members)},
             barrier_step=barrier, incarnation=self.incarnation,
             fleet_size=self.num_workers)
+        # release anchor BEFORE the plan write: a worker's
+        # elastic_release can only follow its read of the steady plan,
+        # so this event strictly precedes every post-barrier reshard
+        if st["kind"] == "shrink":
+            self.flightrec.emit("fleet_shrink", worker=st["worker"],
+                                world=plan.world, barrier=barrier,
+                                cause=st["cause"], version=plan.version)
+        else:
+            self._workers[st["worker"]].member = True
+            self.flightrec.emit("fleet_rejoin", worker=st["worker"],
+                                world=plan.world, barrier=barrier,
+                                version=plan.version)
         self._write_plan(plan)
         st["stage"], st["version"] = "released", plan.version
         self.resizes += 1
@@ -1534,14 +1612,6 @@ class FleetSupervisor:
             direction=st["kind"],
         ).inc()
         self._m_size.set(plan.world)
-        if st["kind"] == "shrink":
-            self.flightrec.emit("fleet_shrink", worker=st["worker"],
-                                world=plan.world, barrier=barrier,
-                                cause=st["cause"])
-        else:
-            self._workers[st["worker"]].member = True
-            self.flightrec.emit("fleet_rejoin", worker=st["worker"],
-                                world=plan.world, barrier=barrier)
         logger.warning("elastic: %s released at barrier step %d "
                        "(world %d, plan v%d)", st["kind"], barrier,
                        plan.world, plan.version)

@@ -126,6 +126,42 @@ class ElasticCallback(Callback):
         self._poll(trainer, step)
 
 
+class FleetSnapshotCallback(Callback):
+    """Step-seam driver for the fleet-observatory snapshot exporter
+    (obs/fleetview.SnapshotExporter): after every ``every_n``-th step
+    the worker's telemetry snapshot — registry dump + flight-recorder
+    tail — is atomically rewritten next to its heartbeat, where the
+    ``FleetSupervisor``'s aggregator (and ``tools/fleet_top.py``) folds
+    it into the fleet-wide view. Pure host file IO on the exporter's
+    injectable clock; best-effort by design — a full disk must degrade
+    the fleet view, never kill the step that was about to be trained.
+    The final export on ``on_train_end`` bypasses the exporter's rate
+    limit so the run's last state always lands."""
+
+    def __init__(self, exporter, every_n: int = 1):
+        if every_n < 1:
+            raise ValueError("every_n must be >= 1")
+        self.exporter = exporter
+        self.every_n = every_n
+
+    def _export(self, step: int | None, force: bool = False) -> None:
+        try:
+            self.exporter.export(step=step, phase="train", force=force)
+        except OSError:
+            logger.warning("fleet telemetry snapshot export failed",
+                           exc_info=True)
+
+    def on_train_start(self, trainer):
+        self._export(int(trainer.state.step))
+
+    def on_step_end(self, trainer, step, metrics):
+        if step % self.every_n == 0:
+            self._export(step)
+
+    def on_train_end(self, trainer):
+        self._export(int(trainer.state.step), force=True)
+
+
 class StopAtStep(Callback):
     """$TF basic_session_run_hooks.py:393 StopAtStepHook."""
 

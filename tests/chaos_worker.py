@@ -341,11 +341,40 @@ def _fleet(args, mesh, model, tx) -> int:
 
             time.sleep(self.seconds)
 
+    from distributed_tensorflow_tpu.obs import fleetview, flightrec as fr
+
     incarnation = fleet_lib.read_incarnation(args.fleet_dir)
     writer = fleet_lib.HeartbeatWriter(
         fleet_lib.heartbeat_path(args.fleet_dir, args.worker_index),
         incarnation=incarnation,
     )
+    # fleet observatory (obs/fleetview.py): periodic telemetry snapshots
+    # next to the heartbeat, and a flight-recorder dump on every exit
+    # path — identity-stamped so postmortem.py --merge can align this
+    # process's clock with the fleet's
+    exporter = fleetview.SnapshotExporter(
+        fleetview.fleetsnap_path(args.fleet_dir, args.worker_index),
+        worker=args.worker_index, incarnation=incarnation)
+
+    def dump_flightrec() -> None:
+        if not args.flightrec_dir:
+            return
+        os.makedirs(args.flightrec_dir, exist_ok=True)
+        base = os.path.join(
+            args.flightrec_dir,
+            f"flightrec-w{args.worker_index}i{incarnation}")
+        # never clobber: an elastic replacement reuses (worker,
+        # incarnation), and overwriting would destroy the dead
+        # process's dump — the one artifact the merge exists to
+        # explain. Two dumps for one slot make the merge fail LOUDLY
+        # with a label collision instead, which is the truthful outcome.
+        path, n = f"{base}.jsonl", 0
+        while os.path.exists(path):
+            n += 1
+            path = f"{base}-{n}.jsonl"
+        fr.default_recorder().dump(
+            path, reason="fleet_worker_exit",
+            extra={"worker": args.worker_index, "incarnation": incarnation})
     ceiling = fleet_lib.read_restore_step(args.fleet_dir)
     elastic_client = None
     if args.elastic:
@@ -422,7 +451,12 @@ def _fleet(args, mesh, model, tx) -> int:
         # later callback for that step), and before the fault callback
         # can hang the loop; the elastic poll sits between heartbeat and
         # checkpoint so a resize hold lands between steps
-        callbacks = [cb.HeartbeatCallback(writer)]
+        # telemetry BEFORE the snapshot export so each snapshot already
+        # carries the step it was cut at; heartbeat stays first (it must
+        # record the step even when a later callback raises)
+        callbacks = [cb.HeartbeatCallback(writer),
+                     cb.TelemetryCallback(every_n=10 ** 6),
+                     cb.FleetSnapshotCallback(exporter)]
         if elastic_client is not None:
             callbacks.append(cb.ElasticCallback(elastic_client))
         callbacks += [cb.CheckpointCallback(ckpt), plan.callback()]
@@ -451,6 +485,7 @@ def _fleet(args, mesh, model, tx) -> int:
         state = sup.run()
     except SupervisorExhausted as e:
         writer.finish("failed", cause=e.cause)
+        dump_flightrec()
         print(f"FLEET-FAILED cause={e.cause}", flush=True)
         return fleet_lib.EXIT_FAILED
     except BaseException as e:
@@ -465,10 +500,12 @@ def _fleet(args, mesh, model, tx) -> int:
         traceback.print_exc()
         cause = classify_failure(e)
         writer.finish("failed", cause=cause)
+        dump_flightrec()
         print(f"FLEET-FAILED cause={cause}", flush=True)
         return fleet_lib.EXIT_FAILED
     if int(state.step) < args.steps:
         writer.finish("preempted")
+        dump_flightrec()
         print(f"FLEET-PREEMPTED step={int(state.step)}", flush=True)
         return fleet_lib.EXIT_PREEMPTED
     if args.out:
@@ -476,6 +513,7 @@ def _fleet(args, mesh, model, tx) -> int:
         np.savez(args.out, **{f"p{i}": np.asarray(x)
                               for i, x in enumerate(leaves)})
     writer.finish("done")
+    dump_flightrec()
     print(f"FLEET-DONE step={int(state.step)} incarnation={incarnation} "
           f"restarts={sup.restarts}", flush=True)
     return 0
@@ -542,6 +580,11 @@ def main(argv=None) -> int:
     ap.add_argument("--step-sleep", type=float, default=0.0,
                     help="fleet mode: sleep this long after every step "
                          "(pacing for real-subprocess elastic rounds)")
+    ap.add_argument("--flightrec-dir", default=None,
+                    help="fleet mode: dump the flight recorder as "
+                         "flightrec-w<i>i<incarnation>.jsonl into this "
+                         "dir on every exit path (postmortem --merge "
+                         "input)")
     args = ap.parse_args(argv)
     if args.fleet and not args.fleet_dir:
         raise SystemExit("--fleet requires --fleet-dir")

@@ -1,0 +1,603 @@
+"""Fleet observatory (obs/fleetview.py): snapshot export crash-safety,
+Registry.from_snapshot reconstruction, merge-not-average aggregation —
+including the proof that fleet-merged histogram p99 equals the p99 of
+the union stream (to bucket resolution) while averaging per-worker p99s
+provably does not — own-clock staleness, and the causally merged
+cross-worker timeline with its anchor must-fail cases."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.obs import fleetview as fv
+from distributed_tensorflow_tpu.obs import flightrec as fr
+from distributed_tensorflow_tpu.obs import goodput
+from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+from distributed_tensorflow_tpu.obs.registry import Registry
+from distributed_tensorflow_tpu.resilience import FaultClock
+
+
+# ---------------------------------------------------------------------------
+# Registry.from_snapshot — the cross-process half of the merge contract
+# ---------------------------------------------------------------------------
+
+
+def _sample_registry() -> Registry:
+    r = Registry()
+    r.counter("c_total", "plain").inc(3)
+    r.counter("family_total", "labeled", cause="x").inc(2)
+    r.counter("family_total", "labeled", cause="y").inc(5)
+    r.gauge("g", "gauge").set(0.5)
+    h = r.histogram("h_seconds", "seconds")
+    for v in (1e-3, 2e-3, 5.0):
+        h.observe(v)
+    return r
+
+
+def test_from_snapshot_roundtrips_exactly():
+    r = _sample_registry()
+    snap = r.snapshot()
+    # through JSON, as the fleet actually receives it
+    back = Registry.from_snapshot(json.loads(json.dumps(snap)))
+    assert back.snapshot() == snap
+
+
+def test_from_snapshot_adds_labels_and_filters_kinds():
+    r = _sample_registry()
+    snap = r.snapshot()
+    back = Registry.from_snapshot(snap, labels={"worker": "3"})
+    assert back.get("c_total", worker="3").value == 3
+    assert back.get("family_total", cause="x", worker="3").value == 2
+    assert back.get("h_seconds", worker="3").count == 3
+    only = Registry.from_snapshot(snap, kinds=("counter", "histogram"))
+    assert only.get("g") is None
+    assert only.get("c_total").value == 3
+
+
+def test_from_snapshot_rejects_malformed():
+    with pytest.raises(ValueError, match="malformed snapshot entry"):
+        Registry.from_snapshot({"x": {"kind": "counter"}})  # no value
+    with pytest.raises(ValueError, match="malformed snapshot entry"):
+        Registry.from_snapshot(
+            {"h": {"kind": "histogram", "bounds": [1.0, 2.0],
+                   "counts": [1, 2], "sum": 3.0}})  # counts != bounds+1
+    with pytest.raises(ValueError, match="unknown metric kind"):
+        Registry.from_snapshot({"x": {"kind": "summary", "value": 1}})
+
+
+# ---------------------------------------------------------------------------
+# THE aggregation-soundness claim: merged p99 == union p99, != avg of p99s
+# ---------------------------------------------------------------------------
+
+
+def test_merged_histogram_p99_is_union_p99_not_average_of_p99s():
+    """docs/observability.md promises: "a fleet aggregator that merges
+    per-host snapshots and takes p99 gets the true fleet p99 (to bucket
+    resolution), which averaging per-host p99s can never give". Prove
+    both halves: (a) the merged histogram's percentile is EXACTLY the
+    percentile of a histogram fed the union stream (same buckets →
+    identical counts → identical read-back, no extra resolution loss),
+    and (b) it is within one bucket ratio of the true union quantile,
+    while the average of per-worker p99s is off by far more than one
+    bucket ratio on a skewed fleet."""
+    fast = [1e-3] * 99 + [10.0]          # worker 0: fast, one straggler
+    slow = [10.0] * 99 + [1e-3]          # worker 1: slow, one fast
+    regs = []
+    for values in (fast, slow):
+        r = Registry()
+        h = r.histogram("train_step_seconds", "seconds")
+        for v in values:
+            h.observe(v)
+        regs.append(r)
+
+    merged = Registry()
+    for r in regs:
+        merged.merge(Registry.from_snapshot(
+            json.loads(json.dumps(r.snapshot()))))
+    union = Registry()
+    hu = union.histogram("train_step_seconds", "seconds")
+    for v in fast + slow:
+        hu.observe(v)
+
+    hm = merged.get("train_step_seconds")
+    assert hm.counts.tolist() == hu.counts.tolist()
+    assert hm.percentile(0.99) == hu.percentile(0.99)  # exact, not approx
+
+    bucket_ratio = 10 ** (1 / 8)  # LATENCY_BUCKETS: 8 buckets/decade
+    true_p99 = float(np.quantile(np.asarray(fast + slow), 0.99))
+    assert true_p99 / bucket_ratio <= hm.percentile(0.99) \
+        <= true_p99 * bucket_ratio
+
+    avg_of_p99s = float(np.mean(
+        [r.get("train_step_seconds").percentile(0.99) for r in regs]))
+    # ~ (0.001 + 10) / 2 ≈ 5 vs a true p99 of 10: off by ~2x, far past
+    # one bucket ratio (~1.33) — averaging percentiles is not a quantile
+    assert avg_of_p99s < true_p99 / (bucket_ratio ** 2)
+
+
+# ---------------------------------------------------------------------------
+# Snapshot exporter: schema, rate limit, crash safety
+# ---------------------------------------------------------------------------
+
+
+def _exporter(tmp_path, clk, **kw):
+    reg = Registry()
+    rec = FlightRecorder(clock=clk)
+    exp = fv.SnapshotExporter(
+        fv.fleetsnap_path(str(tmp_path), 0), worker=0, incarnation=2,
+        registry=reg, flightrec=rec, clock=clk, **kw)
+    return exp, reg, rec
+
+
+def test_exporter_writes_valid_schema_and_counts(tmp_path):
+    clk = FaultClock(7.0)
+    exp, reg, rec = _exporter(tmp_path, clk)
+    rec.emit("train_start", step=0)
+    path = exp.export(step=4, phase="train")
+    snap = fv.read_snapshot(path)
+    assert fv.validate_snapshot(snap, expect_worker=0) == []
+    assert (snap["worker"], snap["incarnation"], snap["seq"]) == (0, 2, 1)
+    assert snap["step"] == 4 and snap["t"] == 7.0
+    assert snap["registry"][
+        'fleetsnap_exports_total{worker=0}']["value"] == 1
+    kinds = [e["kind"] for e in snap["flightrec_tail"]]
+    assert kinds == ["train_start", "fleetsnap_export"]
+    assert not os.path.exists(path + ".tmp")  # atomic: tmp never lingers
+
+
+def test_exporter_rate_limit_on_injected_clock(tmp_path):
+    clk = FaultClock()
+    exp, _, _ = _exporter(tmp_path, clk, min_interval_s=10.0)
+    assert exp.export(step=1) is not None
+    assert exp.export(step=2) is None          # inside the window
+    assert exp.export(step=2, force=True) is not None  # bypass
+    clk.advance(11.0)
+    assert exp.export(step=3) is not None
+    snap = fv.read_snapshot(fv.fleetsnap_path(str(tmp_path), 0))
+    assert snap["seq"] == 3 and snap["step"] == 3
+
+
+def test_kill_mid_export_leaves_previous_snapshot_readable(
+        tmp_path, monkeypatch):
+    """Regression for the crash-safety contract: a worker killed between
+    writing the tmp sibling and the rename must leave the PREVIOUS
+    snapshot intact and readable — simulated by making os.replace die
+    exactly once, after the tmp file is fully written."""
+    clk = FaultClock()
+    exp, _, _ = _exporter(tmp_path, clk)
+    path = exp.export(step=1)
+    real_replace = os.replace
+
+    def killed(src, dst):
+        raise OSError("killed mid-export")
+
+    monkeypatch.setattr(os, "replace", killed)
+    with pytest.raises(OSError, match="killed mid-export"):
+        exp.export(step=2, force=True)
+    monkeypatch.setattr(os, "replace", real_replace)
+    # the torn attempt left a .tmp; the published snapshot is still v1
+    snap = fv.read_snapshot(path)
+    assert fv.validate_snapshot(snap, expect_worker=0) == []
+    assert snap["seq"] == 1 and snap["step"] == 1
+    # and the next export recovers, replacing atomically over the corpse
+    exp.export(step=3, force=True)
+    assert fv.read_snapshot(path)["step"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Fleet aggregator: merge-not-average, rebuild-not-accumulate, staleness
+# ---------------------------------------------------------------------------
+
+
+def _worker_snapshot(fleet_dir, worker, clk, productive, wasted,
+                     incarnation=1):
+    reg = Registry()
+    goodput.note_productive(productive, registry=reg)
+    goodput.note_wasted(goodput.WASTE_COMPILE_WARMUP, wasted, registry=reg)
+    rec = FlightRecorder(clock=clk)
+    exp = fv.SnapshotExporter(
+        fv.fleetsnap_path(fleet_dir, worker), worker=worker,
+        incarnation=incarnation, registry=reg, flightrec=rec, clock=clk)
+    exp.export(step=1, phase="train")
+    return exp
+
+
+def test_aggregator_goodput_is_merged_not_averaged(tmp_path):
+    """worker 0: 9s productive / 1s wasted (0.9); worker 1: 1s / 3s
+    (0.25). The merged fraction is 10/14 ≈ 0.714 — the average of
+    fractions (0.575) would weight a 4-second trajectory like a
+    10-second one."""
+    d = str(tmp_path)
+    clk = FaultClock()
+    _worker_snapshot(d, 0, clk, productive=9.0, wasted=1.0)
+    _worker_snapshot(d, 1, clk, productive=1.0, wasted=3.0)
+    freg, frec = Registry(), FlightRecorder(clock=clk)
+    agg = fv.FleetAggregator(d, [0, 1], registry=freg, flightrec=frec,
+                             clock=clk)
+    view = agg.poll()
+    frac = freg.get(fv.FLEET_GOODPUT_FRACTION)
+    assert frac is not None
+    assert abs(frac.value - 10.0 / 14.0) < 1e-9
+    assert abs(view.get(fv.FLEET_GOODPUT_FRACTION).value
+               - 10.0 / 14.0) < 1e-9
+    # per-worker labeled copies AND the unlabeled union coexist
+    assert view.get(goodput.PRODUCTIVE_SECONDS, worker="0").value == 9.0
+    assert view.get(goodput.PRODUCTIVE_SECONDS).value == 10.0
+    # gauges never union: worker-labeled only
+    assert view.get(goodput.GOODPUT_FRACTION, worker="0") is not None
+    assert view.get(goodput.GOODPUT_FRACTION) is None
+    # regression: a metric ALREADY worker-labeled in the worker's own
+    # registry (the exporter's export counter) must appear in the view
+    # exactly once — its relabeled copy and the union land on the same
+    # key, so naive double-merging would report 2x
+    assert view.get(fv.FLEETSNAP_EXPORTS_TOTAL, worker="0").value == 1.0
+
+
+def test_aggregator_rebuilds_instead_of_accumulating(tmp_path):
+    """Polling the SAME snapshot twice must not double the union
+    counters — the view is rebuilt from the current files, never folded
+    into an accumulating registry."""
+    d = str(tmp_path)
+    clk = FaultClock()
+    _worker_snapshot(d, 0, clk, productive=5.0, wasted=0.0)
+    agg = fv.FleetAggregator(d, [0], registry=Registry(),
+                             flightrec=FlightRecorder(clock=clk), clock=clk)
+    v1 = agg.poll()
+    clk.advance(1.0)
+    v2 = agg.poll()
+    assert v1.get(goodput.PRODUCTIVE_SECONDS).value == 5.0
+    assert v2.get(goodput.PRODUCTIVE_SECONDS).value == 5.0
+
+
+def test_aggregator_staleness_on_own_clock_and_merge_events(tmp_path):
+    d = str(tmp_path)
+    wclk = FaultClock(100.0)  # worker clock: unrelated to the fleet's
+    exp = _worker_snapshot(d, 0, wclk, productive=1.0, wasted=0.0)
+    fclk = FaultClock()
+    freg, frec = Registry(), FlightRecorder(clock=fclk)
+    agg = fv.FleetAggregator(d, [0], registry=freg, flightrec=frec,
+                             clock=fclk)
+    agg.poll()
+    assert freg.get(fv.FLEET_WORKER_STALENESS, worker="0").value == 0.0
+    assert freg.get(fv.FLEETSNAP_MERGES_TOTAL, worker="0").value == 1
+    # no new export: staleness grows on the AGGREGATOR's clock, and no
+    # new fleetsnap_merge is emitted for a seq already observed
+    fclk.advance(30.0)
+    agg.poll()
+    assert freg.get(fv.FLEET_WORKER_STALENESS, worker="0").value == 30.0
+    assert freg.get(fv.FLEETSNAP_MERGES_TOTAL, worker="0").value == 1
+    # a fresh export resets staleness and emits the next anchor
+    exp.export(step=2, force=True)
+    fclk.advance(5.0)
+    agg.poll()
+    assert freg.get(fv.FLEET_WORKER_STALENESS, worker="0").value == 0.0
+    assert freg.get(fv.FLEETSNAP_MERGES_TOTAL, worker="0").value == 2
+    merges = [e for e in frec.events() if e["kind"] == "fleetsnap_merge"]
+    assert [e["seq"] for e in merges] == [1, 2]
+    assert all(e["worker"] == 0 and e["pid"] == os.getpid()
+               for e in merges)
+
+
+def test_aggregator_rejects_label_collision_snapshot(tmp_path):
+    """A snapshot claiming another worker's index under this worker's
+    path is a label collision and must not enter the merged view."""
+    d = str(tmp_path)
+    clk = FaultClock()
+    _worker_snapshot(d, 0, clk, productive=1.0, wasted=0.0)
+    # worker 1's slot holds a snapshot claiming worker 0
+    os.replace(fv.fleetsnap_path(d, 0), fv.fleetsnap_path(d, 1))
+    agg = fv.FleetAggregator(d, [1], registry=Registry(),
+                             flightrec=FlightRecorder(clock=clk), clock=clk)
+    view = agg.poll()
+    assert view.get(goodput.PRODUCTIVE_SECONDS) is None
+    assert agg.status == {}
+
+
+# ---------------------------------------------------------------------------
+# FleetSnapshotCallback (train/callbacks.py) — step-seam driver
+# ---------------------------------------------------------------------------
+
+
+class _FakeExporter:
+    def __init__(self, fail=False):
+        self.calls = []
+        self.fail = fail
+
+    def export(self, step=None, phase=None, force=False):
+        self.calls.append((step, force))
+        if self.fail:
+            raise OSError("disk full")
+        return "path"
+
+
+class _FakeTrainer:
+    class state:
+        step = 7
+
+
+def test_fleet_snapshot_callback_cadence_and_best_effort():
+    from distributed_tensorflow_tpu.train import callbacks as cb
+
+    exp = _FakeExporter()
+    c = cb.FleetSnapshotCallback(exp, every_n=2)
+    c.on_train_start(_FakeTrainer())
+    for step in (1, 2, 3, 4):
+        c.on_step_end(_FakeTrainer(), step, {})
+    c.on_train_end(_FakeTrainer())
+    assert exp.calls == [(7, False), (2, False), (4, False), (7, True)]
+    # an export failure is logged, never raised into the step
+    failing = cb.FleetSnapshotCallback(_FakeExporter(fail=True))
+    failing.on_step_end(_FakeTrainer(), 1, {})
+    with pytest.raises(ValueError):
+        cb.FleetSnapshotCallback(exp, every_n=0)
+
+
+# ---------------------------------------------------------------------------
+# Merged cross-worker timelines: anchors, shifts, must-fails
+# ---------------------------------------------------------------------------
+
+
+def _dump_recorder(path, rec, **extra):
+    return rec.dump(path, reason="test", extra=extra or None)
+
+
+def test_merge_shifts_worker_events_onto_fleet_clock(tmp_path):
+    """Worker events anchored by launch land AT the launch and keep
+    their relative spacing; the cross-process causal expectations hold
+    on the merged sequence even though the raw clocks are wildly
+    offset."""
+    pid = os.getpid()
+    fclk = FaultClock(1000.0)
+    frec = FlightRecorder(clock=fclk)
+    frec.emit("fleet_start", workers=1, incarnation=1)
+    fclk.advance(1.0)   # 1001
+    frec.emit("fleet_gang_stop", cause="transient", survivors=1, killed=0)
+    fclk.advance(1.0)   # 1002
+    frec.emit("fleet_launch", worker=0, incarnation=2, pid=pid)
+    fclk.advance(5.0)   # 1007
+    frec.emit("ckpt_restore", step=4, fallback=True, worker=0,
+              relayed=True, incarnation=2)
+    fclk.advance(1.0)   # 1008
+    frec.emit("fleet_restart", restart=1, cause="transient", incarnation=2)
+    fclk.advance(10.0)  # 1018
+    frec.emit("fleet_done", incarnation=2)
+
+    wclk = FaultClock(50.0)  # a clock that shares nothing with the fleet's
+    wrec = FlightRecorder(clock=wclk)
+    wrec.emit("train_start", step=4)
+    wclk.advance(2.0)   # 52
+    wrec.emit("ckpt_restore", step=4, fallback=True)
+    wclk.advance(2.0)   # 54
+    wrec.emit("train_stop", step=8, reason="done")
+
+    fp = _dump_recorder(str(tmp_path / "fleet.jsonl"), frec)
+    wp = _dump_recorder(str(tmp_path / "w0.jsonl"), wrec,
+                        worker=0, incarnation=2)
+    header, events, failures = fv.merge_timelines(fp, [wp])
+    assert failures == []
+    src = {s["src"]: s for s in header["sources"]}
+    # offset = t(launch) - t(first worker event) = 1002 - 50
+    assert src["w0i2"]["offset"] == pytest.approx(952.0)
+    order = [(e["src"], e["kind"]) for e in events]
+    assert order.index(("fleet", "fleet_gang_stop")) \
+        < order.index(("w0i2", "ckpt_restore")) \
+        < order.index(("fleet", "fleet_restart"))
+    # the merged sequence passes the cross-process causal gate
+    assert fr.contains_in_order(events, [
+        ("fleet_gang_stop", {}),
+        ("ckpt_restore", {"src": "w0i2", "fallback": True}),
+        ("fleet_restart", {}), ("fleet_done", {})])
+    out = str(tmp_path / "merged.jsonl")
+    fv.write_merged(out, header, events)
+    assert fv.validate_merged_dump(out) == []
+
+
+def test_merge_elastic_handshake_anchors_force_resize_order(tmp_path):
+    """The hold/release handshake must read causally in the merged
+    timeline: fleet_hold < elastic_hold < fleet_shrink <
+    elastic_release — even when the worker's raw clock would place its
+    events long before the fleet's."""
+    pid = os.getpid()
+    fclk = FaultClock(2000.0)
+    frec = FlightRecorder(clock=fclk)
+    frec.emit("fleet_launch", worker=0, incarnation=1, pid=pid)
+    fclk.advance(4.0)
+    frec.emit("fleet_hold", version=2, hold=[0], resize="shrink")
+    fclk.advance(2.0)
+    frec.emit("fleet_shrink", worker=1, world=1, barrier=3,
+              cause="transient", version=3)
+    fclk.advance(10.0)
+    frec.emit("fleet_done", incarnation=1)
+
+    wclk = FaultClock(10.0)
+    wrec = FlightRecorder(clock=wclk)
+    wrec.emit("train_start", step=0)
+    wclk.advance(1.0)
+    wrec.emit("elastic_release", version=1, world=2, barrier=0, rank=0)
+    wclk.advance(4.0)
+    wrec.emit("elastic_hold", step=3, version=2)
+    wclk.advance(3.0)
+    wrec.emit("elastic_release", version=3, world=1, barrier=3, rank=0)
+    wclk.advance(1.0)
+    wrec.emit("train_stop", step=8, reason="done")
+
+    fp = _dump_recorder(str(tmp_path / "fleet.jsonl"), frec)
+    wp = _dump_recorder(str(tmp_path / "w0.jsonl"), wrec,
+                        worker=0, incarnation=1)
+    header, events, failures = fv.merge_timelines(fp, [wp])
+    assert failures == []
+    assert fr.contains_in_order(events, [
+        ("fleet_hold", {}), ("elastic_hold", {"src": "w0i1"}),
+        ("fleet_shrink", {}),
+        ("elastic_release", {"src": "w0i1", "version": 3})])
+
+
+def test_merge_failure_corpus(tmp_path):
+    """Every unusable-input class is a loud merge failure: missing
+    identity, missing launch anchor, label collision, causally
+    impossible anchors."""
+    pid = os.getpid()
+    fclk = FaultClock(100.0)
+    frec = FlightRecorder(clock=fclk)
+    frec.emit("fleet_launch", worker=0, incarnation=1, pid=pid)
+    fclk.advance(1.0)
+    frec.emit("fleet_done", incarnation=1)
+    fp = _dump_recorder(str(tmp_path / "fleet.jsonl"), frec)
+
+    wclk = FaultClock(10.0)
+    wrec = FlightRecorder(clock=wclk)
+    wrec.emit("train_start", step=0)
+    wclk.advance(30.0)  # 30s of life vs a 1s launch->done window
+    wrec.emit("train_stop", step=8, reason="done")
+    wp = _dump_recorder(str(tmp_path / "w0.jsonl"), wrec,
+                        worker=0, incarnation=1)
+
+    _, _, failures = fv.merge_timelines(fp, [wp])
+    assert any("inconsistent" in f for f in failures), failures
+
+    anon = _dump_recorder(str(tmp_path / "anon.jsonl"), wrec)
+    _, _, failures = fv.merge_timelines(fp, [anon])
+    assert any("identity" in f for f in failures), failures
+
+    other = _dump_recorder(str(tmp_path / "w9.jsonl"), wrec,
+                           worker=9, incarnation=1)
+    _, _, failures = fv.merge_timelines(fp, [other])
+    assert any("anchor missing" in f for f in failures), failures
+
+    _, _, failures = fv.merge_timelines(fp, [wp, wp])
+    assert any("collision" in f for f in failures), failures
+
+
+def test_merge_disambiguates_relaunched_slot_by_pid(tmp_path):
+    """An elastic replacement reuses (worker, incarnation); two
+    fleet_launch events exist for the slot and the dump must anchor on
+    ITS OWN (pid-matched) launch, not the corpse's."""
+    pid = os.getpid()
+    fclk = FaultClock(100.0)
+    frec = FlightRecorder(clock=fclk)
+    frec.emit("fleet_launch", worker=1, incarnation=1, pid=pid + 1)
+    fclk.advance(50.0)  # 150: the replacement launch
+    frec.emit("fleet_launch", worker=1, incarnation=1, pid=pid,
+              rejoin=True)
+    fclk.advance(20.0)
+    frec.emit("fleet_done", incarnation=1)
+    fp = _dump_recorder(str(tmp_path / "fleet.jsonl"), frec)
+
+    wclk = FaultClock(7.0)
+    wrec = FlightRecorder(clock=wclk)
+    wrec.emit("train_start", step=2)
+    wclk.advance(1.0)
+    wrec.emit("train_stop", step=8, reason="done")
+    wp = _dump_recorder(str(tmp_path / "w1.jsonl"), wrec,
+                        worker=1, incarnation=1)
+    header, events, failures = fv.merge_timelines(fp, [wp])
+    assert failures == []
+    src = {s["src"]: s for s in header["sources"]}
+    assert src["w1i1"]["offset"] == pytest.approx(150.0 - 7.0)
+
+
+def test_validate_merged_dump_catches_corruption(tmp_path):
+    pid = os.getpid()
+    fclk = FaultClock(1.0)
+    frec = FlightRecorder(clock=fclk)
+    frec.emit("fleet_launch", worker=0, incarnation=1, pid=pid)
+    fclk.advance(5.0)
+    frec.emit("fleet_done", incarnation=1)
+    fp = _dump_recorder(str(tmp_path / "fleet.jsonl"), frec)
+    wclk = FaultClock(2.0)
+    wrec = FlightRecorder(clock=wclk)
+    wrec.emit("train_start", step=0)
+    wp = _dump_recorder(str(tmp_path / "w0.jsonl"), wrec,
+                        worker=0, incarnation=1)
+    header, events, failures = fv.merge_timelines(fp, [wp])
+    assert failures == []
+    out = str(tmp_path / "merged.jsonl")
+    fv.write_merged(out, header, events)
+    assert fv.validate_merged_dump(out) == []
+
+    def corrupt(mutate, needle):
+        h = json.loads(json.dumps(header))
+        evs = json.loads(json.dumps(events))
+        mutate(h, evs)
+        bad = str(tmp_path / "bad.jsonl")
+        fv.write_merged(bad, h, evs)
+        got = fv.validate_merged_dump(bad)
+        assert any(needle in f for f in got), (needle, got)
+
+    corrupt(lambda h, e: h.update(schema="dtf-fleetmerge-0"), "schema")
+    corrupt(lambda h, e: h.update(events=99), "dump has")
+    corrupt(lambda h, e: e[0].update(t=1e9), "decreases")
+    corrupt(lambda h, e: e[0].update(kind="meteor_strike"), "unknown")
+    corrupt(lambda h, e: e[0].pop("src"), "not declared")
+    corrupt(lambda h, e: h["sources"].append(dict(h["sources"][1])),
+            "collision")
+
+
+# ---------------------------------------------------------------------------
+# FleetSupervisor wiring: the aggregator runs on the fleet's poll loop
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_supervisor_aggregates_snapshots(tmp_path):
+    """Scripted fleet (FakeProc/Scenario idiom from test_fleet.py):
+    with snapshot_poll_s set, the supervisor folds the workers'
+    snapshots mid-run — fleet_goodput_fraction and staleness gauges
+    appear on ITS registry and fleetsnap_merge anchors in ITS ring,
+    all before fleet_done."""
+    from distributed_tensorflow_tpu.resilience import RetryPolicy
+    from distributed_tensorflow_tpu.resilience import fleet as fl
+
+    clk = FaultClock()
+    fleet_dir = str(tmp_path / "fleet")
+    os.makedirs(fleet_dir)
+
+    class FakeProc:
+        pid = 4242
+
+        def __init__(self):
+            self.rc = None
+
+        def poll(self):
+            return self.rc
+
+        def terminate(self):
+            self.rc = fl.EXIT_PREEMPTED
+
+        def kill(self):
+            self.rc = -9
+
+        def wait(self, timeout=None):
+            return self.rc
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        w = fl.HeartbeatWriter(fl.heartbeat_path(fleet_dir, i),
+                               incarnation=incarnation, clock=clk)
+        w.beat(step=8, phase="done")
+        _worker_snapshot(fleet_dir, i, clk, productive=4.0, wasted=1.0,
+                         incarnation=incarnation)
+        p.rc = 0
+        return p
+
+    rec = FlightRecorder(clock=clk)
+    reg = Registry()
+    cfg = fl.FleetConfig(
+        max_restarts=0, backoff=RetryPolicy(base_s=0.0, jitter=0.0),
+        poll_s=1.0, heartbeat_timeout_s=5.0, stall_timeout_s=10.0,
+        launch_grace_s=20.0, term_grace_s=4.0, snapshot_poll_s=1.0)
+    fleet = fl.FleetSupervisor(
+        launch, 2, fleet_dir, cfg, registry=reg, flightrec=rec,
+        clock=clk, sleep=clk.advance)
+    out = fleet.run()
+    assert out["restarts"] == 0
+    frac = reg.get(fv.FLEET_GOODPUT_FRACTION)
+    assert frac is not None and abs(frac.value - 8.0 / 10.0) < 1e-9
+    for i in (0, 1):
+        assert reg.get(fv.FLEET_WORKER_STALENESS, worker=str(i)) is not None
+    kinds = [e["kind"] for e in rec.events()]
+    merge_idx = kinds.index("fleetsnap_merge")
+    assert merge_idx < kinds.index("fleet_done")
+    view = fleet.aggregator.view()
+    assert view.get(goodput.PRODUCTIVE_SECONDS).value == 8.0

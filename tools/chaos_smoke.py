@@ -41,10 +41,20 @@ machinery is wired at all):
    next barrier — zero gang restarts, with `restart_recovery` at least
    10x below the gang-restart baseline (ISSUE 12 acceptance).
 
+The fleet and elastic rounds additionally stage every process's
+flight-recorder dump (plus telemetry snapshots and heartbeats) under
+``artifacts/{fleet,elastic}_dumps/``, merge them into ONE causally
+consistent cross-worker timeline (obs/fleetview.merge_timelines) at
+``artifacts/{fleet,elastic}_merged_postmortem.jsonl``, and assert the
+cross-process causal chains ci_fast re-gates with ``postmortem.py
+--merge --expect`` (ISSUE 15).
+
 Usage: JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 """
 
+import glob
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -53,6 +63,46 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 WORKER = os.path.join(_REPO, "tests", "chaos_worker.py")
+
+
+def _stage_fleet_dumps(fleet_dir: str, dumps_dir: str,
+                       merged_artifact: str, expects,
+                       expected_workers) -> None:
+    """Copy the round's per-process artifacts (fleet + worker
+    flight-recorder dumps, telemetry snapshots, heartbeats) out of the
+    tempdir into ``dumps_dir``, merge them into ONE cross-worker
+    timeline at ``merged_artifact``, and assert every causal
+    expectation — the same chains tools/ci_fast.sh re-gates with
+    ``postmortem.py --merge --expect`` over the staged files."""
+    from distributed_tensorflow_tpu.obs import fleetview as fv
+    from distributed_tensorflow_tpu.obs import flightrec as fr
+
+    shutil.rmtree(dumps_dir, ignore_errors=True)
+    os.makedirs(dumps_dir, exist_ok=True)
+    for pattern in ("fleet.jsonl", "flightrec-*.jsonl", "fleetsnap-*.json",
+                    "heartbeat-*.json"):
+        for src in glob.glob(os.path.join(fleet_dir, pattern)):
+            shutil.copy(src, dumps_dir)
+    worker_dumps = sorted(
+        glob.glob(os.path.join(dumps_dir, "flightrec-*.jsonl")))
+    for src in expected_workers:
+        assert os.path.join(dumps_dir, f"flightrec-{src}.jsonl") \
+            in worker_dumps, (src, worker_dumps)
+    header, events, failures = fv.merge_timelines(
+        os.path.join(dumps_dir, "fleet.jsonl"), worker_dumps,
+        reason="chaos_smoke")
+    assert not failures, failures
+    fv.write_merged(merged_artifact, header, events)
+    assert not fv.validate_merged_dump(merged_artifact)
+    import importlib.util
+
+    spec_loader = importlib.util.spec_from_file_location(
+        "dtf_postmortem", os.path.join(_REPO, "tools", "postmortem.py"))
+    pm = importlib.util.module_from_spec(spec_loader)
+    spec_loader.loader.exec_module(pm)
+    for spec in expects:
+        assert fr.contains_in_order(events, pm.parse_expect(spec)), \
+            (spec, [(e.get("src"), e["kind"]) for e in events])
 
 
 def scheduler_invariants() -> None:
@@ -211,6 +261,23 @@ FLEET_EXPECT = (
     "fleet_restart,fleet_done"
 )
 
+#: where the fleet round's per-process dumps are staged for the ci_fast
+#: cross-worker merge gate, and where the merged timeline itself lands
+FLEET_DUMPS_DIR = os.environ.get(
+    "DTF_FLEET_DUMPS", os.path.join(_REPO, "artifacts", "fleet_dumps"))
+FLEET_MERGED_ARTIFACT = os.environ.get(
+    "DTF_FLEET_MERGED",
+    os.path.join(_REPO, "artifacts", "fleet_merged_postmortem.jsonl"))
+
+#: the CROSS-PROCESS causal story the merged fleet timeline must tell:
+#: the gang stop precedes EVERY worker's incarnation-2 restore, which
+#: precedes the fleet declaring the restarted gang live (shared with
+#: ci_fast.sh's --merge gate; src pins the event to one process)
+FLEET_MERGED_EXPECTS = (
+    "fleet_gang_stop,ckpt_restore[src=w0i2],fleet_restart,fleet_done",
+    "fleet_gang_stop,ckpt_restore[src=w1i2],fleet_restart,fleet_done",
+)
+
 
 def fleet_round() -> float:
     """Worker 1 hangs (heartbeats stop, process alive) → the fleet
@@ -234,7 +301,7 @@ def fleet_round() -> float:
         def launch(i, incarnation):
             args = [sys.executable, WORKER, ckpt_dirs[i], "--fleet",
                     "--fleet-dir", fleet_dir, "--worker-index", str(i),
-                    "--steps", "6"]
+                    "--steps", "6", "--flightrec-dir", fleet_dir]
             if i == 1:
                 args += ["--hang-at", "3"]  # gated to incarnation 1
             env = dict(os.environ)
@@ -250,6 +317,7 @@ def fleet_round() -> float:
             finally:
                 log.close()
 
+        from distributed_tensorflow_tpu.obs import fleetview as fv
         from distributed_tensorflow_tpu.obs import goodput
 
         rec = FlightRecorder()
@@ -260,12 +328,30 @@ def fleet_round() -> float:
                            backoff=RetryPolicy(base_s=0.0, jitter=0.0),
                            poll_s=0.2, heartbeat_timeout_s=20.0,
                            stall_timeout_s=600.0, launch_grace_s=180.0,
-                           term_grace_s=5.0),
+                           term_grace_s=5.0, snapshot_poll_s=0.4),
             ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec)
         out = fleet.run()
         assert out == {"restarts": 1, "incarnation": 2, "resizes": 0}, out
         assert fl.read_restore_step(fleet_dir) == 2, "common-step ceiling"
+        # fleet observatory: the aggregator folded worker snapshots into
+        # the fleet's registry — fleet-wide goodput from MERGED counters
+        # and an own-clock staleness gauge per worker
+        frac = reg.get(fv.FLEET_GOODPUT_FRACTION)
+        assert frac is not None and 0.0 < frac.value <= 1.0, \
+            "aggregator published no fleet_goodput_fraction"
+        for i in range(2):
+            assert reg.get(fv.FLEET_WORKER_STALENESS, worker=str(i)) \
+                is not None, f"no staleness gauge for worker {i}"
+        view = fleet.aggregator.view()
+        assert view.get("train_steps_total") is not None, \
+            "merged view has no fleet-wide union counters"
         rec.dump(FLEET_POSTMORTEM_ARTIFACT, reason="chaos_smoke_fleet")
+        rec.dump(os.path.join(fleet_dir, "fleet.jsonl"),
+                 reason="chaos_smoke_fleet")
+        _stage_fleet_dumps(
+            fleet_dir, FLEET_DUMPS_DIR, FLEET_MERGED_ARTIFACT,
+            FLEET_MERGED_EXPECTS,
+            expected_workers=("w0i1", "w0i2", "w1i2"))
         # the gang-restart baseline's price: the whole outage window
         # (stop -> relaunch -> restore -> live) in restart_recovery —
         # the elastic round below must beat it by >= 10x
@@ -276,7 +362,8 @@ def fleet_round() -> float:
     assert os.path.exists(FLEET_POSTMORTEM_ARTIFACT)
     print("chaos_smoke: fleet hang -> missed-heartbeat death -> gang "
           "restart (incarnation 2, common ckpt) -> done OK (postmortem "
-          f"at {FLEET_POSTMORTEM_ARTIFACT}; "
+          f"at {FLEET_POSTMORTEM_ARTIFACT}; merged cross-worker timeline "
+          f"at {FLEET_MERGED_ARTIFACT}; "
           f"restart_recovery={baseline_rr:.2f}s)")
     return baseline_rr
 
@@ -290,6 +377,25 @@ ELASTIC_POSTMORTEM_ARTIFACT = os.environ.get(
 
 #: the causal story the elastic round's timeline must tell, in order
 ELASTIC_EXPECT = "fleet_worker_dead,fleet_shrink,fleet_rejoin,fleet_done"
+
+#: staging/merge artifacts for the elastic round's cross-worker gate
+ELASTIC_DUMPS_DIR = os.environ.get(
+    "DTF_ELASTIC_DUMPS", os.path.join(_REPO, "artifacts", "elastic_dumps"))
+ELASTIC_MERGED_ARTIFACT = os.environ.get(
+    "DTF_ELASTIC_MERGED",
+    os.path.join(_REPO, "artifacts", "elastic_merged_postmortem.jsonl"))
+
+#: the CROSS-PROCESS resize story: the fleet's hold plan precedes each
+#: survivor's barrier pause, the shrink release precedes each
+#: survivor's (and the replacement's) application of the new sharding —
+#: i.e. every post-barrier step — and the rejoin precedes fleet_done
+ELASTIC_MERGED_EXPECTS = (
+    "fleet_worker_dead,fleet_hold,elastic_hold[src=w0i1],fleet_shrink,"
+    "elastic_release[src=w0i1],fleet_rejoin,fleet_done",
+    "fleet_worker_dead,fleet_hold,elastic_hold[src=w2i1],fleet_shrink,"
+    "elastic_release[src=w2i1],fleet_rejoin,fleet_done",
+    "fleet_shrink,elastic_release[src=w1i1],fleet_rejoin,fleet_done",
+)
 
 
 def elastic_round(baseline_rr: float) -> None:
@@ -319,7 +425,7 @@ def elastic_round(baseline_rr: float) -> None:
             args = [sys.executable, WORKER, ckpt_dirs[i], "--fleet",
                     "--elastic", "--fleet-dir", fleet_dir,
                     "--worker-index", str(i), "--steps", "8",
-                    "--step-sleep", "0.25"]
+                    "--step-sleep", "0.25", "--flightrec-dir", fleet_dir]
             if i == 1 and n == 0:
                 args += ["--die-at", "3"]  # first launch only
             env = dict(os.environ)
@@ -343,7 +449,7 @@ def elastic_round(baseline_rr: float) -> None:
                            poll_s=0.2, heartbeat_timeout_s=20.0,
                            stall_timeout_s=600.0, launch_grace_s=180.0,
                            rejoin_grace_s=180.0, hold_timeout_s=120.0,
-                           term_grace_s=5.0),
+                           term_grace_s=5.0, snapshot_poll_s=0.4),
             ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec)
         out = fleet.run()
         assert out["restarts"] == 0, out
@@ -360,11 +466,18 @@ def elastic_round(baseline_rr: float) -> None:
         assert fr.contains_in_order(rec.events(), ELASTIC_EXPECT.split(",")), \
             rec.events()
         rec.dump(ELASTIC_POSTMORTEM_ARTIFACT, reason="chaos_smoke_elastic")
+        rec.dump(os.path.join(fleet_dir, "fleet.jsonl"),
+                 reason="chaos_smoke_elastic")
+        _stage_fleet_dumps(
+            fleet_dir, ELASTIC_DUMPS_DIR, ELASTIC_MERGED_ARTIFACT,
+            ELASTIC_MERGED_EXPECTS,
+            expected_workers=("w0i1", "w1i1", "w2i1"))
     assert os.path.exists(ELASTIC_POSTMORTEM_ARTIFACT)
     print("chaos_smoke: elastic death -> shrink@barrier -> replacement "
           "rejoin -> done OK (restart_recovery "
           f"{elastic_rr:.2f}s vs gang baseline {baseline_rr:.2f}s; "
-          f"postmortem at {ELASTIC_POSTMORTEM_ARTIFACT})")
+          f"postmortem at {ELASTIC_POSTMORTEM_ARTIFACT}; merged "
+          f"cross-worker timeline at {ELASTIC_MERGED_ARTIFACT})")
 
 
 def main() -> int:

@@ -54,6 +54,14 @@
 #      ckpt_restore → fleet restart — in causal order (ISSUE 8), and
 #      the elastic round's dump the resize story — worker dead →
 #      fleet_shrink → fleet_rejoin → fleet_done (ISSUE 12)
+#   6b. tools/postmortem.py --merge + tools/fleet_top.py — fleet
+#      observatory gates (ISSUE 15): the chaos fleet and elastic rounds
+#      stage every process's flight-recorder dump (plus telemetry
+#      snapshots and heartbeats) under artifacts/{fleet,elastic}_dumps;
+#      the merge gate aligns the per-process clocks on control-plane
+#      anchors and asserts the CROSS-WORKER causal stories, and
+#      fleet_top --once exercises the merged text view on the same
+#      artifacts
 #   7. tools/bench_serve.py  — paged-KV serve smoke (ISSUE 13): the
 #      mixed-length chaos preset on the tiny model, chaos epilogue
 #      included, gating (a) 64-step greedy parity of the paged path
@@ -89,6 +97,26 @@ env JAX_PLATFORMS=cpu python tools/postmortem.py \
 env JAX_PLATFORMS=cpu python tools/postmortem.py \
   "${DTF_ELASTIC_POSTMORTEM:-artifacts/elastic_postmortem.jsonl}" --quiet \
   --expect 'fleet_worker_dead,fleet_shrink,fleet_rejoin,fleet_done'
+# fleet observatory (ISSUE 15): re-merge the chaos rounds' per-process
+# dumps into ONE cross-worker timeline (clock alignment anchored on the
+# control-plane handshakes) and gate the CROSS-PROCESS causal stories —
+# the gang stop precedes every worker's restore; the shrink release
+# precedes every survivor's application of the new sharding
+env JAX_PLATFORMS=cpu python tools/postmortem.py --merge \
+  "${DTF_FLEET_DUMPS:-artifacts/fleet_dumps}"/fleet.jsonl \
+  "${DTF_FLEET_DUMPS:-artifacts/fleet_dumps}"/flightrec-w*.jsonl \
+  --out "${DTF_FLEET_MERGED:-artifacts/fleet_merged_postmortem.jsonl}" --quiet \
+  --expect 'fleet_gang_stop,ckpt_restore[src=w0i2],fleet_restart,fleet_done' \
+  --expect 'fleet_gang_stop,ckpt_restore[src=w1i2],fleet_restart,fleet_done'
+env JAX_PLATFORMS=cpu python tools/postmortem.py --merge \
+  "${DTF_ELASTIC_DUMPS:-artifacts/elastic_dumps}"/fleet.jsonl \
+  "${DTF_ELASTIC_DUMPS:-artifacts/elastic_dumps}"/flightrec-w*.jsonl \
+  --out "${DTF_ELASTIC_MERGED:-artifacts/elastic_merged_postmortem.jsonl}" --quiet \
+  --expect 'fleet_worker_dead,fleet_hold,elastic_hold[src=w0i1],fleet_shrink,elastic_release[src=w0i1],fleet_rejoin,fleet_done' \
+  --expect 'fleet_worker_dead,fleet_hold,elastic_hold[src=w2i1],fleet_shrink,elastic_release[src=w2i1],fleet_rejoin,fleet_done' \
+  --expect 'fleet_shrink,elastic_release[src=w1i1],fleet_rejoin,fleet_done'
+env JAX_PLATFORMS=cpu python tools/fleet_top.py --once \
+  --fleet-dir "${DTF_FLEET_DUMPS:-artifacts/fleet_dumps}" >/dev/null
 env JAX_PLATFORMS=cpu python tools/bench_serve.py --preset chaos \
   --requests 10 --slots 4 --max-new 8 --parity-check >/dev/null
 echo "ci_fast: all gates passed"

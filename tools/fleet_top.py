@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""fleet_top — live (or one-shot) text view of a fleet's telemetry.
+
+Reads the per-worker artifacts the fleet control plane already leaves
+under a fleet dir — ``fleetsnap-<i>.json`` telemetry snapshots
+(obs/fleetview.SnapshotExporter) and ``heartbeat-<i>.json`` liveness
+records (resilience/fleet.HeartbeatWriter) — folds the snapshots
+through the same ``FleetAggregator`` the ``FleetSupervisor`` runs, and
+prints one row per worker plus the fleet-wide aggregates:
+
+    worker  inc  seq  step  phase    hb.seq  stale_s  steps   goodput
+    0       2    14   6     done     31      0.0      6       0.82
+    1       2    12   6     done     29      0.0      6       0.79
+    fleet: goodput_fraction=0.81 steps_total=12 step p50=3.1ms p99=4.8ms
+
+The fleet aggregates come from MERGED per-worker registries (counters
+and histogram buckets sum; the p99 is read from the union buckets) —
+never from averaging per-worker readings, the aggregation soundness
+rule docs/observability.md "Fleet observability" pins. Staleness is
+judged on THIS process's clock from observed (pid, seq) changes, so on
+``--once`` (a single observation) it reads 0.0 — the column becomes
+meaningful in live mode, where a worker that stopped exporting ages
+visibly while the others stay fresh.
+
+Usage:
+    python tools/fleet_top.py --fleet-dir <dir> --once
+    python tools/fleet_top.py --fleet-dir <dir> --interval 2.0
+"""
+
+import argparse
+import glob
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+_SNAP_RE = re.compile(r"fleetsnap-(\d+)\.json$")
+
+
+def discover_workers(fleet_dir: str) -> list[int]:
+    """Worker indices with a snapshot file under the fleet dir."""
+    out = []
+    for p in glob.glob(os.path.join(fleet_dir, "fleetsnap-*.json")):
+        m = _SNAP_RE.search(os.path.basename(p))
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def _fmt(v, spec="{:.2f}"):
+    return spec.format(v) if v is not None else "-"
+
+
+def render_once(agg, fleet_dir: str, out=sys.stdout) -> None:
+    from distributed_tensorflow_tpu.obs import fleetview as fv
+    from distributed_tensorflow_tpu.obs import goodput
+    from distributed_tensorflow_tpu.resilience import fleet as fl
+
+    view = agg.poll()
+    print(f"{'worker':<7} {'inc':<4} {'seq':<5} {'step':<6} {'phase':<10} "
+          f"{'hb.seq':<7} {'stale_s':<8} {'steps':<7} {'goodput':<7}",
+          file=out)
+    for i in agg.workers:
+        st = agg.status.get(i)
+        if st is None:
+            print(f"{i:<7} {'-':<4} {'-':<5} {'-':<6} {'-':<10} {'-':<7} "
+                  f"{'-':<8} {'-':<7} {'-':<7}", file=out)
+            continue
+        hb = fl.read_heartbeat(fl.heartbeat_path(fleet_dir, i))
+        stale = agg.registry.get(fv.FLEET_WORKER_STALENESS, worker=str(i))
+        steps = view.get("train_steps_total", worker=str(i))
+        frac = view.get(goodput.GOODPUT_FRACTION, worker=str(i))
+        print(f"{i:<7} {st['incarnation']:<4} {st['seq']:<5} "
+              f"{_fmt(st['step'], '{}'):<6} {str(st['phase']):<10} "
+              f"{_fmt(hb.seq if hb else None, '{}'):<7} "
+              f"{_fmt(stale.value if stale else None):<8} "
+              f"{_fmt(steps.value if steps else None, '{:.0f}'):<7} "
+              f"{_fmt(frac.value if frac else None):<7}", file=out)
+    frac = view.get(fv.FLEET_GOODPUT_FRACTION)
+    steps = view.get("train_steps_total")
+    hist = view.get("train_step_seconds")
+    parts = [f"goodput_fraction={_fmt(frac.value if frac else None)}",
+             f"steps_total={_fmt(steps.value if steps else None, '{:.0f}')}"]
+    if hist is not None and hist.count:
+        parts.append(f"step p50={hist.percentile(0.5) * 1e3:.1f}ms "
+                     f"p99={hist.percentile(0.99) * 1e3:.1f}ms")
+    print("fleet: " + " ".join(parts), file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fleet-dir", required=True,
+                    help="fleet control dir (fleetsnap-*.json, "
+                         "heartbeat-*.json)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one view and exit (CI mode)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="live-mode refresh seconds")
+    args = ap.parse_args(argv)
+
+    from distributed_tensorflow_tpu.obs import fleetview as fv
+
+    workers = discover_workers(args.fleet_dir)
+    if not workers:
+        print(f"fleet_top: no fleetsnap-*.json under {args.fleet_dir}",
+              file=sys.stderr)
+        return 2
+    agg = fv.FleetAggregator(args.fleet_dir, workers)
+    if args.once:
+        render_once(agg, args.fleet_dir)
+        return 0
+    try:
+        while True:
+            render_once(agg, args.fleet_dir)
+            print(flush=True)
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -94,6 +94,7 @@ def check(verbose: bool = True) -> list[str]:
     failures += _check_flightrec()
     failures += _check_goodput(reg)
     failures += _check_scaling()
+    failures += _check_fleetview()
 
     if verbose:
         print(text, end="")
@@ -281,6 +282,136 @@ def _check_scaling() -> list[str]:
             "does not multiply")
     corrupt(lambda r: r["gates"][0].update(passed=False), "inconsistent")
     corrupt(lambda r: r.update(cells=[]), "no cells")
+    return failures
+
+
+def _check_fleetview() -> list[str]:
+    """Fleet-observatory gate (obs/fleetview.py): a worker snapshot
+    round-trips through the ``dtf-fleetsnap-1`` validator, a consistent
+    set of per-process dumps merges into a valid ``dtf-fleetmerge-1``
+    timeline — and the must-fail corpora are each caught: a torn
+    snapshot, a snapshot claiming another worker's label, a worker dump
+    with no clock anchor, a worker label collision, and causally
+    impossible anchors. Pure host code: no device, no jax."""
+    import copy
+    import os
+
+    from distributed_tensorflow_tpu.obs import fleetview as fv
+    from distributed_tensorflow_tpu.obs import flightrec as fr
+    from distributed_tensorflow_tpu.obs.registry import Registry
+
+    failures: list[str] = []
+
+    class _Clk:
+        def __init__(self, t):
+            self.t = float(t)
+
+        def __call__(self):
+            return self.t
+
+    with tempfile.TemporaryDirectory(prefix="obs_check_fv_") as d:
+        # -- snapshot schema + crash-safety ------------------------------
+        wclk = _Clk(100.0)
+        wrec = fr.FlightRecorder(clock=wclk)
+        wreg = Registry()
+        wreg.counter("goodput_productive_seconds_total").inc(3.0)
+        exporter = fv.SnapshotExporter(
+            fv.fleetsnap_path(d, 0), worker=0, incarnation=1,
+            registry=wreg, flightrec=wrec, clock=wclk, min_interval_s=5.0)
+        wrec.emit("train_start", step=0)
+        path = exporter.export(step=1, phase="train")
+        snap = fv.read_snapshot(path)
+        for f in fv.validate_snapshot(snap, expect_worker=0):
+            failures.append(f"fleetsnap invalid: {f}")
+        if exporter.export(step=2) is not None:  # inside the rate limit
+            failures.append("exporter ignored min_interval_s")
+        if exporter.export(step=2, force=True) is None:
+            failures.append("exporter force= did not bypass the rate limit")
+        # a crash mid-export leaves a torn .tmp and the PREVIOUS
+        # snapshot readable — simulate the torn sibling and verify reads
+        # never see it
+        # reviewed: deliberately torn scratch sibling for the crash-safety
+        # probe — the .tmp path is exactly what a mid-export kill leaves
+        with open(path + ".tmp", "w") as f_torn:  # dtflint: disable=atomic-durable-write
+            f_torn.write('{"schema": "dtf-fleetsnap-1", "worker"')
+        good = fv.read_snapshot(path)
+        if good is None or good["seq"] != 2:
+            failures.append("previous snapshot unreadable next to a torn "
+                            ".tmp")
+        # a torn snapshot FILE (external corruption) reads as absent
+        torn = os.path.join(d, "torn.json")
+        # reviewed: scratch corpus for the must-fail probe
+        with open(torn, "w") as f_t:  # dtflint: disable=atomic-durable-write
+            f_t.write('{"schema": "dtf-fleetsnap-1", "wor')
+        if fv.read_snapshot(torn) is not None:
+            failures.append("torn snapshot did not read as absent")
+        bad = copy.deepcopy(snap)
+        bad["schema"] = "dtf-fleetsnap-0"
+        if not any("schema" in f for f in fv.validate_snapshot(bad)):
+            failures.append("snapshot validator missed a schema violation")
+        if not any("collision" in f
+                   for f in fv.validate_snapshot(snap, expect_worker=1)):
+            failures.append("snapshot validator missed a worker label "
+                            "collision")
+
+        # -- merged timeline + anchor must-fails -------------------------
+        pid = os.getpid()
+        fclk = _Clk(500.0)
+        frec = fr.FlightRecorder(clock=fclk)
+        frec.emit("fleet_start", workers=1, incarnation=1)
+        fclk.t = 501.0
+        frec.emit("fleet_launch", worker=0, incarnation=1, pid=pid)
+        fclk.t = 510.0
+        frec.emit("fleetsnap_merge", worker=0, seq=1, pid=pid,
+                  incarnation=1)
+        fclk.t = 540.0
+        frec.emit("fleet_done", incarnation=1)
+        fleet_dump = frec.dump(os.path.join(d, "fleet.jsonl"), "obs_check")
+        wclk.t = 130.0
+        wrec.emit("train_stop", step=2, reason="done")
+        worker_dump = wrec.dump(os.path.join(d, "w0.jsonl"), "obs_check",
+                                extra={"worker": 0, "incarnation": 1})
+        header, events, merge_failures = fv.merge_timelines(
+            fleet_dump, [worker_dump], reason="obs_check")
+        for f in merge_failures:
+            failures.append(f"consistent dumps failed to merge: {f}")
+        merged = os.path.join(d, "merged.jsonl")
+        fv.write_merged(merged, header, events)
+        for f in fv.validate_merged_dump(merged):
+            failures.append(f"merged dump invalid: {f}")
+        if not fr.contains_in_order(events, [
+                ("fleet_launch", {}), ("train_start", {"src": "w0i1"}),
+                ("fleetsnap_merge", {}), ("fleet_done", {})]):
+            failures.append("merged timeline lost the launch->merge->done "
+                            "causal order")
+        # no anchor: a fleet dump with no fleet_launch for this worker
+        bare = fr.FlightRecorder(clock=_Clk(500.0))
+        bare.emit("fleet_start", workers=1, incarnation=1)
+        bare_dump = bare.dump(os.path.join(d, "bare.jsonl"), "obs_check")
+        _, _, mf = fv.merge_timelines(bare_dump, [worker_dump])
+        if not any("anchor missing" in f for f in mf):
+            failures.append(f"merge missed a missing clock anchor: {mf}")
+        # collision: two dumps claiming the same (worker, incarnation)
+        _, _, mf = fv.merge_timelines(fleet_dump,
+                                      [worker_dump, worker_dump])
+        if not any("collision" in f for f in mf):
+            failures.append(f"merge missed a worker label collision: {mf}")
+        # impossible anchors: the worker's life (30s) cannot fit the
+        # fleet's launch->done window (1s)
+        tight = fr.FlightRecorder(clock=_Clk(500.0))
+        tight.emit("fleet_launch", worker=0, incarnation=1, pid=pid)
+        tight_clk = _Clk(501.0)
+        tight.clock = tight_clk
+        tight.emit("fleet_done", incarnation=1)
+        tight_dump = tight.dump(os.path.join(d, "tight.jsonl"), "obs_check")
+        _, _, mf = fv.merge_timelines(tight_dump, [worker_dump])
+        if not any("inconsistent" in f for f in mf):
+            failures.append(f"merge missed inconsistent clock anchors: {mf}")
+        # missing identity: a dump without worker/incarnation can't merge
+        anon_dump = wrec.dump(os.path.join(d, "anon.jsonl"), "obs_check")
+        _, _, mf = fv.merge_timelines(fleet_dump, [anon_dump])
+        if not any("identity" in f for f in mf):
+            failures.append(f"merge missed a missing worker identity: {mf}")
     return failures
 
 

@@ -1,12 +1,13 @@
 #!/usr/bin/env python
-"""Render a flight-recorder postmortem dump as a causal timeline.
+"""Render flight-recorder postmortem dumps as causal timelines —
+single-process, or MERGED across a fleet.
 
-The dump (obs/flightrec.py: one JSON header line + one JSON event per
-line, monotonic timestamps) is written by the train loop on an unhandled
-step exception, by the Supervisor on ``SupervisorExhausted``, or on
-request (``tests/chaos_worker.py --flightrec``). This tool answers the
-operator question the raw JSONL can't: *what happened, in what order,
-and what did recovery do about it* — e.g.
+A single dump (obs/flightrec.py: one JSON header line + one JSON event
+per line, monotonic timestamps) is written by the train loop on an
+unhandled step exception, by the Supervisor on ``SupervisorExhausted``,
+or on request (``tests/chaos_worker.py --flightrec``). This tool answers
+the operator question the raw JSONL can't: *what happened, in what
+order, and what did recovery do about it* — e.g.
 
     t+0.412s  fault_fired          step=3   fault=sigterm
     t+0.498s  ckpt_save            step=4   trigger=preemption
@@ -16,21 +17,37 @@ and what did recovery do about it* — e.g.
     t+0.633s  ckpt_quarantine      step=4   note=...
     t+0.671s  ckpt_restore         step=2   fallback=True
 
-Validation (exit 1 on failure, the CI gate in tools/ci_fast.sh):
+With ``--merge``, the FIRST dump is the fleet supervisor's and the rest
+are per-worker dumps (headers stamped ``worker``/``incarnation``); the
+tool aligns their incomparable per-process monotonic clocks on shared
+control-plane anchors (``obs/fleetview.merge_timelines``: launches,
+snapshot merges, relayed restores, the resize handshake), renders ONE
+pod-scale timeline with a ``src`` column, optionally writes it
+(``--out``, schema ``dtf-fleetmerge-1``), and applies every ``--expect``
+to the merged sequence — a CROSS-PROCESS causal gate ("the gang stop
+precedes every worker's restore"). A dump whose header already carries
+``dtf-fleetmerge-1`` is validated as a merged timeline.
+
+Validation (exit 1 on failure, the CI gates in tools/ci_fast.sh):
 
 - schema: header tag, per-event required keys, known event kinds,
-  non-decreasing timestamps (``obs.flightrec.validate_dump``);
-- ordering: ``--expect k1,k2[attr=v],...`` asserts the timeline contains
-  those events as a causal subsequence (``obs.flightrec.contains_in_order``).
+  non-decreasing timestamps (``obs.flightrec.validate_dump`` /
+  ``obs.fleetview.validate_merged_dump``);
+- anchors (merge mode): a worker dump with no launch anchor, ambiguous
+  anchors, inconsistent offset bounds, or a worker label collision
+  fails the merge;
+- ordering: each ``--expect k1,k2[attr=v],...`` (repeatable) asserts
+  the timeline contains those events as a causal subsequence
+  (``obs.flightrec.contains_in_order``; merged events carry
+  ``src=fleet|w<i>i<k>`` for per-process pinning).
 
 Usage:
-    python tools/postmortem.py <dump.jsonl>
-    python tools/postmortem.py <dump.jsonl> --expect \
-        'fault_fired[fault=sigterm],ckpt_save[trigger=preemption],sup_restart'
+    python tools/postmortem.py <dump.jsonl> [--expect ...]
+    python tools/postmortem.py --merge <fleet.jsonl> <worker.jsonl>... \
+        --out merged.jsonl --expect 'fleet_gang_stop,ckpt_restore[src=w0i2]'
 """
 
 import argparse
-import json
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
@@ -42,14 +59,11 @@ _STEP_KINDS = ("step_start", "step_end")
 
 
 def load(path):
-    """Returns (header_dict, [event_dict, ...])."""
-    with open(path) as f:
-        lines = f.read().splitlines()
-    if not lines:
-        raise ValueError(f"empty dump: {path}")
-    header = json.loads(lines[0])
-    events = [json.loads(line) for line in lines[1:]]
-    return header, events
+    """Returns (header_dict, [event_dict, ...]) — the one JSONL-dump
+    reader, shared with the merge library."""
+    from distributed_tensorflow_tpu.obs import fleetview as fv
+
+    return fv.load_dump(path)
 
 
 def parse_expect(spec: str):
@@ -90,24 +104,36 @@ def _split_top(spec: str):
     return out
 
 
-def _fmt_event(e, t0):
-    attrs = {k: v for k, v in e.items() if k not in ("t", "kind", "step")}
+def _fmt_event(e, t0, with_src=False):
+    skip = ("t", "kind", "step", "src") if with_src else ("t", "kind", "step")
+    attrs = {k: v for k, v in e.items() if k not in skip}
     step = f"step={e['step']:<6}" if "step" in e else " " * 11
+    src = f"{e.get('src', ''):<8}" if with_src else ""
     body = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
-    return f"  t+{e['t'] - t0:9.3f}s  {e['kind']:<20} {step} {body}".rstrip()
+    return (f"  t+{e['t'] - t0:9.3f}s  {src}{e['kind']:<20} "
+            f"{step} {body}").rstrip()
 
 
-def render(header, events, out=sys.stdout):
+def render(header, events, out=sys.stdout, with_src=False):
     """Human timeline; consecutive step_start/step_end runs collapsed."""
     t0 = events[0]["t"] if events else header.get("dumped_t", 0.0)
     span = events[-1]["t"] - t0 if events else 0.0
-    print(
-        f"FLIGHT RECORDER POSTMORTEM  reason={header.get('reason') or '-'}  "
-        f"{len(events)} events ({header.get('dropped', 0)} dropped, "
-        f"ring capacity {header.get('capacity')})  span {span:.3f}s  "
-        f"pid {header.get('pid')}",
-        file=out,
-    )
+    if with_src:
+        srcs = [s.get("src") for s in header.get("sources", [])]
+        print(
+            f"MERGED FLEET POSTMORTEM  reason={header.get('reason') or '-'}  "
+            f"{len(events)} events from {len(srcs)} processes "
+            f"({', '.join(map(str, srcs))})  span {span:.3f}s",
+            file=out,
+        )
+    else:
+        print(
+            f"FLIGHT RECORDER POSTMORTEM  reason={header.get('reason') or '-'}"
+            f"  {len(events)} events ({header.get('dropped', 0)} dropped, "
+            f"ring capacity {header.get('capacity')})  span {span:.3f}s  "
+            f"pid {header.get('pid')}",
+            file=out,
+        )
     i = 0
     while i < len(events):
         e = events[i]
@@ -128,40 +154,95 @@ def render(header, events, out=sys.stdout):
                 )
                 i = j
                 continue
-        print(_fmt_event(e, t0), file=out)
+        print(_fmt_event(e, t0, with_src=with_src), file=out)
         i += 1
+
+
+def _check_expects(events, expects, failures) -> None:
+    from distributed_tensorflow_tpu.obs import flightrec as fr
+
+    for spec in expects or []:
+        if not fr.contains_in_order(events, parse_expect(spec)):
+            failures.append(
+                f"timeline does not contain the expected causal "
+                f"sequence: {spec}")
+
+
+def _run_merge(args) -> int:
+    from distributed_tensorflow_tpu.obs import fleetview as fv
+
+    if len(args.dump) < 2:
+        print("FAIL: --merge needs a fleet dump plus at least one "
+              "worker dump", file=sys.stderr)
+        return 1
+    header, events, failures = fv.merge_timelines(
+        args.dump[0], args.dump[1:], reason="postmortem --merge")
+    if not failures and args.out:
+        fv.write_merged(args.out, header, events)
+        failures += fv.validate_merged_dump(args.out)
+    if not failures and not args.quiet:
+        render(header, events, with_src=True)
+    if not failures:
+        _check_expects(events, args.expect, failures)
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(f"OK: merged {len(args.dump)} dumps into {len(events)} "
+              f"events" + (f" -> {args.out}" if args.out else "")
+              + (f"; causal order present for {len(args.expect)} "
+                 f"expectation(s)" if args.expect else ""),
+              file=sys.stderr)
+    return 1 if failures else 0
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("dump", help="postmortem JSONL written by the recorder")
-    ap.add_argument("--expect", default=None,
+    ap.add_argument("dump", nargs="+",
+                    help="postmortem JSONL dump(s); with --merge the "
+                         "first is the fleet's and the rest are workers'")
+    ap.add_argument("--merge", action="store_true",
+                    help="align the dumps' clocks on control-plane "
+                         "anchors and gate ONE merged cross-worker "
+                         "timeline")
+    ap.add_argument("--out", default=None,
+                    help="with --merge: write the merged timeline "
+                         "(dtf-fleetmerge-1 JSONL) here")
+    ap.add_argument("--expect", action="append", default=None,
                     help="comma-separated 'kind' or 'kind[attr=val,...]' "
-                         "items that must appear in this causal order")
+                         "items that must appear in this causal order "
+                         "(repeatable; each spec is checked separately)")
     ap.add_argument("--quiet", action="store_true",
                     help="validate only; skip the rendered timeline")
     args = ap.parse_args(argv)
 
+    if args.merge:
+        return _run_merge(args)
+    if len(args.dump) != 1:
+        print("FAIL: multiple dumps require --merge", file=sys.stderr)
+        return 1
+    path = args.dump[0]
+
+    from distributed_tensorflow_tpu.obs import fleetview as fv
     from distributed_tensorflow_tpu.obs import flightrec as fr
 
-    failures = fr.validate_dump(args.dump)
-    header, events = ({}, [])
+    try:
+        header, events = load(path)
+    except (OSError, ValueError) as e:
+        print(f"FAIL: unreadable dump: {e}", file=sys.stderr)
+        return 1
+    merged = header.get("schema") == fv.MERGED_SCHEMA
+    failures = (fv.validate_merged_dump(path) if merged
+                else fr.validate_dump(path))
+    if not failures and not args.quiet:
+        render(header, events, with_src=merged)
     if not failures:
-        header, events = load(args.dump)
-        if not args.quiet:
-            render(header, events)
-    if args.expect and not failures:
-        specs = parse_expect(args.expect)
-        if not fr.contains_in_order(events, specs):
-            failures.append(
-                f"timeline does not contain the expected causal sequence: "
-                f"{args.expect}"
-            )
+        _check_expects(events, args.expect, failures)
     for f in failures:
         print(f"FAIL: {f}", file=sys.stderr)
     if not failures:
-        print(f"OK: {args.dump} valid ({len(events)} events"
-              + (f", causal order '{args.expect}' present" if args.expect
+        n = len(args.expect) if args.expect else 0
+        print(f"OK: {path} valid ({len(events)} events"
+              + (f", causal order present for {n} expectation(s)" if n
                  else "") + ")",
               file=sys.stderr)
     return 1 if failures else 0
