@@ -60,6 +60,7 @@ from ..core import (
 DURABLE_MODULES = (
     "train/checkpoint.py",
     "resilience/fleet.py",
+    "resilience/liveness.py",
     "resilience/anomaly.py",
     "obs/flightrec.py",
     "obs/fleetview.py",

@@ -471,6 +471,19 @@ def _offset_bounds(src: str, header: Mapping, events: Sequence[Mapping],
                 and fe.get("incarnation") == k \
                 and pid is not None and fe.get("pid") == pid:
             highs.append(fe["t"] - last_t)
+        elif kind == "serve_route" and fe.get("replica") == w \
+                and fe.get("rid") is not None:
+            # serve-fleet dispatch handshake: the router emitted the
+            # dispatch before this replica ACKed it (same rid). A stale
+            # pairing from an earlier dispatch of the rid to this slot
+            # only loosens the bound — max(lows) keeps the tight one.
+            we = _first(events, "serve_route", rid=fe["rid"])
+            if we is not None:
+                lows.append(fe["t"] - we["t"])
+        elif kind == "serve_replica_dead" and fe.get("replica") == w \
+                and fe.get("incarnation") == k \
+                and pid is not None and fe.get("pid") == pid:
+            highs.append(fe["t"] - last_t)
         elif kind == "fleet_done":
             # fires only after every worker's exit: all events precede
             highs.append(fe["t"] - last_t)

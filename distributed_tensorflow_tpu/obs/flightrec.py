@@ -113,6 +113,16 @@ EVENT_KINDS = (
     #                         exhaustion (paged cache)      {uid, slot}
     "serve_prefill_chunk",  # one chunk of a chunked prefill
     #                                               {uid, slot, start, n}
+    # serve fleet (serve/router.py, serve/fleet.py, serve/replica.py) —
+    # serve_route is BOTH halves of the dispatch handshake: the router
+    # emits it when it places a request on a replica, and the replica
+    # re-emits it (same rid) when it ingests the dispatch — the clock
+    # anchor the merged timeline aligns serve replicas on (fleetview)
+    "serve_route",          # request dispatched / ingested {rid, lane, replica, hit}
+    "serve_requeue",        # in-flight requeued at lane head after its
+    #                         replica died                  {rid, lane, replica, delivered}
+    "serve_replica_dead",   # serve replica liveness/exit failure
+    #                                       {replica, cause, incarnation, pid}
     # free-form operator note
     "note",
 )

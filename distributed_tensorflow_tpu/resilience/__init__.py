@@ -13,6 +13,10 @@ both halves of that story:
   seeded jitter and obs counters (retry.py), and the in-process training
   Supervisor that classifies failures and restarts `Trainer.fit` from
   the latest *valid* checkpoint under a restart budget (supervisor.py);
+- the process-liveness protocol (liveness.py): atomic heartbeat files,
+  incarnation fencing, monitor-clock staleness, and launch-seam handle
+  teardown — the ONE implementation shared by the training fleet
+  (fleet.py) and the serving fleet (serve/fleet.py);
 - the cluster-level layer over both: a collective-free, heartbeat-based
   fleet control plane that supervises worker PROCESSES and turns any
   classified failure into a coordinated gang restart from the latest
@@ -49,6 +53,11 @@ from .faults import (  # noqa: F401
     TransientIOError,
     corrupt_shard,
     truncate_shard,
+)
+from .liveness import (  # noqa: F401
+    atomic_write,
+    ensure_dead,
+    reap,
 )
 from .fleet import (  # noqa: F401
     EXIT_FAILED,

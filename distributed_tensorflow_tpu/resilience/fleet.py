@@ -69,6 +69,24 @@ from ..obs import flightrec as flightrec_lib
 from ..obs import goodput
 from ..obs.flightrec import FlightRecorder
 from ..obs.registry import Registry, default_registry
+from . import liveness
+from .liveness import (
+    DEAD,
+    HOLD_PHASES as _HOLD_PHASES,
+    INCARNATION_FILE as _INCARNATION_FILE,
+    LIVE,
+    STALLED_HB,
+    TERMINAL_PHASES as _TERMINAL_PHASES,
+    WAITING,
+    Heartbeat,
+    HeartbeatMonitor,
+    HeartbeatWriter,
+    atomic_write as _atomic_write,
+    heartbeat_path,
+    read_heartbeat,
+    read_incarnation,
+    write_incarnation,
+)
 from .retry import RetryPolicy
 from .supervisor import (
     FATAL, POISONED, PREEMPTION, STALLED, TRANSIENT, classify_failure,
@@ -106,7 +124,6 @@ FLEET_RESIZES_TOTAL = "fleet_resizes_total"
 #: one process.
 _ELASTIC_CAUSES = frozenset({TRANSIENT, STALLED, PREEMPTION})
 
-_INCARNATION_FILE = "INCARNATION"
 _RESTORE_FILE = "RESTORE_STEP"
 _SHARD_PLAN_FILE = "SHARD_PLAN"
 
@@ -138,52 +155,10 @@ class FleetExhausted(RuntimeError):
 
 
 # ---------------------------------------------------------------------------
-# On-disk control files (incarnation, restore ceiling)
+# On-disk control files (restore ceiling; the incarnation file, the
+# atomic-write idiom, and the heartbeat layout live in .liveness — the
+# ONE implementation shared with the serve fleet)
 # ---------------------------------------------------------------------------
-
-
-def _atomic_write(path: str, text: str) -> None:
-    """tmp + rename so a reader never sees a torn record; no fsync —
-    these files trade durability for freshness (a record lost to a
-    crash IS the signal the protocol detects: a heartbeat that didn't
-    reach disk reads as a missed beat, which is the truth)."""
-    tmp = f"{path}.tmp"
-    # reviewed: deliberately NOT the fsync idiom — see docstring; an
-    # fsync per beat would put a disk flush on the liveness hot path
-    with open(tmp, "w") as f:  # dtflint: disable=atomic-durable-write
-        f.write(text)
-    os.replace(tmp, path)
-
-
-def heartbeat_path(fleet_dir: str, worker: int) -> str:
-    """The one heartbeat file of worker ``worker`` under the fleet dir —
-    the single definition of the layout, shared by writer and monitor."""
-    return os.path.join(
-        os.path.abspath(os.path.expanduser(fleet_dir)),
-        f"heartbeat-{worker}.json",
-    )
-
-
-def read_incarnation(fleet_dir: str) -> int:
-    """Current fleet incarnation (0 when no fleet has ever run here).
-    Workers call this at startup and stamp every heartbeat with it."""
-    path = os.path.join(
-        os.path.abspath(os.path.expanduser(fleet_dir)), _INCARNATION_FILE)
-    try:
-        with open(path) as f:
-            return int(f.read().strip())
-    except FileNotFoundError:
-        return 0
-    except (OSError, ValueError) as e:
-        logger.warning("unreadable incarnation file %s (%s); assuming 0",
-                       path, e)
-        return 0
-
-
-def write_incarnation(fleet_dir: str, incarnation: int) -> None:
-    d = os.path.abspath(os.path.expanduser(fleet_dir))
-    os.makedirs(d, exist_ok=True)
-    _atomic_write(os.path.join(d, _INCARNATION_FILE), f"{int(incarnation)}\n")
 
 
 def read_restore_step(fleet_dir: str) -> int | None:
@@ -419,239 +394,12 @@ def newest_common_valid_step(ckpt_dirs: Sequence[str]) -> int | None:
 
 
 # ---------------------------------------------------------------------------
-# Heartbeats: writer (worker side) and monitor (fleet side)
+# Heartbeats: writer (worker side) and monitor (fleet side) — factored
+# into .liveness (shared with serve/fleet.py) and re-exported above:
+# Heartbeat, read_heartbeat, HeartbeatWriter, HeartbeatMonitor, the
+# WAITING/LIVE/DEAD/STALLED_HB statuses, and the terminal/hold phase
+# tuples. The protocol semantics are documented there.
 # ---------------------------------------------------------------------------
-
-
-@dataclasses.dataclass(frozen=True)
-class Heartbeat:
-    """One decoded heartbeat record. ``t`` is the WRITER's clock —
-    informational only; staleness is judged by the monitor observing
-    ``seq`` changes on its OWN clock, because monotonic clocks are not
-    comparable across processes."""
-
-    pid: int
-    seq: int
-    t: float
-    step: int
-    attempt: int
-    incarnation: int
-    phase: str
-    cause: str | None = None
-    restore_step: int | None = None
-    restore_fallback: bool | None = None
-    #: elastic plan acknowledgment: the newest ShardPlan version this
-    #: worker has applied (or is holding at), and its sharded world size
-    plan_version: int | None = None
-    world: int | None = None
-
-
-def read_heartbeat(path: str) -> Heartbeat | None:
-    """Decode the heartbeat at ``path``; None when absent or unreadable
-    (an unreadable heartbeat is indistinguishable from a missing one —
-    both mean 'no proof of life')."""
-    try:
-        with open(path) as f:
-            data = json.load(f)
-        return Heartbeat(
-            pid=int(data["pid"]), seq=int(data["seq"]),
-            t=float(data["t"]), step=int(data.get("step", 0)),
-            attempt=int(data.get("attempt", 0)),
-            incarnation=int(data.get("incarnation", 0)),
-            phase=str(data.get("phase", "init")),
-            cause=data.get("cause"),
-            restore_step=data.get("restore_step"),
-            restore_fallback=data.get("restore_fallback"),
-            plan_version=data.get("plan_version"),
-            world=data.get("world"),
-        )
-    except FileNotFoundError:
-        return None
-    except (OSError, ValueError, KeyError, TypeError) as e:
-        logger.warning("unreadable heartbeat %s (%s); treating as absent",
-                       path, e)
-        return None
-
-
-class HeartbeatWriter:
-    """Worker-side heartbeat emitter: every ``beat()`` bumps ``seq`` and
-    atomically rewrites the file with the latest known
-    ``{step, attempt, phase, restore...}``. Fields persist across beats,
-    so a fleet that only samples the newest record still sees the
-    restore note from an earlier one. Thread-safe (the optional pulse
-    thread and the train loop both beat)."""
-
-    def __init__(self, path: str, incarnation: int = 0,
-                 clock: Callable[[], float] = time.monotonic,
-                 pulse_interval_s: float | None = None):
-        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
-                    exist_ok=True)
-        self.path = path
-        self.incarnation = int(incarnation)
-        self.clock = clock
-        self._lock = threading.Lock()
-        self._seq = 0
-        self._step = 0
-        self._attempt = 0
-        self._phase = "init"
-        self._cause: str | None = None
-        self._restore: tuple[int, bool] | None = None
-        self._plan: tuple[int, int] | None = None  # (version, world)
-        self._stop = threading.Event()
-        self._pulse: threading.Thread | None = None
-        if pulse_interval_s is not None:
-            if pulse_interval_s <= 0:
-                raise ValueError("pulse_interval_s must be positive")
-            self._pulse = threading.Thread(
-                target=self._pulse_loop, args=(pulse_interval_s,),
-                daemon=True, name="fleet-heartbeat-pulse")
-            self._pulse.start()
-
-    def beat(self, step: int | None = None, attempt: int | None = None,
-             phase: str | None = None) -> None:
-        """Write one heartbeat; omitted fields keep their last value."""
-        with self._lock:
-            if step is not None:
-                self._step = int(step)
-            if attempt is not None:
-                self._attempt = int(attempt)
-            if phase is not None:
-                self._phase = str(phase)
-            self._seq += 1
-            rec = {
-                "pid": os.getpid(), "seq": self._seq,
-                "t": float(self.clock()), "step": self._step,
-                "attempt": self._attempt, "incarnation": self.incarnation,
-                "phase": self._phase, "cause": self._cause,
-            }
-            if self._restore is not None:
-                rec["restore_step"], rec["restore_fallback"] = self._restore
-            if self._plan is not None:
-                rec["plan_version"], rec["world"] = self._plan
-            # write INSIDE the lock: beats from the pulse thread and the
-            # train loop serialize, so seq order on disk == write order
-            _atomic_write(self.path, json.dumps(rec))
-
-    def note_restore(self, step: int, fallback: bool) -> None:
-        """Record which checkpoint this incarnation restored from — the
-        fleet relays it into its timeline as the gang's ``ckpt_restore``
-        evidence."""
-        with self._lock:
-            self._restore = (int(step), bool(fallback))
-        self.beat()
-
-    def note_plan(self, version: int, world: int) -> None:
-        """Record the newest ShardPlan this worker has applied (or is
-        holding at) — the fleet's resize-acknowledgment signal. The
-        caller beats separately (usually with the matching phase)."""
-        with self._lock:
-            self._plan = (int(version), int(world))
-
-    @property
-    def phase(self) -> str:
-        """Last beaten phase — lets a transient phase (``save``) restore
-        what it replaced instead of guessing."""
-        with self._lock:
-            return self._phase
-
-    def finish(self, phase: str, cause: str | None = None) -> None:
-        """Terminal beat (``done`` / ``preempted`` / ``failed``) — the
-        record the fleet reads after the process exits."""
-        with self._lock:
-            self._cause = cause
-        self.close()
-        self.beat(phase=phase)
-
-    def _pulse_loop(self, interval_s: float) -> None:
-        while not self._stop.wait(interval_s):
-            self.beat()
-
-    def close(self) -> None:
-        """Stop the pulse thread (idempotent; the file is left behind —
-        its staleness is the death signal)."""
-        self._stop.set()
-        if self._pulse is not None:
-            self._pulse.join(timeout=5.0)
-            self._pulse = None
-
-
-#: HeartbeatMonitor.check() statuses
-WAITING = "waiting"   # no beat yet, launch grace not exceeded
-LIVE = "live"
-DEAD = "dead"         # no (current-incarnation) beat within the budget
-STALLED_HB = "stalled"  # beats ticking, no progress past the budget
-
-#: phases after which a frozen step is expected (the process is exiting)
-_TERMINAL_PHASES = ("done", "preempted", "failed")
-
-#: phases during which a frozen step is SANCTIONED: the fleet itself is
-#: holding the worker at a resize barrier (and bounds the hold with its
-#: own ``hold_timeout_s`` — the stall budget must not race it)
-_HOLD_PHASES = ("barrier",)
-
-
-class HeartbeatMonitor:
-    """Fleet-side liveness judgment for ONE worker's heartbeat file.
-
-    Staleness is measured on the MONITOR's clock from the moments it
-    *observes* the heartbeat change — never from the heartbeat's own
-    timestamp (monotonic clocks don't compare across processes). A
-    heartbeat stamped with a different incarnation is ignored entirely:
-    a straggler from the previous gang writing right up until its
-    SIGKILL must read as *absent*, not alive.
-
-    Stall = ``seq`` still ticking (the pulse thread, or any beat
-    source) while (step, attempt, phase) make NO progress past the
-    stall budget, outside the terminal phases — so a pulsed worker hung
-    in build/restore (phase ``init``) is just as detectable as one hung
-    mid-train. Size ``stall_timeout_s`` above the longest legitimate
-    restore + first-step compile.
-    """
-
-    def __init__(self, path: str, incarnation: int,
-                 clock: Callable[[], float] = time.monotonic,
-                 heartbeat_timeout_s: float = 30.0,
-                 stall_timeout_s: float = 120.0,
-                 launch_grace_s: float = 120.0):
-        if heartbeat_timeout_s <= 0 or stall_timeout_s <= 0 \
-                or launch_grace_s <= 0:
-            raise ValueError("liveness budgets must be positive")
-        self.path = path
-        self.incarnation = int(incarnation)
-        self.clock = clock
-        self.heartbeat_timeout_s = heartbeat_timeout_s
-        self.stall_timeout_s = stall_timeout_s
-        self.launch_grace_s = launch_grace_s
-        self.heartbeat: Heartbeat | None = None  # last ACCEPTED record
-        self._t0 = clock()
-        self._last_seq: int | None = None
-        self._t_seq = self._t0
-        self._last_progress: tuple | None = None  # (step, attempt, phase)
-        self._t_progress = self._t0
-
-    def check(self) -> str:
-        """One liveness poll: WAITING / LIVE / DEAD / STALLED_HB."""
-        now = self.clock()
-        hb = read_heartbeat(self.path)
-        if hb is not None and hb.incarnation == self.incarnation:
-            self.heartbeat = hb
-            if hb.seq != self._last_seq:
-                self._last_seq, self._t_seq = hb.seq, now
-            progress = (hb.step, hb.attempt, hb.phase)
-            if progress != self._last_progress:
-                self._last_progress, self._t_progress = progress, now
-        if self._last_seq is None:
-            # nothing (of this incarnation) ever beat: grant the launch
-            # grace — process spawn + interpreter + framework import
-            return DEAD if now - self._t0 > self.launch_grace_s else WAITING
-        if now - self._t_seq > self.heartbeat_timeout_s:
-            return DEAD
-        if (self.heartbeat is not None
-                and self.heartbeat.phase not in _TERMINAL_PHASES
-                and self.heartbeat.phase not in _HOLD_PHASES
-                and now - self._t_progress > self.stall_timeout_s):
-            return STALLED_HB
-        return LIVE
 
 
 # ---------------------------------------------------------------------------
@@ -1619,18 +1367,10 @@ class FleetSupervisor:
     def _ensure_dead(self, w: _Worker) -> None:
         """Make one worker's death final before its slot is rewired:
         terminate (grace for a coordinated save), kill past the grace,
-        reap."""
-        if w.handle.poll() is None:
-            w.handle.terminate()
-            deadline = self.clock() + self.cfg.term_grace_s
-            while w.handle.poll() is None and self.clock() < deadline:
-                self._wait(min(self.cfg.poll_s, self.cfg.term_grace_s / 4))
-            if w.handle.poll() is None:
-                w.handle.kill()
-        try:
-            w.handle.wait(timeout=5.0)
-        except Exception as e:  # reap is best-effort bookkeeping
-            logger.warning("fleet: reaping worker %d failed: %r", w.index, e)
+        reap (liveness.ensure_dead, on the fleet's interruptible wait)."""
+        liveness.ensure_dead(w.handle, self.cfg.term_grace_s,
+                             self.cfg.poll_s, clock=self.clock,
+                             sleep=self._wait)
 
     def _preempted_teardown(self) -> None:
         """The fleet process itself was SIGTERMed: stop the gang (the
@@ -1649,11 +1389,7 @@ class FleetSupervisor:
         (the kernel hasn't finished tearing them down): skipping those
         leaks one zombie per escalated gang stop."""
         for w in self._workers:
-            try:
-                w.handle.wait(timeout=5.0)
-            except Exception as e:  # reap is best-effort bookkeeping
-                logger.warning("fleet: reaping worker %d failed: %r",
-                               w.index, e)
+            liveness.reap(w.handle)
 
     def _dump_postmortem(self, reason: str) -> None:
         flightrec_lib.dump_postmortem(self.flightrec, self.postmortem_dir,

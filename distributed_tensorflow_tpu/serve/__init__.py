@@ -7,7 +7,10 @@ ordinary pytree placed by parallel/sharding.py rules, the decode path is
 the SAME ``models.Transformer`` with a ``kv_cache`` argument, attention
 falls back to the masked dense form where the flash kernel doesn't apply
 (ops.attention.cached_attention), and the engine is a host-drives/
-device-computes loop like train/loop.py. See docs/serving.md.
+device-computes loop like train/loop.py. Above the single engine sits
+the serve FLEET (fleet.py + router.py): N replica engines behind a
+prefix-aware, SLO-laned router under heartbeat supervision — the
+serving twin of resilience/fleet.py. See docs/serving.md.
 """
 
 from .decode import (  # noqa: F401
@@ -24,6 +27,13 @@ from .decode import (  # noqa: F401
     prefill_bucket,
 )
 from .engine import ServeEngine, StepStats  # noqa: F401
+from .fleet import (  # noqa: F401
+    EngineBridge,
+    LocalReplica,
+    ServeFleetExhausted,
+    ServeFleetSupervisor,
+    SubprocessReplica,
+)
 from .kv_cache import (  # noqa: F401
     CACHE_LOGICAL,
     PAGED_CACHE_LOGICAL,
@@ -37,6 +47,14 @@ from .kv_cache import (  # noqa: F401
     paged_cache_specs,
     shard_cache,
     shard_paged_cache,
+)
+from .router import (  # noqa: F401
+    LANE_BATCH,
+    LANE_INTERACTIVE,
+    LANES,
+    FleetRequest,
+    Router,
+    UnknownLane,
 )
 from .sampling import sample  # noqa: F401
 from .scheduler import (  # noqa: F401

@@ -264,11 +264,14 @@ class ServeEngine:
         max_new_tokens: int = 32,
         eos_id: int | None = None,
         deadline_s: float | None = None,
+        priority: int = 0,
     ) -> int:
         """Enqueue a request (raises ``scheduler.QueueFull`` under
-        backpressure, ``scheduler.SchedulerClosed`` after drain)."""
+        backpressure, ``scheduler.SchedulerClosed`` after drain).
+        Higher ``priority`` residents are preempted LAST on block
+        exhaustion (the serve fleet's lane tiering rides on this)."""
         return self.sched.submit(prompt, max_new_tokens, eos_id,
-                                 deadline_s=deadline_s)
+                                 deadline_s=deadline_s, priority=priority)
 
     def cancel(self, uid: int) -> bool:
         """Cancel a queued or in-flight request (``FINISH_CANCELLED``);
@@ -472,11 +475,19 @@ class ServeEngine:
         self._ptoks.pop(slot, None)
 
     def _youngest_resident(self, exclude: int) -> int | None:
+        """Preemption victim: the LOWEST-priority resident, youngest
+        (highest uid) among equals — so batch-lane work absorbs block
+        exhaustion before interactive traffic, and all-default
+        priorities reproduce the original pure youngest-first policy."""
         best = None
         for i, req in enumerate(self.sched.slots):
             if req is None or i == exclude:
                 continue
-            if best is None or req.uid > self.sched.slots[best].uid:
+            if best is None:
+                best = i
+                continue
+            cur = self.sched.slots[best]
+            if (req.priority, -req.uid) < (cur.priority, -cur.uid):
                 best = i
         return best
 

@@ -1,0 +1,566 @@
+"""Serve fleet — N replica engines under one supervisor, one router
+(docs/serving.md "Serve fleet").
+
+``ServeFleetSupervisor`` is the serving twin of the training fleet
+(resilience/fleet.FleetSupervisor), built on the SAME liveness protocol
+(resilience/liveness.py: atomic heartbeat files, incarnation fencing,
+monitor-clock staleness, launch-seam teardown) — but where the training
+fleet's unit of recovery is the whole gang (restart from a common
+checkpoint), the serve fleet's is one REQUEST: a replica death loses no
+durable state, only in-flight decodes, and those are requeued at their
+lane head (serve/router.py) and re-prefilled on survivors. Scale-up is
+symmetric: a joining replica becomes a placement target on the next
+dispatch, no drain.
+
+Topology::
+
+    clients ──submit──> Router ──dispatch──> replica 0..N-1
+                          ^                    (each: paged ServeEngine)
+                          └── token/finish/death feedback (pump loop)
+
+Two replica transports speak one protocol (Popen-shaped ``poll/
+terminate/kill/wait/pid`` + ``send(payload)`` / ``poll_output()`` /
+``request_drain()``):
+
+- ``LocalReplica`` — an in-process engine behind the protocol, with a
+  synthetic pid and a ``hard_kill()`` that drops the engine mid-stream.
+  Deterministic (the supervisor's pump loop is single-threaded), so
+  the router/failover invariants are testable without processes.
+- ``SubprocessReplica`` — a real worker process
+  (``python -m distributed_tensorflow_tpu.serve.replica``) fed through
+  an inbox of atomically-written request files and tailed through an
+  append-only events JSONL; heartbeats + telemetry snapshots ride next
+  to them in the fleet workdir, exactly like training workers.
+
+The supervisor's flight recorder carries the fleet half of the merged
+postmortem (tools/postmortem.py --merge): ``fleet_launch`` per replica
+(the required clock anchor), ``serve_route`` on dispatch (paired with
+the replica's ingest ACK — the recurring lower bound),
+``serve_replica_dead`` / ``serve_requeue`` on the death path, and
+``fleet_done`` bounding every replica event from above.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import logging
+import os
+import time
+from collections import deque
+from typing import Callable
+
+from ..obs import fleetview as fleetview_lib
+from ..obs import flightrec as flightrec_lib
+from ..obs.registry import Registry, default_registry
+from ..resilience import liveness
+from .router import Router
+from .scheduler import QueueFull
+
+logger = logging.getLogger(__name__)
+
+#: metric names (documented in docs/observability.md "Serve fleet")
+SERVE_REPLICAS = "serve_replicas"
+SERVE_REPLICA_DEATHS_TOTAL = "serve_replica_deaths_total"
+
+#: replica exit protocol: 0 = clean drain; anything else mid-run is a
+#: death (the request-level recovery needs no finer taxonomy)
+DRAIN_SENTINEL = "DRAIN"
+
+
+class ServeFleetExhausted(RuntimeError):
+    """Replica deaths exceeded the fleet's budget, or the last replica
+    died — there is no survivor to re-prefill on."""
+
+
+def replica_dir(workdir: str, index: int) -> str:
+    return os.path.join(os.path.abspath(os.path.expanduser(workdir)),
+                        f"replica-{index}")
+
+
+def replica_inbox_dir(workdir: str, index: int) -> str:
+    return os.path.join(replica_dir(workdir, index), "inbox")
+
+
+def replica_events_path(workdir: str, index: int, incarnation: int) -> str:
+    """Append-only token/finish stream of one replica incarnation. The
+    incarnation is in the name so a relaunch never interleaves with its
+    corpse's stream."""
+    return os.path.join(replica_dir(workdir, index),
+                        f"events-i{incarnation}.jsonl")
+
+
+def drain_path(workdir: str, index: int) -> str:
+    return os.path.join(replica_dir(workdir, index), DRAIN_SENTINEL)
+
+
+# ---------------------------------------------------------------------------
+# Engine bridge — rid <-> uid, shared by LocalReplica and serve/replica.py
+# ---------------------------------------------------------------------------
+
+
+class EngineBridge:
+    """The ONE rid↔uid adapter between router dispatch payloads and a
+    ``ServeEngine`` (used in-process by ``LocalReplica`` and inside the
+    replica worker) — so the re-prefill and backpressure semantics
+    cannot drift between the test transport and the real one.
+
+    Backpressure: a payload the engine refuses (``QueueFull``) waits in
+    a local FIFO and is retried each pump, preserving dispatch order.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._pending: deque[dict] = deque()
+        self._req_of: dict[int, object] = {}   # rid -> scheduler Request
+        self._sent: dict[int, int] = {}        # rid -> tokens reported
+
+    def accept(self, payload: dict) -> None:
+        self._pending.append(dict(payload))
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._pending or self._req_of
+                    or self.engine.sched.has_work)
+
+    def pump(self) -> list[dict]:
+        """Feed waiting payloads, advance the engine one step, and
+        report what changed: ``{kind: token|finish, rid, ...}``."""
+        while self._pending:
+            if not self._try_submit(self._pending[0]):
+                break
+            self._pending.popleft()
+        if self.engine.sched.has_work:
+            self.engine.step()
+        return self.collect()
+
+    def _try_submit(self, payload: dict) -> bool:
+        try:
+            self.engine.submit(
+                payload["prompt"], payload["max_new_tokens"],
+                eos_id=payload.get("eos_id"),
+                priority=int(payload.get("priority", 0)),
+            )
+        except QueueFull:
+            return False
+        rid = int(payload["rid"])
+        # the freshly submitted Request is the queue tail; holding the
+        # object directly survives preemption requeues (same instance)
+        self._req_of[rid] = self.engine.sched.queue[-1]
+        self._sent[rid] = 0
+        return True
+
+    def collect(self) -> list[dict]:
+        out: list[dict] = []
+        for rid in list(self._req_of):
+            req = self._req_of[rid]
+            for tok in req.generated[self._sent[rid]:]:
+                out.append({"kind": "token", "rid": rid, "token": int(tok)})
+            self._sent[rid] = len(req.generated)
+            if req.done:
+                out.append({"kind": "finish", "rid": rid,
+                            "reason": req.finish_reason})
+                del self._req_of[rid], self._sent[rid]
+                self.engine.sched.finished.pop(req.uid, None)
+        return out
+
+    def drain(self) -> list[dict]:
+        """Engine shutdown: decode residents to completion, audit the
+        block allocator, report the trailing events plus one terminal
+        ``drained`` record (the leak gate every surviving replica must
+        pass)."""
+        eng = self.engine
+        eng.drain()
+        out = self.collect()
+        free = int(getattr(eng.alloc, "blocks_free", 0)) if eng.paged else 0
+        total = int(eng.cache.num_blocks) if eng.paged else 0
+        out.append({"kind": "drained", "blocks_free": free,
+                    "num_blocks": total, "leak_free": free == total})
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Replica transports
+# ---------------------------------------------------------------------------
+
+#: synthetic pids for in-process replicas — disjoint from real pids in
+#: any merged timeline (kernel pids are far below this range)
+_local_pids = itertools.count(10_000_000)
+
+
+class LocalReplica:
+    """An in-process replica: a real (usually paged) ``ServeEngine``
+    behind the replica transport protocol. ``hard_kill()`` is the chaos
+    seam — the engine is dropped on the floor exactly as a SIGKILL
+    would, mid-stream, undelivered state and all."""
+
+    def __init__(self, engine, *, pid: int | None = None):
+        self.bridge = EngineBridge(engine)
+        self.pid = int(pid) if pid is not None else next(_local_pids)
+        self._rc: int | None = None
+        self._draining = False
+
+    # -- data plane --------------------------------------------------------
+
+    def send(self, payload: dict) -> None:
+        if self._rc is None and not self._draining:
+            self.bridge.accept(payload)
+
+    def poll_output(self) -> list[dict]:
+        if self._rc is not None:
+            return []
+        if self._draining:
+            events = self.bridge.drain()
+            self._rc = 0
+            return events
+        return self.bridge.pump()
+
+    def request_drain(self) -> None:
+        self._draining = True
+
+    # -- Popen shape -------------------------------------------------------
+
+    def poll(self) -> int | None:
+        return self._rc
+
+    def wait(self, timeout: float | None = None) -> int:
+        if self._rc is None:
+            # an in-process replica only exits through drain/kill; a
+            # bare wait() would spin forever — surface the misuse
+            raise RuntimeError("LocalReplica.wait() before drain/kill")
+        return self._rc
+
+    def hard_kill(self) -> None:
+        """SIGKILL equivalent: no drain, no leak audit, engine state
+        (and every undelivered token) gone."""
+        if self._rc is None:
+            self._rc = -9
+
+    def kill(self) -> None:
+        self.hard_kill()
+
+    def terminate(self) -> None:
+        # SIGTERM equivalent: coordinated drain on the next pump
+        self._draining = True
+
+
+class SubprocessReplica:
+    """Client side of one replica worker process: wraps its Popen
+    handle, writes dispatch payloads into the inbox (atomic tmp+rename,
+    so the worker never reads a torn request), and tails the replica's
+    append-only events stream (complete lines only — a torn tail line
+    is left for the next poll)."""
+
+    def __init__(self, proc, workdir: str, index: int, incarnation: int):
+        self.proc = proc
+        self.workdir = workdir
+        self.index = int(index)
+        self.incarnation = int(incarnation)
+        self._inbox = replica_inbox_dir(workdir, index)
+        self._events = replica_events_path(workdir, index, incarnation)
+        self._offset = 0
+        self._seq = 0
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def poll(self):
+        return self.proc.poll()
+
+    def wait(self, timeout: float | None = None):
+        return self.proc.wait(timeout=timeout)
+
+    def terminate(self) -> None:
+        self.proc.terminate()
+
+    def kill(self) -> None:
+        self.proc.kill()
+
+    def send(self, payload: dict) -> None:
+        os.makedirs(self._inbox, exist_ok=True)
+        self._seq += 1
+        liveness.atomic_write(
+            os.path.join(self._inbox, f"req-{self._seq:06d}.json"),
+            json.dumps(payload))
+
+    def request_drain(self) -> None:
+        liveness.atomic_write(drain_path(self.workdir, self.index), "1\n")
+
+    def poll_output(self) -> list[dict]:
+        try:
+            with open(self._events) as f:
+                f.seek(self._offset)
+                chunk = f.read()
+        except FileNotFoundError:
+            return []
+        events: list[dict] = []
+        consumed = 0
+        for line in chunk.splitlines(keepends=True):
+            if not line.endswith("\n"):
+                break  # torn tail: the writer is mid-append
+            consumed += len(line)
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+        self._offset += consumed
+        return events
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Replica:
+    index: int
+    incarnation: int
+    handle: object
+    monitor: liveness.HeartbeatMonitor | None = None
+
+
+class ServeFleetSupervisor:
+    """Pump-driven supervisor over N replicas and one ``Router``.
+
+    ``launch(index, incarnation)`` is the seam (FleetSupervisor's
+    pattern): it returns a replica transport — tests and the bench
+    driver hand back ``LocalReplica``s; tools/chaos_smoke.py spawns
+    ``serve/replica.py`` workers and wraps them in
+    ``SubprocessReplica``. One ``pump()`` is one deterministic
+    iteration: dispatch → collect replica output → judge liveness (and
+    run the death path) → optionally fold telemetry snapshots.
+
+    Death path (cause: nonzero/early exit, or a DEAD/stalled heartbeat
+    verdict when a workdir is configured): emit ``serve_replica_dead``,
+    make the corpse final (``liveness.ensure_dead``), requeue its
+    in-flight requests at their lane heads, and — with
+    ``relaunch_dead`` — relaunch the slot at incarnation+1 behind a
+    fresh incarnation fence, corpse heartbeat deleted first so the new
+    monitor can never read stale liveness. Without relaunch the
+    survivors simply absorb the load (elastic ``add_replica`` is the
+    scale-up path, no drain either way).
+    """
+
+    def __init__(self, launch: Callable[[int, int], object],
+                 num_replicas: int, *, router: Router | None = None,
+                 workdir: str | None = None,
+                 relaunch_dead: bool = False,
+                 max_deaths: int = 8,
+                 registry: Registry | None = None, flightrec=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 poll_s: float = 0.01, term_grace_s: float = 5.0,
+                 heartbeat_timeout_s: float = 30.0,
+                 stall_timeout_s: float = 120.0,
+                 launch_grace_s: float = 120.0,
+                 snapshot_poll_s: float | None = None):
+        if num_replicas < 1:
+            raise ValueError("num_replicas must be >= 1")
+        self.launch = launch
+        self.num_replicas = num_replicas
+        self.workdir = (os.path.abspath(os.path.expanduser(workdir))
+                        if workdir else None)
+        self.relaunch_dead = relaunch_dead
+        self.max_deaths = max_deaths
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
+        self.router = router if router is not None else Router(
+            registry=self.registry, flightrec=self.flightrec, clock=clock)
+        self.clock = clock
+        self.sleep = sleep
+        self.poll_s = poll_s
+        self.term_grace_s = term_grace_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.stall_timeout_s = stall_timeout_s
+        self.launch_grace_s = launch_grace_s
+        self.deaths = 0
+        self.replicas: dict[int, _Replica] = {}
+        #: index → terminal ``drained`` record (the leak audit of every
+        #: replica that shut down cleanly)
+        self.drained: dict[int, dict] = {}
+        self._m_replicas = self.registry.gauge(
+            SERVE_REPLICAS, "live serve replicas behind the router")
+        self._m_deaths = self.registry.counter(
+            SERVE_REPLICA_DEATHS_TOTAL,
+            "serve replica deaths detected (exit, missed heartbeat)")
+        self.aggregator: fleetview_lib.FleetAggregator | None = None
+        self._snapshot_poll_s = snapshot_poll_s
+        self._t_agg: float | None = None
+        if snapshot_poll_s is not None and self.workdir:
+            self.aggregator = fleetview_lib.FleetAggregator(
+                self.workdir, range(num_replicas),
+                registry=self.registry, flightrec=self.flightrec,
+                clock=self.clock)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.flightrec.emit("fleet_start", workers=self.num_replicas,
+                            incarnation=0)
+        for i in range(self.num_replicas):
+            self._launch(i, 0)
+
+    def _launch(self, index: int, incarnation: int) -> None:
+        if self.workdir:
+            # clear corpse state BEFORE the fence goes up: a stale
+            # heartbeat or half-eaten inbox must not leak into the new
+            # incarnation (requeued requests were already re-owned by
+            # the router, so leftover inbox files are duplicates)
+            hb = liveness.heartbeat_path(self.workdir, index)
+            if os.path.exists(hb):
+                os.remove(hb)
+            inbox = replica_inbox_dir(self.workdir, index)
+            if os.path.isdir(inbox):
+                for name in os.listdir(inbox):
+                    os.remove(os.path.join(inbox, name))
+            stale_drain = drain_path(self.workdir, index)
+            if os.path.exists(stale_drain):
+                os.remove(stale_drain)
+        handle = self.launch(index, incarnation)
+        monitor = None
+        if self.workdir:
+            monitor = liveness.HeartbeatMonitor(
+                liveness.heartbeat_path(self.workdir, index), incarnation,
+                clock=self.clock,
+                heartbeat_timeout_s=self.heartbeat_timeout_s,
+                stall_timeout_s=self.stall_timeout_s,
+                launch_grace_s=self.launch_grace_s)
+        self.replicas[index] = _Replica(index, incarnation, handle, monitor)
+        self.flightrec.emit("fleet_launch", worker=index,
+                            incarnation=incarnation,
+                            pid=getattr(handle, "pid", None))
+        self.router.add_replica(index)
+        self._m_replicas.set(len(self.replicas))
+
+    def add_replica(self) -> int:
+        """Elastic scale-up: launch one more replica (next free index,
+        incarnation 0) and make it a placement target on the very next
+        dispatch — the fleet never drains."""
+        index = max(self.replicas, default=-1) + 1
+        if self.aggregator is not None:
+            self.aggregator.workers.append(index)
+        self._launch(index, 0)
+        return index
+
+    # -- the pump ----------------------------------------------------------
+
+    def pump(self) -> bool:
+        """One supervision iteration; returns True while work remains
+        (requests queued or in flight)."""
+        for target, req in self.router.dispatch():
+            self.replicas[target].handle.send(req.payload())
+        for rep in list(self.replicas.values()):
+            for ev in rep.handle.poll_output():
+                self._on_replica_event(rep, ev)
+        self._check_liveness()
+        self._maybe_aggregate()
+        return not self.router.idle
+
+    def _on_replica_event(self, rep: _Replica, ev: dict) -> None:
+        kind = ev.get("kind")
+        if kind == "token":
+            self.router.on_token(int(ev["rid"]), int(ev["token"]))
+        elif kind == "finish":
+            self.router.on_finish(int(ev["rid"]), str(ev["reason"]))
+        elif kind == "drained":
+            self.drained[rep.index] = dict(ev)
+        # anything else ("ready", diagnostics) is informational
+
+    def _check_liveness(self) -> None:
+        for rep in list(self.replicas.values()):
+            rc = rep.handle.poll()
+            cause = None
+            if rc is not None:
+                # ANY exit while supervised is a death: clean drains
+                # happen in stop(), after the replica leaves the table
+                cause = "exit" if rc else "early_exit"
+            elif rep.monitor is not None:
+                verdict = rep.monitor.check()
+                if verdict == liveness.DEAD:
+                    cause = "heartbeat"
+                elif verdict == liveness.STALLED_HB:
+                    cause = "stall"
+            if cause is not None:
+                self._on_death(rep, cause, rc)
+
+    def _on_death(self, rep: _Replica, cause: str, rc) -> None:
+        self.deaths += 1
+        self._m_deaths.inc()
+        self.flightrec.emit(
+            "serve_replica_dead", replica=rep.index, cause=cause,
+            incarnation=rep.incarnation,
+            pid=getattr(rep.handle, "pid", None))
+        logger.error("serve fleet: replica %d dead [%s] rc=%r",
+                     rep.index, cause, rc)
+        liveness.ensure_dead(rep.handle, self.term_grace_s, self.poll_s,
+                             clock=self.clock, sleep=self.sleep)
+        del self.replicas[rep.index]
+        self._m_replicas.set(len(self.replicas))
+        # drain the corpse's last delivered tokens? No: its events were
+        # already polled this pump; anything undelivered died with it —
+        # the requeue below re-prefills past exactly what the client saw
+        self.router.requeue_replica(rep.index)
+        if self.deaths > self.max_deaths:
+            raise ServeFleetExhausted(
+                f"{self.deaths} replica deaths exceed the budget "
+                f"({self.max_deaths})")
+        if self.relaunch_dead:
+            self._launch(rep.index, rep.incarnation + 1)
+        elif not self.replicas:
+            raise ServeFleetExhausted(
+                "last replica died with relaunch disabled; no survivor "
+                "to re-prefill on")
+
+    def _maybe_aggregate(self) -> None:
+        if self.aggregator is None:
+            return
+        now = self.clock()
+        if self._t_agg is None or now - self._t_agg >= self._snapshot_poll_s:
+            self._t_agg = now
+            self.aggregator.poll()
+
+    # -- driving -----------------------------------------------------------
+
+    def run(self, max_pumps: int = 1_000_000) -> None:
+        """Pump until every submitted request finished. ``max_pumps``
+        bounds the loop so a wedged fleet fails loudly instead of
+        spinning forever."""
+        for _ in range(max_pumps):
+            if not self.pump():
+                return
+            self.sleep(self.poll_s)
+        raise ServeFleetExhausted(
+            f"fleet made no progress to idle within {max_pumps} pumps "
+            f"({self.router.inflight()} in flight)")
+
+    def stop(self, timeout_s: float = 60.0) -> None:
+        """Coordinated shutdown: ask every replica to drain, keep
+        pumping their output (the terminal leak audits arrive here),
+        reap, and close the timeline with ``fleet_done`` — the merge
+        anchor that bounds every replica event from above."""
+        for rep in self.replicas.values():
+            rep.handle.request_drain()
+        deadline = self.clock() + timeout_s
+        live = dict(self.replicas)
+        while live and self.clock() < deadline:
+            for i, rep in list(live.items()):
+                for ev in rep.handle.poll_output():
+                    self._on_replica_event(rep, ev)
+                if rep.handle.poll() is not None:
+                    del live[i]
+            if live:
+                self.sleep(self.poll_s)
+        for rep in self.replicas.values():
+            liveness.ensure_dead(rep.handle, self.term_grace_s, self.poll_s,
+                                 clock=self.clock, sleep=self.sleep)
+        if self.aggregator is not None:
+            self.aggregator.poll()
+        incarnation = max(
+            (r.incarnation for r in self.replicas.values()), default=0)
+        self.flightrec.emit("fleet_done", incarnation=incarnation)
+        self.replicas.clear()
+        self._m_replicas.set(0)

@@ -1,0 +1,383 @@
+"""Fleet-front router — SLO lanes, prefix-aware placement, survivor
+re-prefill (docs/serving.md "Serve fleet").
+
+The router is the request-side half of the serve fleet: it owns every
+request NOT currently resident on a replica, and decides (a) *when* a
+request is offered to the fleet (lane order + per-replica outstanding
+caps = admission/backpressure) and (b) *where* it lands (prefix-aware
+or random placement). It is deliberately jax-free and engine-free —
+replicas are just integer ids with a capacity; the supervisor
+(serve/fleet.py) bridges dispatch orders to real engines — so every
+routing invariant is testable without a model.
+
+State machine of one request (``FleetRequest``)::
+
+    submit ──> queued(lane) ──dispatch──> in-flight(replica) ──> finished
+                   ^                           │
+                   └──── requeue_replica ──────┘   (replica died; back
+                         at the HEAD of its lane, original FIFO order)
+
+- **Lanes.** Two disjoint FIFO queues, ``interactive`` and ``batch``.
+  Dispatch drains interactive completely before offering batch, and
+  batch rides at engine priority 0 vs interactive 1 — so on a replica
+  under block pressure the batch lane absorbs preemption first
+  (engine._youngest_resident picks lowest priority), and under fleet
+  backpressure batch is the lane that waits.
+- **Prefix-aware placement.** Requests carry ``prefix_len`` — the
+  length of their shared system prompt. The first request of a prefix
+  picks the least-loaded replica and pins the prefix there; later
+  requests follow it while it stays live (a hit: the replica's LRU
+  prefix cache already holds those blocks, counted by the engine as
+  ``prefix_reuse_hits_total`` and here as ``router_prefix_hits_total``).
+  ``policy="random"`` is the control arm: seeded uniform placement over
+  replicas with capacity, same admission order.
+- **Death → requeue → re-prefill.** When the supervisor declares a
+  replica dead it calls ``requeue_replica``: that replica's in-flight
+  requests go back to the HEAD of their lanes in original dispatch
+  order, each carrying the tokens already streamed to the client. The
+  next dispatch re-prefills ``prompt + delivered`` on a survivor with
+  the remaining token budget — exactly the engine's own preemption
+  path (serve/engine.py re-prefills prompt+generated), one level up.
+  Greedy decode is deterministic, so the resumed stream continues the
+  uncontended stream bit-identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Callable, Iterable, Sequence
+
+from collections import deque
+
+from ..obs import flightrec as flightrec_lib
+from ..obs.registry import Registry, default_registry
+
+logger = logging.getLogger(__name__)
+
+#: SLO lanes (closed set — the scheduler's admission seam and the
+#: observability labels both key on these literals)
+LANE_INTERACTIVE = "interactive"
+LANE_BATCH = "batch"
+LANES = (LANE_INTERACTIVE, LANE_BATCH)
+
+#: engine-level priority each lane submits at: interactive residents
+#: are preempted LAST on block exhaustion (engine._youngest_resident)
+LANE_PRIORITY = {LANE_INTERACTIVE: 1, LANE_BATCH: 0}
+
+#: metric names (documented in docs/observability.md "Serve fleet")
+ROUTER_REQUESTS_TOTAL = "router_requests_total"
+ROUTER_DISPATCHES_TOTAL = "router_dispatches_total"
+ROUTER_REQUEUES_TOTAL = "router_requeues_total"
+ROUTER_PREFIX_HITS_TOTAL = "router_prefix_hits_total"
+ROUTER_QUEUE_DEPTH = "router_queue_depth"
+ROUTER_INFLIGHT = "router_inflight"
+ROUTER_TTFT_SECONDS = "router_ttft_seconds"
+ROUTER_TPOT_SECONDS = "router_tpot_seconds"
+
+
+class UnknownLane(ValueError):
+    """Lane label outside the closed set LANES."""
+
+
+@dataclasses.dataclass
+class FleetRequest:
+    """One routed request across its whole fleet lifetime — survives
+    replica deaths (``delivered`` is the resume point)."""
+
+    rid: int
+    prompt: tuple[int, ...]
+    max_new_tokens: int
+    lane: str = LANE_INTERACTIVE
+    #: length of the shared system-prompt prefix (0 = no shared prefix);
+    #: the placement key is ``prompt[:prefix_len]``
+    prefix_len: int = 0
+    eos_id: int | None = None
+    #: tokens already streamed to the client — on re-dispatch these ride
+    #: in the prompt (re-prefill) and shrink the remaining budget
+    delivered: list[int] = dataclasses.field(default_factory=list)
+    #: current replica (None while queued), and dispatch bookkeeping
+    replica: int | None = None
+    requeues: int = 0
+    finish_reason: str | None = None
+    # lifecycle timestamps (router clock): TTFT/TPOT are measured HERE,
+    # across deaths — a requeue does not reset t_submit, so the tail a
+    # client actually sees (including the re-prefill detour) is what
+    # the lane histograms record
+    t_submit: float | None = None
+    t_first_token: float | None = None
+    t_finish: float | None = None
+
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+    @property
+    def prefix(self) -> tuple[int, ...]:
+        return self.prompt[: self.prefix_len]
+
+    def payload(self) -> dict:
+        """The dispatch order a replica executes: re-prefill everything
+        the client has already seen, generate only the remainder."""
+        return {
+            "rid": self.rid,
+            "prompt": list(self.prompt) + list(self.delivered),
+            "max_new_tokens": self.max_new_tokens - len(self.delivered),
+            "eos_id": self.eos_id,
+            "priority": LANE_PRIORITY[self.lane],
+            "lane": self.lane,
+        }
+
+
+class Router:
+    """Lane-ordered, placement-aware request front for N replicas.
+
+    The router never talks to an engine: ``dispatch`` RETURNS
+    ``(replica, FleetRequest)`` orders and the caller (the supervisor)
+    delivers them, then feeds replica output back through
+    ``on_token``/``on_finish`` and deaths through ``requeue_replica``.
+    Single-threaded by design — the supervisor's pump loop is the only
+    caller, so ordering is deterministic.
+    """
+
+    def __init__(self, *, policy: str = "prefix",
+                 max_outstanding: int = 4, seed: int = 0,
+                 registry: Registry | None = None, flightrec=None,
+                 clock: Callable[[], float] = time.monotonic):
+        if policy not in ("prefix", "random"):
+            raise ValueError(f"unknown placement policy {policy!r}")
+        if max_outstanding < 1:
+            raise ValueError("max_outstanding must be >= 1")
+        self.policy = policy
+        #: per-replica cap on dispatched-but-unfinished requests — the
+        #: fleet-level backpressure knob (replica engines additionally
+        #: gate admission on actual KV blocks)
+        self.max_outstanding = max_outstanding
+        self.clock = clock  # injectable for deterministic latency tests
+        self._rng = random.Random(seed)  # seeded: placement is replayable
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
+        r = registry if registry is not None else default_registry()
+        self.registry = r
+        self.lanes: dict[str, deque[FleetRequest]] = {
+            lane: deque() for lane in LANES}
+        #: rid → request, for every request not yet finished
+        self.requests: dict[int, FleetRequest] = {}
+        #: replica → rids in dispatch order (the order requeue preserves)
+        self.outstanding: dict[int, list[int]] = {}
+        self.finished: dict[int, FleetRequest] = {}
+        self._next_rid = 0
+        #: prefix → home replica (prefix policy); entries for dead
+        #: replicas are repinned on the next dispatch of that prefix
+        self._prefix_home: dict[tuple[int, ...], int] = {}
+        #: True while the order being emitted (re)pinned its prefix —
+        #: a first placement, not a cache-warm hit
+        self._fresh_pin = False
+        self._m_requests = {
+            lane: r.counter(ROUTER_REQUESTS_TOTAL,
+                            "requests accepted by the router", lane=lane)
+            for lane in LANES
+        }
+        self._m_dispatches = {
+            lane: r.counter(ROUTER_DISPATCHES_TOTAL,
+                            "dispatch orders issued to replicas (requeued "
+                            "requests dispatch again)", lane=lane)
+            for lane in LANES
+        }
+        self._m_requeues = r.counter(
+            ROUTER_REQUEUES_TOTAL,
+            "in-flight requests returned to their lane head by a "
+            "replica death")
+        self._m_prefix_hits = r.counter(
+            ROUTER_PREFIX_HITS_TOTAL,
+            "dispatches placed on the live home replica of their "
+            "shared prefix")
+        self._m_depth = {
+            lane: r.gauge(ROUTER_QUEUE_DEPTH,
+                          "requests waiting in the lane", lane=lane)
+            for lane in LANES
+        }
+        self._m_inflight = r.gauge(
+            ROUTER_INFLIGHT, "requests dispatched and not yet finished")
+        self._m_ttft = {
+            lane: r.histogram(ROUTER_TTFT_SECONDS,
+                              "seconds from router submit to first "
+                              "delivered token, across replica deaths",
+                              lane=lane)
+            for lane in LANES
+        }
+        self._m_tpot = {
+            lane: r.histogram(ROUTER_TPOT_SECONDS,
+                              "seconds per generated token after the "
+                              "first (decode cadence)", lane=lane)
+            for lane in LANES
+        }
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, prompt: Iterable[int], max_new_tokens: int = 32,
+               *, lane: str = LANE_INTERACTIVE, prefix_len: int = 0,
+               eos_id: int | None = None) -> int:
+        """Queue a request on its lane; returns its rid."""
+        if lane not in LANES:
+            raise UnknownLane(f"lane {lane!r} not in {LANES}")
+        prompt = tuple(int(t) for t in prompt)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if not 0 <= prefix_len <= len(prompt):
+            raise ValueError(f"prefix_len {prefix_len} outside the prompt")
+        req = FleetRequest(self._next_rid, prompt, int(max_new_tokens),
+                           lane=lane, prefix_len=int(prefix_len),
+                           eos_id=eos_id, t_submit=self.clock())
+        self._next_rid += 1
+        self.requests[req.rid] = req
+        self.lanes[lane].append(req)
+        self._m_requests[lane].inc()
+        self._sync_gauges()
+        return req.rid
+
+    # -- replica membership ------------------------------------------------
+
+    def add_replica(self, replica: int) -> None:
+        """A replica joined (launch or elastic scale-up): it becomes a
+        placement target on the very next ``dispatch`` — no drain."""
+        self.outstanding.setdefault(int(replica), [])
+
+    def remove_replica(self, replica: int) -> None:
+        """Forget a replica WITHOUT requeueing (clean scale-down after
+        its outstanding set drained). Use ``requeue_replica`` for
+        deaths."""
+        left = self.outstanding.pop(int(replica), [])
+        if left:
+            raise RuntimeError(
+                f"replica {replica} removed with {len(left)} in-flight "
+                f"requests; requeue_replica is the death path")
+        self._prefix_home = {p: w for p, w in self._prefix_home.items()
+                             if w != replica}
+
+    # -- placement + dispatch ----------------------------------------------
+
+    def dispatch(self) -> list[tuple[int, FleetRequest]]:
+        """Drain the lanes onto replicas with capacity: ALL of
+        interactive before ANY of batch (batch is the lane that waits
+        under fleet backpressure). Returns the issued orders; the
+        caller delivers each payload to its replica."""
+        orders: list[tuple[int, FleetRequest]] = []
+        for lane in LANES:  # interactive first — the SLO tier order
+            q = self.lanes[lane]
+            while q:
+                target = self._place(q[0])
+                if target is None:
+                    break  # no capacity: everything behind the head waits
+                req = q.popleft()
+                req.replica = target
+                self.outstanding[target].append(req.rid)
+                self._m_dispatches[lane].inc()
+                self.flightrec.emit(
+                    "serve_route", rid=req.rid, lane=lane, replica=target,
+                    hit=bool(req.prefix_len
+                             and self._prefix_home.get(req.prefix) == target
+                             and not self._fresh_pin))
+                orders.append((target, req))
+        self._sync_gauges()
+        return orders
+
+    def _place(self, req: FleetRequest) -> int | None:
+        """Pick a live replica with capacity for ``req`` (None = none).
+        Sets ``self._fresh_pin`` when a prefix was (re)pinned rather
+        than followed — the distinction between a hit and a first
+        placement."""
+        self._fresh_pin = False
+        free = [w for w, rids in sorted(self.outstanding.items())
+                if len(rids) < self.max_outstanding]
+        if not free:
+            return None
+        if self.policy == "random":
+            return self._rng.choice(free)
+        if req.prefix_len:
+            home = self._prefix_home.get(req.prefix)
+            if home is not None and home in self.outstanding:
+                if home not in free:
+                    return None  # wait for the home replica, keep warmth
+                self._m_prefix_hits.inc()
+                return home
+            # first placement (or the home died): pin to least loaded
+            target = min(free, key=lambda w: (len(self.outstanding[w]), w))
+            self._prefix_home[req.prefix] = target
+            self._fresh_pin = True
+            return target
+        return min(free, key=lambda w: (len(self.outstanding[w]), w))
+
+    # -- replica feedback --------------------------------------------------
+
+    def on_token(self, rid: int, token: int) -> None:
+        """One generated token reached the client."""
+        req = self.requests[rid]
+        if req.t_first_token is None:
+            req.t_first_token = self.clock()
+            self._m_ttft[req.lane].observe(req.t_first_token - req.t_submit)
+        req.delivered.append(int(token))
+
+    def on_finish(self, rid: int, reason: str) -> None:
+        """The replica evicted the request as finished."""
+        req = self.requests.pop(rid)
+        req.finish_reason = reason
+        req.t_finish = self.clock()
+        if req.replica is not None:
+            self.outstanding[req.replica].remove(rid)
+        req.replica = None
+        if req.t_first_token is not None and len(req.delivered) > 1:
+            self._m_tpot[req.lane].observe(
+                (req.t_finish - req.t_first_token)
+                / (len(req.delivered) - 1))
+        self.finished[rid] = req
+        self._sync_gauges()
+
+    def requeue_replica(self, replica: int) -> list[int]:
+        """The death path: every request in flight on ``replica`` goes
+        back to the HEAD of its lane, original dispatch order preserved
+        (FIFO within the lane survives the death), ready to re-prefill
+        on a survivor. Returns the requeued rids."""
+        rids = self.outstanding.pop(int(replica), [])
+        per_lane: dict[str, list[FleetRequest]] = {l: [] for l in LANES}
+        for rid in rids:
+            req = self.requests[rid]
+            req.replica = None
+            req.requeues += 1
+            per_lane[req.lane].append(req)
+            self._m_requeues.inc()
+            self.flightrec.emit(
+                "serve_requeue", rid=rid, lane=req.lane, replica=replica,
+                delivered=len(req.delivered))
+        for lane, reqs in per_lane.items():
+            # extendleft reverses, so feed it reversed dispatch order:
+            # the queue head ends up [oldest, ..., newest, prior queue]
+            self.lanes[lane].extendleft(reversed(reqs))
+        # drop the dead replica's prefix pins: the next dispatch of each
+        # prefix repins it on a survivor (and counts no false hit)
+        self._prefix_home = {p: w for p, w in self._prefix_home.items()
+                             if w != replica}
+        self._sync_gauges()
+        return rids
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def idle(self) -> bool:
+        """No request queued or in flight."""
+        return not self.requests
+
+    def queued(self, lane: str) -> int:
+        return len(self.lanes[lane])
+
+    def inflight(self) -> int:
+        return sum(len(v) for v in self.outstanding.values())
+
+    def _sync_gauges(self) -> None:
+        for lane in LANES:
+            self._m_depth[lane].set(len(self.lanes[lane]))
+        self._m_inflight.set(self.inflight())

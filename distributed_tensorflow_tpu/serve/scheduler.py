@@ -87,6 +87,11 @@ class Request:
     #: block exhaustion — engine re-prefills prompt+generated on
     #: re-admission); ``t_admit`` keeps its FIRST admission stamp
     preemptions: int = 0
+    #: SLO tier: block-exhaustion preemption victimizes the LOWEST
+    #: priority resident first (ties: youngest), so a low-priority batch
+    #: lane absorbs cache pressure before interactive traffic. 0 =
+    #: default; all-equal priorities reproduce pure youngest-first.
+    priority: int = 0
     # lifecycle timestamps (scheduler clock), the raw material for the
     # serve latency metrics (docs/observability.md): queue wait =
     # t_admit - t_submit, TTFT = t_first_token - t_submit, per-token
@@ -148,6 +153,7 @@ class Scheduler:
         max_new_tokens: int = 32,
         eos_id: int | None = None,
         deadline_s: float | None = None,
+        priority: int = 0,
     ) -> int:
         """Enqueue a request; returns its uid. Raises ``QueueFull`` when
         ``max_queue`` requests are already waiting (backpressure) and
@@ -175,7 +181,8 @@ class Scheduler:
             )
         now = self.clock()
         req = Request(self._next_uid, prompt, max_new_tokens, eos_id,
-                      deadline_s=deadline_s, t_submit=now)
+                      deadline_s=deadline_s, priority=int(priority),
+                      t_submit=now)
         if deadline_s is not None:
             req.t_deadline = now + deadline_s
         self._next_uid += 1

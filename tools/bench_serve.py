@@ -36,10 +36,23 @@ allocator is back to all-free. ``--parity-check`` additionally gates
 64-token greedy parity of the paged path against the dense fallback on
 the same weights (the ci_fast.sh smoke runs it).
 
+``--fleet N`` lifts the chaos preset to the serve-fleet tier
+(docs/serving.md "Serve fleet"): an OPEN-LOOP trace — seeded arrival
+times, shared-system-prompt prefix groups, interactive/batch lanes —
+driven twice through N in-process replicas (``LocalReplica``) behind
+the router, once with ``policy="prefix"`` and once with the seeded
+random baseline, same trace, same mid-run replica kill (chaos preset).
+Reports per-lane p50/p99 TTFT/TPOT from the ROUTER's registry (client
+clocks, accumulated across the kill and requeues) and the
+routed-vs-random prefix-hit comparison, with gates: every request
+finishes, every surviving replica drains leak-free, and routed
+prefix-reuse strictly beats random.
+
 Usage:
     JAX_PLATFORMS=cpu python tools/bench_serve.py
     python tools/bench_serve.py --preset chaos --requests 24 --json out.json
     python tools/bench_serve.py --dense   # the PR-1 slot-dense cache
+    python tools/bench_serve.py --preset chaos --fleet 3 --requests 24
 """
 
 import argparse
@@ -86,6 +99,174 @@ def _parity_check(cfg, serve, args):
     print("parity-check: 64-step paged == dense", file=sys.stderr)
 
 
+def _fleet_trace(cfg, args, rng):
+    """Seeded open-loop trace: ``(t_arrival, prompt, lane, prefix_len)``
+    rows with the chaos length mix behind per-group shared system
+    prompts. Arrival times are fixed up front — the trace never reacts
+    to completions, which is what makes a queueing tail honest."""
+    from distributed_tensorflow_tpu import serve
+
+    groups = [[rng.randrange(cfg.vocab_size) for _ in range(24)]
+              for _ in range(args.prefix_groups)]
+    long_hi = max(cfg.max_len - 24 - args.max_new - 1, 17)
+    trace, t = [], 0.0
+    for _ in range(args.requests):
+        t += rng.uniform(0.0, 2 * args.arrival_ms / 1e3)
+        g = rng.randrange(len(groups))
+        if rng.random() < 0.6:
+            body = rng.randint(4, 16)
+        else:
+            body = rng.randint(min(40, long_hi), long_hi)
+        prompt = groups[g] + [rng.randrange(cfg.vocab_size)
+                              for _ in range(body)]
+        lane = (serve.LANE_INTERACTIVE if rng.random() < 0.5
+                else serve.LANE_BATCH)
+        trace.append((t, prompt, lane, len(groups[g])))
+    return trace
+
+
+def _run_fleet(cfg, serve, args, trace, policy, kill_after):
+    """Drive one fleet over the trace; kill one busy replica once
+    ``kill_after`` requests have finished (None = no chaos). Returns
+    the per-run report fragment."""
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.obs.registry import Registry
+
+    reg, rec = Registry(), FlightRecorder(capacity=4096)
+    engines = []
+
+    def launch(index, incarnation):
+        eng = serve.ServeEngine.with_random_params(
+            cfg, seed=args.seed, num_slots=args.slots, paged=True,
+            block_size=args.block_size, num_blocks=args.blocks,
+            prefill_chunk=args.prefill_chunk)
+        engines.append(eng)
+        return serve.LocalReplica(eng)
+
+    router = serve.Router(policy=policy, max_outstanding=args.slots,
+                          seed=args.seed, registry=reg, flightrec=rec)
+    sup = serve.ServeFleetSupervisor(
+        launch, args.fleet, router=router, registry=reg, flightrec=rec,
+        sleep=lambda s: None)
+    sup.start()
+
+    t0 = time.perf_counter()
+    i, killed = 0, kill_after is None
+    while i < len(trace) or not router.idle:
+        now = time.perf_counter() - t0
+        while i < len(trace) and trace[i][0] <= now:
+            _, prompt, lane, plen = trace[i]
+            router.submit(prompt, max_new_tokens=args.max_new,
+                          lane=lane, prefix_len=plen)
+            i += 1
+        if not killed and len(router.finished) >= kill_after \
+                and len(sup.replicas) > 1:
+            # prefer a victim with streams in flight: the kill must
+            # cost something, or the requeue path went unexercised
+            busy = [w for w in sorted(sup.replicas)
+                    if router.outstanding.get(w)]
+            victim = busy[0] if busy else min(sup.replicas)
+            sup.replicas[victim].handle.hard_kill()
+            killed = True
+        sup.pump()
+    wall = time.perf_counter() - t0
+    sup.stop()
+
+    from distributed_tensorflow_tpu.obs import goodput
+
+    assert len(router.finished) == args.requests, (
+        f"lost requests: {len(router.finished)}/{args.requests} finished"
+    )
+    leaked = [i for i, d in sup.drained.items() if not d.get("leak_free")]
+    assert not leaked, f"replicas leaked blocks after drain: {leaked}"
+
+    lanes = {}
+    for lane in serve.LANES:
+        n = reg.get("router_ttft_seconds", lane=lane).count
+        if not n:
+            lanes[lane] = None
+            continue
+        ttft = goodput.latency_percentiles_ms(
+            reg, "router_ttft_seconds", lane=lane)
+        row = {"finished": n,
+               "ttft_p50_ms": ttft["p50_ms"], "ttft_p99_ms": ttft["p99_ms"]}
+        if reg.get("router_tpot_seconds", lane=lane).count:
+            tpot = goodput.latency_percentiles_ms(
+                reg, "router_tpot_seconds", lane=lane)
+            row.update(tpot_p50_ms=tpot["p50_ms"],
+                       tpot_p99_ms=tpot["p99_ms"])
+        lanes[lane] = row
+    tokens = sum(len(r.delivered) for r in router.finished.values())
+    return {
+        "policy": policy,
+        "wall_s": round(wall, 3),
+        "generated_tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 1) if wall else None,
+        "lanes": lanes,
+        "requeues": int(reg.get("router_requeues_total").value),
+        "replica_deaths": int(
+            reg.get("serve_replica_deaths_total").value),
+        "router_prefix_hits": int(
+            reg.get("router_prefix_hits_total").value),
+        # ground truth on the engines: blocks actually mapped from the
+        # shared-prefix cache instead of being re-prefilled
+        "engine_prefix_reuse_hits": sum(
+            int(e.registry.get("prefix_reuse_hits_total").value)
+            for e in engines),
+    }
+
+
+def _fleet_bench(cfg, serve, args):
+    from distributed_tensorflow_tpu.obs import scaling
+
+    rng = random.Random(args.seed)
+    trace = _fleet_trace(cfg, args, rng)
+    # compile outside the timed runs: the jitted chunk/decode/copy
+    # programs are cached per shape process-wide, so one throwaway
+    # engine warms every replica of both runs
+    warm = serve.ServeEngine.with_random_params(
+        cfg, seed=args.seed, num_slots=args.slots, paged=True,
+        block_size=args.block_size, num_blocks=args.blocks,
+        prefill_chunk=args.prefill_chunk)
+    wp = [rng.randrange(cfg.vocab_size) for _ in range(2 * args.block_size)]
+    for _ in range(2):
+        warm.submit(wp, max_new_tokens=2)
+        warm.run()
+    warm.drain()
+
+    kill_after = args.requests // 2 if args.preset == "chaos" else None
+    routed = _run_fleet(cfg, serve, args, trace, "prefix", kill_after)
+    rand = _run_fleet(cfg, serve, args, trace, "random", kill_after)
+
+    result = scaling.stamp_provenance({
+        "preset": args.preset,
+        "fleet": args.fleet,
+        "requests": args.requests,
+        "slots": args.slots,
+        "prefix_groups": args.prefix_groups,
+        "arrival_ms": args.arrival_ms,
+        "kill_after": kill_after,
+        "routed": routed,
+        "random": rand,
+        "prefix_hit_advantage": (routed["engine_prefix_reuse_hits"]
+                                 - rand["engine_prefix_reuse_hits"]),
+    })
+    print(json.dumps(result, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+    if args.preset == "chaos" and routed["requeues"] < 1:
+        print("FAIL: chaos kill exercised no requeue", file=sys.stderr)
+        return 1
+    if routed["engine_prefix_reuse_hits"] <= rand["engine_prefix_reuse_hits"]:
+        print(f"FAIL: prefix-aware routing did not beat random "
+              f"({routed['engine_prefix_reuse_hits']} <= "
+              f"{rand['engine_prefix_reuse_hits']} reuse hits)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--requests", type=int, default=16)
@@ -107,7 +288,17 @@ def main(argv=None):
                     help="gate 64-step greedy parity paged vs dense")
     ap.add_argument("--json", type=str, default=None,
                     help="also write the result dict to this path")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="drive N serve replicas behind the router "
+                         "instead of one engine (open-loop trace, "
+                         "routed-vs-random comparison)")
+    ap.add_argument("--prefix-groups", type=int, default=3,
+                    help="shared system prompts in the fleet trace")
+    ap.add_argument("--arrival-ms", type=float, default=2.0,
+                    help="mean interarrival of the open-loop trace")
     args = ap.parse_args(argv)
+    if args.fleet and args.dense:
+        ap.error("--fleet drives paged replicas; drop --dense")
 
     from distributed_tensorflow_tpu import serve
     from distributed_tensorflow_tpu.models import transformer as tfm
@@ -118,6 +309,8 @@ def main(argv=None):
     )
     if args.parity_check:
         _parity_check(cfg, serve, args)
+    if args.fleet:
+        return _fleet_bench(cfg, serve, args)
     eng = _make_engine(cfg, serve, args, args.seed)
 
     rng = random.Random(args.seed)

@@ -40,6 +40,12 @@ machinery is wired at all):
    to world 2, relaunches the slot, and the replacement rejoins at the
    next barrier — zero gang restarts, with `restart_recovery` at least
    10x below the gang-restart baseline (ISSUE 12 acceptance).
+7. **One serve-fleet failover round** (two serve/replica.py
+   subprocesses under ServeFleetSupervisor): one replica is SIGKILLed
+   mid-stream, its in-flight requests requeue at their lane heads and
+   re-prefill on the survivor — every stream finishes, the survivor's
+   drain audit is leak-free, and the corpse (by design) never writes
+   one (ISSUE 16 acceptance).
 
 The fleet and elastic rounds additionally stage every process's
 flight-recorder dump (plus telemetry snapshots and heartbeats) under
@@ -480,6 +486,128 @@ def elastic_round(baseline_rr: float) -> None:
           f"cross-worker timeline at {ELASTIC_MERGED_ARTIFACT})")
 
 
+#: staging/merge artifacts for the serve-fleet round's cross-process gate
+SERVE_FLEET_DUMPS_DIR = os.environ.get(
+    "DTF_SERVE_FLEET_DUMPS",
+    os.path.join(_REPO, "artifacts", "serve_fleet_dumps"))
+SERVE_FLEET_MERGED_ARTIFACT = os.environ.get(
+    "DTF_SERVE_FLEET_MERGED",
+    os.path.join(_REPO, "artifacts", "serve_fleet_merged_postmortem.jsonl"))
+
+#: the CROSS-PROCESS failover story the merged serve-fleet timeline must
+#: tell (shared with ci_fast.sh's --merge gate): the SIGKILL is detected
+#: (serve_replica_dead, fleet clock), the victim's in-flight requests
+#: return to their lane heads (serve_requeue), a SURVIVOR admits a
+#: re-prefilled request (serve_admit, worker clock — aligned through the
+#: serve_route dispatch/ACK handshake), and the fleet closes the
+#: timeline (fleet_done)
+SERVE_FLEET_MERGED_EXPECT = (
+    "serve_replica_dead,serve_requeue,serve_admit,fleet_done")
+
+
+def serve_fleet_round() -> None:
+    """SIGKILL one of two subprocess serve replicas mid-stream
+    (serve/replica.py workers under ServeFleetSupervisor): the
+    supervisor sees the exit, the router requeues the victim's
+    in-flight requests at their lane heads, the survivor re-prefills
+    and finishes EVERY stream — no request lost — and drains leak-free
+    (the terminal block-accounting audit; the corpse never writes one,
+    which is the point). The per-process dumps are staged for the
+    ci_fast merge gate."""
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.obs.registry import Registry
+    from distributed_tensorflow_tpu.serve import fleet as sf
+    from distributed_tensorflow_tpu.serve import router as rt
+
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke_serve_") as d:
+        fleet_dir = os.path.join(d, "fleet")
+        os.makedirs(fleet_dir)
+
+        def launch(i, incarnation):
+            args = [sys.executable, "-m",
+                    "distributed_tensorflow_tpu.serve.replica",
+                    "--workdir", fleet_dir, "--index", str(i),
+                    "--incarnation", str(incarnation),
+                    "--slots", "2", "--seed", "0"]
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            # reviewed: a replica's stdout log stream, not durable state
+            log = open(os.path.join(  # dtflint: disable=atomic-durable-write
+                fleet_dir, f"replica{i}-inc{incarnation}.log"), "w")
+            try:
+                proc = subprocess.Popen(args, stdout=log,
+                                        stderr=subprocess.STDOUT, env=env)
+            finally:
+                log.close()
+            return sf.SubprocessReplica(proc, fleet_dir, i, incarnation)
+
+        rec = FlightRecorder()
+        reg = Registry()
+        router = rt.Router(policy="prefix", max_outstanding=2,
+                           registry=reg, flightrec=rec)
+        sup = sf.ServeFleetSupervisor(
+            launch, 2, router=router, workdir=fleet_dir,
+            registry=reg, flightrec=rec, poll_s=0.02,
+            heartbeat_timeout_s=60.0, stall_timeout_s=600.0,
+            launch_grace_s=180.0, snapshot_poll_s=0.4)
+        sup.start()
+
+        # two shared system prompts so both replicas get a prefix home
+        import random as _random
+        rng = _random.Random(0)
+        groups = [[rng.randrange(256) for _ in range(24)] for _ in range(2)]
+        total = 10
+        for i in range(total):
+            g = groups[i % 2]
+            lane = rt.LANE_INTERACTIVE if i % 2 == 0 else rt.LANE_BATCH
+            router.submit(g + [rng.randrange(256) for _ in range(6)],
+                          max_new_tokens=12, lane=lane, prefix_len=24)
+
+        # pump until a replica is mid-stream (an in-flight request with
+        # delivered tokens), then SIGKILL it
+        import time as _time
+        deadline = _time.monotonic() + 180.0
+        victim = None
+        while victim is None:
+            assert _time.monotonic() < deadline, \
+                "no replica went mid-stream within 180s"
+            sup.pump()
+            for w in sorted(sup.replicas):
+                rids = router.outstanding.get(w, ())
+                if any(router.requests[r].delivered for r in rids):
+                    victim = w
+                    break
+            _time.sleep(0.02)
+        sup.replicas[victim].handle.kill()
+        sup.run()
+        survivors = sorted(sup.replicas)
+        sup.stop(timeout_s=60.0)
+
+        assert len(router.finished) == total, (
+            f"lost requests: {len(router.finished)}/{total}")
+        assert all(r.finish_reason in ("max_new_tokens", "eos")
+                   for r in router.finished.values()), router.finished
+        assert sup.deaths == 1 and victim not in survivors
+        requeues = int(reg.get("router_requeues_total").value)
+        assert requeues >= 1, "kill landed between streams; no requeue"
+        for i in survivors:
+            audit = sup.drained.get(i)
+            assert audit and audit.get("leak_free"), (i, audit)
+        assert victim not in sup.drained  # a corpse never writes the audit
+
+        rec.dump(os.path.join(fleet_dir, "fleet.jsonl"),
+                 reason="chaos_smoke_serve_fleet")
+        _stage_fleet_dumps(
+            fleet_dir, SERVE_FLEET_DUMPS_DIR, SERVE_FLEET_MERGED_ARTIFACT,
+            (SERVE_FLEET_MERGED_EXPECT,),
+            expected_workers=tuple(f"w{i}i0" for i in survivors))
+    print("chaos_smoke: serve replica SIGKILL mid-stream -> requeue at "
+          f"lane head -> survivor re-prefill -> all {total} streams "
+          f"finished, {requeues} requeued, survivors leak-free OK "
+          f"(merged timeline at {SERVE_FLEET_MERGED_ARTIFACT})")
+
+
 def main() -> int:
     scheduler_invariants()
     sigterm_resume_round()
@@ -487,6 +615,7 @@ def main() -> int:
     nan_blame_round()
     baseline_rr = fleet_round()
     elastic_round(baseline_rr)
+    serve_fleet_round()
     return 0
 
 

@@ -43,7 +43,10 @@
 #      (ISSUE 8) + one ELASTIC round: one of 3 workers hard-dies, the
 #      gang shrinks at a barrier instead of stopping, the relaunched
 #      replacement rejoins at the next barrier, and restart_recovery
-#      waste beats the gang-restart baseline by >= 10x (ISSUE 12)
+#      waste beats the gang-restart baseline by >= 10x (ISSUE 12) +
+#      one serve-fleet failover round: a serve replica SIGKILLed
+#      mid-stream, in-flight requests requeued and re-prefilled on the
+#      survivor, every stream finished, survivors leak-free (ISSUE 16)
 #   6. tools/postmortem.py     — flight-recorder gates: the supervised
 #      round's postmortem dump must pass schema validation AND contain
 #      fault → preemption save → restart → quarantine → fallback-restore
@@ -69,6 +72,13 @@
 #      shutdown (the block allocator back to all-free after drain),
 #      and (c) full-batch occupancy under backlog + the one-chunk
 #      starvation bound for resident decoders
+#   7b. tools/postmortem.py --merge — serve-fleet failover gate
+#      (ISSUE 16): chaos_smoke's serve-fleet round SIGKILLs one of two
+#      serve/replica.py subprocesses mid-stream and stages the
+#      per-process dumps under artifacts/serve_fleet_dumps; the merge
+#      aligns replica clocks on the serve_route dispatch/ACK handshake
+#      and asserts replica-dead -> lane-head requeue -> survivor
+#      re-admission -> fleet_done
 #
 # Usage: tools/ci_fast.sh   (extra args are passed to smoke_collect)
 set -euo pipefail
@@ -119,4 +129,14 @@ env JAX_PLATFORMS=cpu python tools/fleet_top.py --once \
   --fleet-dir "${DTF_FLEET_DUMPS:-artifacts/fleet_dumps}" >/dev/null
 env JAX_PLATFORMS=cpu python tools/bench_serve.py --preset chaos \
   --requests 10 --slots 4 --max-new 8 --parity-check >/dev/null
+# serve fleet (ISSUE 16): re-merge the serve-fleet failover round's
+# per-process dumps (router/supervisor + surviving replicas, clocks
+# aligned on the serve_route dispatch/ACK handshake) and gate the
+# failover story: replica dead -> requeue at lane head -> a survivor
+# admits the re-prefilled request -> fleet_done
+env JAX_PLATFORMS=cpu python tools/postmortem.py --merge \
+  "${DTF_SERVE_FLEET_DUMPS:-artifacts/serve_fleet_dumps}"/fleet.jsonl \
+  "${DTF_SERVE_FLEET_DUMPS:-artifacts/serve_fleet_dumps}"/flightrec-w*.jsonl \
+  --out "${DTF_SERVE_FLEET_MERGED:-artifacts/serve_fleet_merged_postmortem.jsonl}" --quiet \
+  --expect 'serve_replica_dead,serve_requeue,serve_admit,fleet_done'
 echo "ci_fast: all gates passed"
