@@ -229,11 +229,13 @@ class Ring:
 ''',
     "closed-vocab": '''\
 class Engine:
-    def __init__(self, flightrec):
+    def __init__(self, flightrec, reqtrace):
         self.flightrec = flightrec
+        self.reqtrace = reqtrace
 
     def poke(self):
         self.flightrec.emit("serve_admit", uid=1, slot=0)
+        self.reqtrace.transition(7, "decode_gap", n=1)
 ''',
     "exception-hygiene": '''\
 import logging

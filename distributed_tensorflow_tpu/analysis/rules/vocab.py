@@ -16,6 +16,12 @@ Checks:
 - ``<flightrec>.emit("<kind>", ...)`` — a literal kind must be in
   ``EVENT_KINDS`` (receivers recognized by the repo's naming idiom:
   ``self.flightrec`` / ``rec`` / ``recorder`` / ``default_recorder()``).
+- ``<reqtrace>.transition(rid, "<phase>", ...)`` — a literal request
+  lifecycle phase must be in ``obs/reqtrace.PHASES`` (receivers by the
+  same idiom: ``self.reqtrace`` / ``reqtrace`` / ``rt`` /
+  ``router_trace`` / ``eng_trace``), and every ``PHASES`` entry must
+  appear in ``docs/observability.md`` — the request-tracing phase
+  table is part of the vocabulary's contract.
 - ``note_wasted("<cause>", ...)`` — a literal cause must be in
   ``WASTE_CAUSES``.
 - registry registrations ``.counter/.gauge/.histogram("<name>", ...)``
@@ -48,6 +54,9 @@ MFU_SITE = "distributed_tensorflow_tpu/obs/goodput.py"
 
 _FLIGHTREC_RECEIVERS = frozenset({"flightrec", "rec", "recorder"})
 
+_REQTRACE_RECEIVERS = frozenset(
+    {"reqtrace", "rt", "router_trace", "eng_trace"})
+
 _DOCS_NAME_RE = re.compile(r"`([A-Za-z_][A-Za-z0-9_:]*)")
 
 
@@ -59,11 +68,16 @@ def _load_vocab(ctx: LintContext) -> dict:
     """Parse the framework vocabularies once per lint run."""
     if "vocab" in ctx.scratch:
         return ctx.scratch["vocab"]
-    vocab = {"event_kinds": None, "waste_causes": None, "docs_names": None}
+    vocab = {"event_kinds": None, "waste_causes": None, "docs_names": None,
+             "phases": None}
 
     src = ctx.read_repo_file("distributed_tensorflow_tpu/obs/flightrec.py")
     if src:
         vocab["event_kinds"] = _string_tuple_constant(src, "EVENT_KINDS")
+
+    src = ctx.read_repo_file("distributed_tensorflow_tpu/obs/reqtrace.py")
+    if src:
+        vocab["phases"] = _string_tuple_constant(src, "PHASES")
 
     src = ctx.read_repo_file("distributed_tensorflow_tpu/obs/goodput.py")
     if src:
@@ -110,6 +124,13 @@ def _is_flightrec_receiver(node: ast.AST) -> bool:
     return dn.rpartition(".")[2] in _FLIGHTREC_RECEIVERS
 
 
+def _is_reqtrace_receiver(node: ast.AST) -> bool:
+    dn = dotted_name(node)
+    if dn is None:
+        return False
+    return dn.rpartition(".")[2] in _REQTRACE_RECEIVERS
+
+
 def _in_package(module: Module, ctx: LintContext) -> bool:
     p = _norm(module.path)
     return ("distributed_tensorflow_tpu/" in p or
@@ -132,6 +153,8 @@ class ClosedVocabRule(Rule):
         in_pkg = _in_package(module, ctx)
         if _norm(module.path).endswith("obs/flightrec.py"):
             ctx.scratch["flightrec_module"] = module.path
+        if _norm(module.path).endswith("obs/reqtrace.py"):
+            ctx.scratch["reqtrace_module"] = module.path
 
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
@@ -158,6 +181,24 @@ class ClosedVocabRule(Rule):
                         f"at runtime; extend the closed vocabulary (and "
                         f"the docs/observability.md event table) to add "
                         f"a kind",
+                    )
+
+            # request-trace lifecycle phases
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "transition" \
+                    and _is_reqtrace_receiver(node.func.value) \
+                    and len(node.args) >= 2:
+                phase = self._literal(node.args[1], constants)
+                phases = vocab["phases"]
+                if phase is not None and phases and phase not in phases:
+                    yield Finding(
+                        self.name, module.path, node.lineno,
+                        node.col_offset,
+                        f"request-trace phase {phase!r} is not in "
+                        f"obs/reqtrace.PHASES — transition() will raise "
+                        f"at runtime; extend the closed vocabulary (and "
+                        f"the docs/observability.md phase table) to add "
+                        f"a phase",
                     )
 
             # goodput waste causes
@@ -233,4 +274,17 @@ class ClosedVocabRule(Rule):
                     f"EVENT_KINDS entry {kind!r} is missing from the "
                     f"docs/observability.md event table — the closed "
                     f"vocabulary and its docs must move together",
+                )
+
+        # every request-trace PHASE documented
+        rt_path = ctx.scratch.get("reqtrace_module")
+        phases = vocab["phases"]
+        if rt_path and phases and docs:
+            for phase in sorted(phases - docs):
+                yield Finding(
+                    self.name, rt_path, 1, 0,
+                    f"PHASES entry {phase!r} is missing from the "
+                    f"docs/observability.md request-tracing phase table "
+                    f"— the closed vocabulary and its docs must move "
+                    f"together",
                 )

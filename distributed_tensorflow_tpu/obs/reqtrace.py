@@ -1,0 +1,577 @@
+"""Request ledger — end-to-end per-request tracing across the serve fleet.
+
+The fleet observatory (obs/fleetview.py) and the serve-fleet metrics
+answer AGGREGATE questions — fleet p99 TTFT, requeue counts, merged
+causal postmortems — but cannot explain one request: when the chaos
+bench reports a bad interactive p99, nothing says whether that tail
+request burned its budget in lane queueing, admission block-wait,
+chunked prefill, preemption, or a death-requeue hop to a survivor.
+
+This module is the per-request causal record. Every request carries its
+router trace id (``rid``) from ``Router.submit`` through dispatch,
+replica ingest, admission, each prefill chunk, decode residency,
+preemption, death-requeue, and finish; each lifecycle transition becomes
+a **span** in a ``ReqTrace`` ledger. A transition *closes* the open span
+and *opens* the next one, so one request's spans form a gap-free,
+overlap-free partition of its wall time by construction — the property
+the tail-attribution report (tools/trace_view.py) relies on: the named
+phase durations of a request SUM to its measured latency, exactly.
+
+The phase vocabulary is CLOSED (``PHASES``): ``transition`` rejects
+unknown phases, and dtflint's ``closed-vocab`` rule checks every literal
+``transition()`` phase statically — the same contract as flightrec's
+``EVENT_KINDS``.
+
+Cross-process merge. Router and replica processes each keep their own
+ledger on their own monotonic clock; ``merge_traces`` aligns them with
+the PR 15 clock-anchor protocol, reusing the ``serve_route``
+dispatch/ACK handshake that already orders the processes: the router's
+``route`` span for ``(rid, requeue)`` opens strictly before the
+replica's ``admission_block`` span for the same pair (dispatch
+happens-before ingest), giving an offset LOWER bound, and the replica
+samples a request's first token strictly before the router delivers it
+(its first ``decode_gap`` span), giving an UPPER bound. The merger takes
+the largest lower bound, so every replica span lands at-or-before its
+true router-clock position and all anchored orderings are preserved —
+one causally consistent per-request timeline even when the request
+hopped processes through a death-requeue.
+
+Dumps follow the flight-recorder discipline: JSONL, one header line
+(schema ``dtf-reqtrace-1``, identity, counts) then one line per request,
+written tmp+fsync+``os.replace`` so a torn dump never looks complete.
+``validate_dump`` is the schema gate (``tools/obs_check.py`` feeds it
+must-fail corpora). Nothing here imports jax — plain stdlib, usable
+from the router's pure-host tests and subprocess replicas alike.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Mapping, Sequence
+
+__all__ = [
+    "PHASES",
+    "SCHEMA",
+    "MERGED_SCHEMA",
+    "ReqTrace",
+    "validate_dump",
+    "load_dump",
+    "merge_traces",
+    "write_merged",
+    "phase_partition",
+    "attribute_window",
+    "span_chain_matches",
+]
+
+#: dump header schema tag — bump when the record layout changes
+SCHEMA = "dtf-reqtrace-1"
+#: merged-trace header schema tag (tools/trace_view.py output)
+MERGED_SCHEMA = "dtf-reqtrace-merged-1"
+
+#: the closed phase vocabulary (docs/observability.md has the table).
+#: Each name is the state a request ENTERS at a lifecycle transition;
+#: the span lasts until the next transition for the same rid.
+PHASES = (
+    "queue_wait",        # submitted (or re-dispatched): waiting in its SLO lane
+    "route",             # dispatch order issued, in flight to the replica
+    "admission_block",   # ingested by the replica, blocked on KV admission
+    "prefill_chunks",    # admitted to a slot, chunked prefill running
+    "decode_gap",        # resident, between delivered decode tokens
+    "preempted",         # evicted to the queue head on block exhaustion
+    "requeue_reprefill", # replica died: requeued for re-prefill on a survivor
+)
+
+_KNOWN_PHASES = frozenset(PHASES)
+#: span keys a transition attr may not shadow
+_RESERVED = frozenset(("rid", "phase", "t0", "t1", "src", "spans"))
+
+
+class ReqTrace:
+    """Lock-protected per-request span ledger for ONE process.
+
+    ``transition`` is the single write path: it stamps the clock
+    *inside* the lock (flightrec's rule — span order is timestamp order
+    even under concurrent emitters), closes the rid's open span at that
+    instant, and opens the next one. The ledger is bounded: when
+    ``capacity`` distinct requests are resident the oldest record is
+    evicted and counted, so a week of serving costs what a smoke test
+    costs.
+    """
+
+    def __init__(self, src: str = "local", capacity: int = 4096,
+                 clock: Callable[[], float] = time.monotonic):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.src = src
+        self.capacity = capacity
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._recs: dict[int, dict] = {}  # rid -> record, insertion order
+        self._dropped = 0
+        self._seq = 0  # bumps on every mutation (dirty tracking for dumpers)
+
+    # -- write -------------------------------------------------------------
+
+    def transition(self, rid: int, phase: str, **attrs: Any) -> None:
+        """Record that request ``rid`` entered ``phase`` now. Closes the
+        rid's open span at the same instant; attrs are free-form
+        JSON-able fields attached to the span being opened."""
+        if phase not in _KNOWN_PHASES:
+            raise ValueError(
+                f"unknown request-trace phase {phase!r} "
+                f"(extend PHASES to add one)")
+        bad = _RESERVED.intersection(attrs)
+        if bad:
+            raise ValueError(f"attrs shadow reserved keys: {sorted(bad)}")
+        with self._lock:
+            t = float(self.clock())  # clock INSIDE the lock
+            rec = self._recs.get(rid)
+            if rec is None:
+                if len(self._recs) >= self.capacity:
+                    oldest = next(iter(self._recs))
+                    del self._recs[oldest]
+                    self._dropped += 1
+                rec = {"rid": int(rid), "spans": [], "finish_reason": None}
+                self._recs[rid] = rec
+            spans = rec["spans"]
+            if spans and spans[-1]["t1"] is None:
+                spans[-1]["t1"] = t
+            span: dict = {"phase": phase, "t0": t, "t1": None}
+            span.update(attrs)
+            spans.append(span)
+            self._seq += 1
+
+    def finish(self, rid: int, reason: str | None = None) -> None:
+        """Close the rid's open span now and mark the record finished.
+        Unknown rids are ignored (a bounded ledger may have evicted the
+        record — the finish must not crash the serving path)."""
+        with self._lock:
+            rec = self._recs.get(rid)
+            if rec is None:
+                return
+            t = float(self.clock())
+            spans = rec["spans"]
+            if spans and spans[-1]["t1"] is None:
+                spans[-1]["t1"] = t
+            rec["finish_reason"] = reason
+            self._seq += 1
+
+    # -- read --------------------------------------------------------------
+
+    @property
+    def seq(self) -> int:
+        """Mutation counter — dumpers compare it to skip clean rewrites."""
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def records(self) -> list[dict]:
+        """Snapshot copy, oldest request first."""
+        with self._lock:
+            return [
+                {**rec, "spans": [dict(s) for s in rec["spans"]]}
+                for rec in self._recs.values()
+            ]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._recs)
+
+    # -- dump --------------------------------------------------------------
+
+    def dump(self, path: str, reason: str = "",
+             extra: Mapping[str, Any] | None = None) -> str:
+        """Write the ledger as JSONL: one header line (schema, identity,
+        counts) then one line per request, oldest first — tmp+fsync+
+        ``os.replace``, the flight-recorder dump discipline, so a
+        replica killed mid-dump leaves the previous trace readable,
+        never a torn one. ``extra`` adds identity fields to the header
+        (``worker``/``incarnation``); core keys win on collision."""
+        records = self.records()
+        with self._lock:
+            dropped = self._dropped
+        header = dict(extra or {})
+        header.update({
+            "schema": SCHEMA,
+            "src": self.src,
+            "reason": reason,
+            "dumped_t": float(self.clock()),
+            "records": len(records),
+            "dropped": dropped,
+            "pid": os.getpid(),
+        })
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header, sort_keys=True, default=repr) + "\n")
+            for rec in records:
+                f.write(json.dumps(rec, sort_keys=True, default=repr) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # a torn dump must not look complete
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Dump validation (shared by tools/trace_view.py, tools/obs_check.py, CI)
+# ---------------------------------------------------------------------------
+
+
+def _check_spans(spans: Any, where: str, failures: list[str]) -> None:
+    if not isinstance(spans, list) or not spans:
+        failures.append(f"{where}: missing/empty spans list")
+        return
+    prev_t1: float | None = None
+    for j, span in enumerate(spans):
+        w = f"{where} span {j}"
+        if not isinstance(span, dict):
+            failures.append(f"{w}: not an object")
+            continue
+        phase = span.get("phase")
+        if phase not in _KNOWN_PHASES:
+            failures.append(f"{w}: unknown phase {phase!r}")
+        t0, t1 = span.get("t0"), span.get("t1")
+        if not isinstance(t0, (int, float)) or isinstance(t0, bool):
+            failures.append(f"{w}: missing/non-numeric t0")
+            continue
+        if t1 is None:
+            # an open span is legal only as the LAST span (a record that
+            # died mid-phase — e.g. on a SIGKILLed replica)
+            if j != len(spans) - 1:
+                failures.append(f"{w}: open span is not last")
+        elif not isinstance(t1, (int, float)) or isinstance(t1, bool):
+            failures.append(f"{w}: non-numeric t1")
+        elif t1 < t0:
+            failures.append(f"{w}: span end {t1} before start {t0}")
+        if prev_t1 is not None and t0 < prev_t1:
+            failures.append(
+                f"{w}: overlaps previous span (t0 {t0} < prev t1 {prev_t1})")
+        if t1 is not None and isinstance(t1, (int, float)) \
+                and not isinstance(t1, bool) and t1 >= t0:
+            prev_t1 = float(t1)
+
+
+def validate_dump(path: str, schema: str = SCHEMA) -> list[str]:
+    """Schema-check a request-trace dump; returns failures (empty ==
+    pass). Checks: header schema tag, record count agreement, per
+    record: int rid, no duplicate rid within the dump, spans a
+    non-empty list of known-phase spans with numeric ``t0 <= t1``, open
+    span only in last position, no overlap between consecutive spans."""
+    failures: list[str] = []
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        return [f"unreadable dump: {e}"]
+    if not lines:
+        return ["empty dump (no header line)"]
+    try:
+        header = json.loads(lines[0])
+    except ValueError as e:
+        return [f"header is not JSON: {e}"]
+    if header.get("schema") != schema:
+        failures.append(f"header schema {header.get('schema')!r} != {schema!r}")
+    n_records = len(lines) - 1
+    if header.get("records") != n_records:
+        failures.append(
+            f"header says {header.get('records')} records, "
+            f"dump has {n_records} (torn dump?)")
+    seen: set[int] = set()
+    for i, line in enumerate(lines[1:], 2):
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            failures.append(f"line {i}: not JSON ({e}) — torn dump?")
+            continue
+        rid = rec.get("rid")
+        if not isinstance(rid, int) or isinstance(rid, bool):
+            failures.append(f"line {i}: missing/non-int rid")
+            continue
+        if rid in seen:
+            failures.append(f"line {i}: duplicate rid {rid} within dump")
+        seen.add(rid)
+        _check_spans(rec.get("spans"), f"line {i} (rid {rid})", failures)
+    return failures
+
+
+def load_dump(path: str) -> tuple[dict, list[dict]]:
+    """Read a validated-shape dump: (header, records). Raises
+    ``ValueError`` on a structurally unusable file — callers wanting
+    soft failures run ``validate_dump`` first."""
+    with open(path) as f:
+        lines = f.read().splitlines()
+    if not lines:
+        raise ValueError(f"{path}: empty dump")
+    header = json.loads(lines[0])
+    records = [json.loads(line) for line in lines[1:]]
+    return header, records
+
+
+# ---------------------------------------------------------------------------
+# Cross-process merge — the clock-anchor protocol, per request
+# ---------------------------------------------------------------------------
+
+
+def _index_lives(records: list[dict], phase: str) -> dict[tuple[int, int], dict]:
+    """Map ``(rid, requeue)`` -> first span of ``phase`` in that
+    request-life. Router lives are keyed by the ``requeue`` attr its
+    ``route`` spans carry; replica lives by the ``requeue`` attr the
+    ingest (``admission_block``) span copied from the payload."""
+    out: dict[tuple[int, int], dict] = {}
+    for rec in records:
+        for span in rec.get("spans", ()):
+            if span.get("phase") != phase:
+                continue
+            key = (rec["rid"], int(span.get("requeue", 0)))
+            out.setdefault(key, span)
+    return out
+
+
+def _first_decode_by_life(records: list[dict]) -> dict[tuple[int, int], float]:
+    """Map ``(rid, requeue)`` -> t0 of the first ``decode_gap`` span
+    following that life's opening span (``route`` on the router side,
+    ``admission_block`` on the replica side)."""
+    out: dict[tuple[int, int], float] = {}
+    for rec in records:
+        life = 0
+        for span in rec.get("spans", ()):
+            phase = span.get("phase")
+            if phase in ("route", "admission_block"):
+                life = int(span.get("requeue", life))
+            elif phase == "decode_gap":
+                out.setdefault((rec["rid"], life), float(span["t0"]))
+    return out
+
+
+def _offset_bounds(router_records: list[dict],
+                   replica_records: list[dict]) -> tuple[float, float, int]:
+    """Offset bounds mapping a replica clock onto the router clock
+    (``t_router = t_replica + off``), from the per-request anchors:
+
+    - dispatch happens-before ingest: the router's ``route`` span for
+      ``(rid, requeue)`` opens before the replica's ``admission_block``
+      span for the same pair → ``off >= t_route - t_ingest`` (low);
+    - sample happens-before delivery: the replica opens a life's first
+      ``decode_gap`` span before the router observes that life's first
+      delivered token → ``off <= t_router_tok - t_replica_tok`` (high).
+
+    Returns ``(lo, hi, n_anchors)``; ``lo`` is ``-inf`` with no anchor.
+    """
+    routes = _index_lives(router_records, "route")
+    ingests = _index_lives(replica_records, "admission_block")
+    lo, n = float("-inf"), 0
+    for key, ingest in ingests.items():
+        route = routes.get(key)
+        if route is None:
+            continue
+        lo = max(lo, float(route["t0"]) - float(ingest["t0"]))
+        n += 1
+    hi = float("inf")
+    router_tok = _first_decode_by_life(router_records)
+    for key, t_rep in _first_decode_by_life(replica_records).items():
+        t_rtr = router_tok.get(key)
+        if t_rtr is not None:
+            hi = min(hi, t_rtr - t_rep)
+    return lo, hi, n
+
+
+#: tie-break rank for transitions landing at the same aligned instant —
+#: causal lifecycle order, so a fake-clock test with coincident stamps
+#: still yields the canonical chain
+_PHASE_RANK = {
+    "queue_wait": 0, "requeue_reprefill": 0, "route": 1,
+    "admission_block": 2, "prefill_chunks": 3, "preempted": 3,
+    "decode_gap": 4,
+}
+
+
+def merge_traces(router_path: str, replica_paths: Sequence[str],
+                 reason: str = "") -> tuple[dict, list[dict], list[str]]:
+    """Merge one router-process trace dump with any number of
+    replica-process dumps into ONE per-request timeline on the router
+    clock. Returns ``(header, merged_records, failures)``; a non-empty
+    failures list means the merge is NOT trustworthy.
+
+    Per replica dump the offset is the largest lower bound over its
+    dispatch→ingest anchors (checked consistent against the
+    sample→delivery upper bounds); aligned replica transitions are then
+    interleaved with the router's, and each request's spans are REBUILT
+    as the partition between consecutive transitions — gap-free and
+    overlap-free by construction, covering submit → finish.
+    """
+    failures: list[str] = []
+    try:
+        router_header, router_records = load_dump(router_path)
+    except (OSError, ValueError) as e:
+        return {}, [], [f"router dump {router_path}: {e}"]
+    if router_header.get("schema") != SCHEMA:
+        failures.append(
+            f"router dump schema {router_header.get('schema')!r} != {SCHEMA!r}")
+
+    # rid -> list of (t_aligned, phase, src, span-attrs)
+    transitions: dict[int, list[tuple[float, str, str, dict]]] = {}
+    finish: dict[int, tuple[float | None, Any]] = {}
+
+    def _add(records: list[dict], src: str, off: float) -> None:
+        for rec in records:
+            rows = transitions.setdefault(rec["rid"], [])
+            for span in rec.get("spans", ()):
+                attrs = {k: v for k, v in span.items()
+                         if k not in ("phase", "t0", "t1")}
+                rows.append(
+                    (float(span["t0"]) + off, span["phase"], src, attrs))
+            if src == "router":
+                last = rec.get("spans") or [{}]
+                t1 = last[-1].get("t1")
+                finish[rec["rid"]] = (
+                    None if t1 is None else float(t1) + off,
+                    rec.get("finish_reason"))
+
+    _add(router_records, "router", 0.0)
+
+    offsets: dict[str, float] = {}
+    seen_src: set[str] = {"router"}
+    for path in replica_paths:
+        fails = validate_dump(path)
+        if fails:
+            failures.extend(f"{path}: {f}" for f in fails)
+            continue
+        header, records = load_dump(path)
+        src = str(header.get("src", path))
+        if src in seen_src:
+            failures.append(f"{path}: source label {src!r} collides")
+            continue
+        seen_src.add(src)
+        lo, hi, n = _offset_bounds(router_records, records)
+        if n == 0:
+            failures.append(
+                f"{path}: no dispatch→ingest anchor pairs the router "
+                f"(cannot align clocks)")
+            continue
+        if lo > hi:
+            failures.append(
+                f"{path}: inconsistent clock anchors (lower bound {lo:.6f} "
+                f"> upper bound {hi:.6f})")
+            continue
+        offsets[src] = lo
+        _add(records, src, lo)
+
+    merged: list[dict] = []
+    for rid in sorted(transitions):
+        rows = sorted(
+            transitions[rid],
+            key=lambda r: (r[0], _PHASE_RANK.get(r[1], 9)))
+        t_end, freason = finish.get(rid, (None, None))
+        if t_end is None:
+            t_end = rows[-1][0]
+        spans = []
+        for i, (t0, phase, src, attrs) in enumerate(rows):
+            t1 = rows[i + 1][0] if i + 1 < len(rows) else t_end
+            span = {"phase": phase, "t0": t0, "t1": max(t1, t0), "src": src}
+            span.update(attrs)
+            spans.append(span)
+        merged.append({"rid": rid, "spans": spans, "finish_reason": freason,
+                       "sources": sorted({r[2] for r in rows})})
+
+    header = {
+        "schema": MERGED_SCHEMA,
+        "reason": reason,
+        "router": router_path,
+        "sources": sorted(seen_src),
+        "offsets": {k: round(v, 9) for k, v in sorted(offsets.items())},
+        "records": len(merged),
+    }
+    return header, merged, failures
+
+
+def write_merged(path: str, header: dict, records: list[dict]) -> str:
+    """Atomically write a merged trace (same JSONL shape as a dump)."""
+    header = dict(header)
+    header["records"] = len(records)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(header, sort_keys=True, default=repr) + "\n")
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True, default=repr) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Attribution arithmetic (tools/trace_view.py, the trace-continuity tests)
+# ---------------------------------------------------------------------------
+
+
+def phase_partition(record: Mapping) -> list[tuple[str, float, float]]:
+    """A record's spans as ``(phase, t0, t1)`` rows; raises
+    ``ValueError`` if they do not partition the request's wall time
+    (a gap or an overlap between consecutive spans)."""
+    rows: list[tuple[str, float, float]] = []
+    prev_t1: float | None = None
+    for span in record.get("spans", ()):
+        t0 = float(span["t0"])
+        t1 = span.get("t1")
+        t1 = t0 if t1 is None else float(t1)
+        if prev_t1 is not None and abs(t0 - prev_t1) > 1e-9:
+            raise ValueError(
+                f"rid {record.get('rid')}: spans do not partition wall time "
+                f"(prev ends {prev_t1}, next starts {t0})")
+        rows.append((str(span["phase"]), t0, t1))
+        prev_t1 = t1
+    return rows
+
+
+def attribute_window(record: Mapping, t_lo: float,
+                     t_hi: float) -> dict[str, float]:
+    """Decompose the window ``[t_lo, t_hi]`` of a request's timeline
+    into per-phase seconds. Because spans partition wall time, the
+    returned values sum to ``t_hi - t_lo`` exactly (up to float
+    rounding) — the tail-attribution soundness property."""
+    out: dict[str, float] = {}
+    for phase, t0, t1 in phase_partition(record):
+        overlap = min(t1, t_hi) - max(t0, t_lo)
+        if overlap > 0:
+            out[phase] = out.get(phase, 0.0) + overlap
+    return out
+
+
+def first_token_t(record: Mapping) -> float | None:
+    """Aligned time the request entered its first ``decode_gap`` span —
+    the TTFT boundary — or None if no token was ever delivered."""
+    for span in record.get("spans", ()):
+        if span.get("phase") == "decode_gap":
+            return float(span["t0"])
+    return None
+
+
+def span_chain_matches(record: Mapping,
+                       specs: Sequence[tuple[str, Mapping[str, Any]] | str],
+                       ) -> bool:
+    """True when the record's span sequence (plus a virtual terminal
+    ``finish`` entry carrying ``reason``) contains a subsequence
+    matching ``specs`` — each a phase name or ``(phase, {attr: value})``
+    with attrs compared as strings (flightrec's ``contains_in_order``
+    contract, applied to one request's lifecycle)."""
+    entries: list[dict] = [dict(s) for s in record.get("spans", ())]
+    if record.get("finish_reason") is not None:
+        entries.append({"phase": "finish",
+                        "reason": record["finish_reason"]})
+    it = iter(entries)
+    for spec in specs:
+        phase, attrs = (spec, {}) if isinstance(spec, str) else spec
+        for e in it:
+            if e.get("phase") != phase:
+                continue
+            if all(str(e.get(k)) == str(v) for k, v in attrs.items()):
+                break
+        else:
+            return False
+    return True

@@ -113,6 +113,7 @@ class ServeEngine:
         registry: Registry | None = None,
         clock: Callable[[], float] = time.perf_counter,
         flightrec=None,
+        reqtrace=None,
     ):
         if not cfg.causal:
             raise ValueError("ServeEngine requires a causal (decoder) model")
@@ -177,9 +178,13 @@ class ServeEngine:
         # engine's drain event, so the postmortem timeline interleaves
         self.flightrec = (flightrec if flightrec is not None
                           else flightrec_lib.default_recorder())
+        #: per-request span ledger (obs/reqtrace.py) shared with the
+        #: scheduler; None = untraced. Only requests that entered with a
+        #: router trace id (rid) emit spans — direct submissions don't.
+        self.reqtrace = reqtrace
         self.sched = Scheduler(
             num_slots, M, clock=clock, max_queue=max_queue,
-            flightrec=self.flightrec,
+            flightrec=self.flightrec, reqtrace=reqtrace,
             admission_gate=self._admission_gate if paged else None,
         )
         self.temperature = temperature
@@ -265,13 +270,16 @@ class ServeEngine:
         eos_id: int | None = None,
         deadline_s: float | None = None,
         priority: int = 0,
+        rid: int | None = None,
     ) -> int:
         """Enqueue a request (raises ``scheduler.QueueFull`` under
         backpressure, ``scheduler.SchedulerClosed`` after drain).
         Higher ``priority`` residents are preempted LAST on block
-        exhaustion (the serve fleet's lane tiering rides on this)."""
+        exhaustion (the serve fleet's lane tiering rides on this);
+        ``rid`` carries the router trace id into the request ledger."""
         return self.sched.submit(prompt, max_new_tokens, eos_id,
-                                 deadline_s=deadline_s, priority=priority)
+                                 deadline_s=deadline_s, priority=priority,
+                                 rid=rid)
 
     def cancel(self, uid: int) -> bool:
         """Cancel a queued or in-flight request (``FINISH_CANCELLED``);
@@ -583,6 +591,12 @@ class ServeEngine:
         self._m_chunks.inc()
         self.flightrec.emit("serve_prefill_chunk", uid=req.uid, slot=slot,
                             start=start, n=end - start)
+        if self.reqtrace is not None and req.rid is not None:
+            # one span per chunk: the waterfall shows where a long
+            # prompt's prefill interleaved with the residents' decode
+            self.reqtrace.transition(req.rid, "prefill_chunks",
+                                     uid=req.uid, slot=slot,
+                                     start=start, n=end - start)
         self._written[slot] = end
         if end < T:
             self._pending[slot] = end
@@ -601,6 +615,12 @@ class ServeEngine:
             )
         )
         self._last[slot] = tok
+        if self.reqtrace is not None and req.rid is not None:
+            # prefill complete, first token of this residency sampled —
+            # the request enters decode; this is also the replica-side
+            # half of the sample→delivery clock anchor (the router's
+            # matching decode_gap span opens strictly later)
+            self.reqtrace.transition(req.rid, "decode_gap", uid=req.uid)
         self._deliver(slot, tok, stats)
 
     def _observe_finish(self, req: Request, stats: StepStats | None) -> None:
@@ -674,6 +694,8 @@ class ServeEngine:
         )
         self._written[slot] = P
         self._last[slot] = tok
+        if self.reqtrace is not None and req.rid is not None:
+            self.reqtrace.transition(req.rid, "decode_gap", uid=req.uid)
         self._deliver(slot, tok, stats)
 
     def _do_decode(self, active: list[int], stats: StepStats) -> None:

@@ -117,7 +117,17 @@ class EngineBridge:
         self._sent: dict[int, int] = {}        # rid -> tokens reported
 
     def accept(self, payload: dict) -> None:
-        self._pending.append(dict(payload))
+        payload = dict(payload)
+        rt = getattr(self.engine, "reqtrace", None)
+        if rt is not None and "rid" in payload:
+            # ingest span: opens when the order reaches the replica,
+            # closes when the scheduler admits it to a slot. Its t0 is
+            # the replica-side half of the dispatch→ingest clock anchor
+            # (the router's ``route`` span is the other half), keyed by
+            # (rid, requeue) so each life aligns independently.
+            rt.transition(int(payload["rid"]), "admission_block",
+                          requeue=int(payload.get("requeues", 0)))
+        self._pending.append(payload)
 
     @property
     def busy(self) -> bool:
@@ -141,6 +151,7 @@ class EngineBridge:
                 payload["prompt"], payload["max_new_tokens"],
                 eos_id=payload.get("eos_id"),
                 priority=int(payload.get("priority", 0)),
+                rid=payload.get("rid"),
             )
         except QueueFull:
             return False

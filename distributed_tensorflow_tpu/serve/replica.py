@@ -65,6 +65,7 @@ def main(argv=None) -> int:
     from distributed_tensorflow_tpu.obs import fleetview
     from distributed_tensorflow_tpu.obs import flightrec as fr
     from distributed_tensorflow_tpu.obs.registry import default_registry
+    from distributed_tensorflow_tpu.obs.reqtrace import ReqTrace
     from distributed_tensorflow_tpu.resilience import liveness
     from distributed_tensorflow_tpu.serve import fleet as serve_fleet
     from distributed_tensorflow_tpu.serve.engine import ServeEngine
@@ -85,10 +86,15 @@ def main(argv=None) -> int:
         vocab_size=256, max_len=128, num_layers=2, d_model=64, num_heads=4,
         d_ff=128, dropout=0.0, dtype="float32", causal=True, pre_ln=True,
     )
+    # this replica's half of the request ledger (obs/reqtrace.py): one
+    # span record per rid this incarnation served; src carries the
+    # (worker, incarnation) identity into the merged timeline
+    reqtrace = ReqTrace(src=f"w{args.index}i{args.incarnation}")
     engine = ServeEngine.with_random_params(
         cfg, seed=args.seed, num_slots=args.slots, paged=True,
         block_size=args.block_size, num_blocks=args.blocks,
-        prefill_chunk=args.prefill_chunk, registry=default_registry())
+        prefill_chunk=args.prefill_chunk, registry=default_registry(),
+        reqtrace=reqtrace)
     bridge = serve_fleet.EngineBridge(engine)
 
     inbox = serve_fleet.replica_inbox_dir(args.workdir, args.index)
@@ -118,6 +124,24 @@ def main(argv=None) -> int:
         rec.dump(path, reason="serve_replica_exit",
                  extra={"worker": args.index,
                         "incarnation": args.incarnation})
+
+    trace_path = os.path.join(
+        os.path.abspath(os.path.expanduser(args.workdir)),
+        f"reqtrace-w{args.index}i{args.incarnation}.jsonl")
+    trace_seq = {"dumped": -1}
+
+    def dump_reqtrace(reason: str) -> None:
+        """Atomically (re)write this incarnation's trace dump when the
+        ledger changed. Called BEFORE token events are appended to the
+        events stream, so any token the router observed has its trace
+        transitions already durable — a SIGKILLed victim's spans for the
+        killed request survive in its last dump."""
+        if reqtrace.seq == trace_seq["dumped"]:
+            return
+        trace_seq["dumped"] = reqtrace.seq
+        reqtrace.dump(trace_path, reason=reason,
+                      extra={"worker": args.index,
+                             "incarnation": args.incarnation})
 
     tokens_out = 0
     with open(events_path, "a") as out:  # append-only event stream
@@ -153,7 +177,9 @@ def main(argv=None) -> int:
                 bridge.accept(payload)
                 os.remove(path)
             busy = bridge.busy
-            emit(bridge.pump())
+            events = bridge.pump()
+            dump_reqtrace("serve_replica_pump")  # durable before emit
+            emit(events)
             writer.beat(step=tokens_out)
             try:
                 exporter.export(step=tokens_out)
@@ -162,7 +188,9 @@ def main(argv=None) -> int:
                                  args.index)
             if not busy:
                 time.sleep(args.idle_sleep_s)
-        emit(bridge.drain())
+        events = bridge.drain()
+        dump_reqtrace("serve_replica_drain")
+        emit(events)
     try:
         exporter.export(step=tokens_out, force=True)
     except OSError:

@@ -128,6 +128,10 @@ class FleetRequest:
             "eos_id": self.eos_id,
             "priority": LANE_PRIORITY[self.lane],
             "lane": self.lane,
+            # which dispatch generation this order belongs to — the
+            # replica's ingest span copies it, making (rid, requeue) the
+            # pair key the request-ledger clock alignment anchors on
+            "requeues": self.requeues,
         }
 
 
@@ -145,7 +149,8 @@ class Router:
     def __init__(self, *, policy: str = "prefix",
                  max_outstanding: int = 4, seed: int = 0,
                  registry: Registry | None = None, flightrec=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 reqtrace=None):
         if policy not in ("prefix", "random"):
             raise ValueError(f"unknown placement policy {policy!r}")
         if max_outstanding < 1:
@@ -159,6 +164,9 @@ class Router:
         self._rng = random.Random(seed)  # seeded: placement is replayable
         self.flightrec = (flightrec if flightrec is not None
                           else flightrec_lib.default_recorder())
+        #: per-request span ledger (obs/reqtrace.py), None = untraced —
+        #: the router-process side of the end-to-end request trace
+        self.reqtrace = reqtrace
         r = registry if registry is not None else default_registry()
         self.registry = r
         self.lanes: dict[str, deque[FleetRequest]] = {
@@ -237,6 +245,8 @@ class Router:
         self.requests[req.rid] = req
         self.lanes[lane].append(req)
         self._m_requests[lane].inc()
+        if self.reqtrace is not None:
+            self.reqtrace.transition(req.rid, "queue_wait", lane=lane)
         self._sync_gauges()
         return req.rid
 
@@ -282,6 +292,12 @@ class Router:
                     hit=bool(req.prefix_len
                              and self._prefix_home.get(req.prefix) == target
                              and not self._fresh_pin))
+                if self.reqtrace is not None:
+                    # requeue attr = dispatch generation: pairs this span
+                    # with the replica's ingest span for clock alignment
+                    self.reqtrace.transition(
+                        req.rid, "route", replica=target, lane=lane,
+                        requeue=req.requeues)
                 orders.append((target, req))
         self._sync_gauges()
         return orders
@@ -321,6 +337,11 @@ class Router:
             req.t_first_token = self.clock()
             self._m_ttft[req.lane].observe(req.t_first_token - req.t_submit)
         req.delivered.append(int(token))
+        if self.reqtrace is not None:
+            # one span per delivered token: the gaps between them ARE
+            # the client-visible decode cadence (TPOT attribution)
+            self.reqtrace.transition(rid, "decode_gap",
+                                     n=len(req.delivered))
 
     def on_finish(self, rid: int, reason: str) -> None:
         """The replica evicted the request as finished."""
@@ -334,6 +355,8 @@ class Router:
             self._m_tpot[req.lane].observe(
                 (req.t_finish - req.t_first_token)
                 / (len(req.delivered) - 1))
+        if self.reqtrace is not None:
+            self.reqtrace.finish(rid, reason)
         self.finished[rid] = req
         self._sync_gauges()
 
@@ -353,6 +376,10 @@ class Router:
             self.flightrec.emit(
                 "serve_requeue", rid=rid, lane=req.lane, replica=replica,
                 delivered=len(req.delivered))
+            if self.reqtrace is not None:
+                self.reqtrace.transition(
+                    rid, "requeue_reprefill", replica=replica,
+                    delivered=len(req.delivered), cause="replica_dead")
         for lane, reqs in per_lane.items():
             # extendleft reverses, so feed it reversed dispatch order:
             # the queue head ends up [oldest, ..., newest, prior queue]

@@ -100,6 +100,12 @@ class Request:
     t_admit: float | None = None
     t_first_token: float | None = None
     t_finish: float | None = None
+    #: router trace id (serve fleet): set when the request entered
+    #: through a Router, None for direct engine submissions. Carried so
+    #: the replica-side request ledger (obs/reqtrace.py) records this
+    #: process's admission/prefill/preemption spans under the SAME id
+    #: the router traces — the key the cross-process merge joins on.
+    rid: int | None = None
 
     @property
     def done(self) -> bool:
@@ -113,7 +119,8 @@ class Scheduler:
     def __init__(self, num_slots: int, max_len: int,
                  clock: Callable[[], float] = time.perf_counter,
                  max_queue: int | None = None, flightrec=None,
-                 admission_gate: Callable[[Request], bool] | None = None):
+                 admission_gate: Callable[[Request], bool] | None = None,
+                 reqtrace=None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if max_queue is not None and max_queue < 1:
@@ -132,6 +139,9 @@ class Scheduler:
         #: (obs/flightrec.py — stdlib-only, so this stays jax-free)
         self.flightrec = (flightrec if flightrec is not None
                           else flightrec_lib.default_recorder())
+        #: per-request span ledger (obs/reqtrace.py), None = untraced.
+        #: Only rid-carrying requests (router traffic) emit spans.
+        self.reqtrace = reqtrace
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * num_slots
         self._next_uid = 0
@@ -154,6 +164,7 @@ class Scheduler:
         eos_id: int | None = None,
         deadline_s: float | None = None,
         priority: int = 0,
+        rid: int | None = None,
     ) -> int:
         """Enqueue a request; returns its uid. Raises ``QueueFull`` when
         ``max_queue`` requests are already waiting (backpressure) and
@@ -182,7 +193,7 @@ class Scheduler:
         now = self.clock()
         req = Request(self._next_uid, prompt, max_new_tokens, eos_id,
                       deadline_s=deadline_s, priority=int(priority),
-                      t_submit=now)
+                      t_submit=now, rid=rid)
         if deadline_s is not None:
             req.t_deadline = now + deadline_s
         self._next_uid += 1
@@ -207,6 +218,12 @@ class Scheduler:
                 self.slots[slot] = req
                 placed.append((slot, req))
                 self.flightrec.emit("serve_admit", uid=req.uid, slot=slot)
+                if self.reqtrace is not None and req.rid is not None:
+                    # admission ends the block-wait: the request enters
+                    # its (chunked) prefill phase in this slot
+                    self.reqtrace.transition(
+                        req.rid, "prefill_chunks", uid=req.uid, slot=slot,
+                        preemptions=req.preemptions)
         return placed
 
     # -- eviction beyond token-driven finish -------------------------------
@@ -219,6 +236,8 @@ class Scheduler:
         req.t_finish = self.clock() if now is None else now
         self.finished[req.uid] = req
         self.flightrec.emit("serve_evict", uid=req.uid, reason=reason)
+        if self.reqtrace is not None and req.rid is not None:
+            self.reqtrace.finish(req.rid, reason)
 
     def cancel(self, uid: int) -> Request | None:
         """Evict ``uid`` with ``FINISH_CANCELLED`` wherever it lives —
@@ -252,6 +271,9 @@ class Scheduler:
         req.preemptions += 1
         self.queue.appendleft(req)
         self.flightrec.emit("serve_preempt", uid=req.uid, slot=slot)
+        if self.reqtrace is not None and req.rid is not None:
+            self.reqtrace.transition(req.rid, "preempted", uid=req.uid,
+                                     slot=slot)
         return req
 
     def expire(self) -> list[Request]:

@@ -1,0 +1,193 @@
+"""Perf-regression sentinel (ISSUE 17): tools/bench_trend.py detects
+regressions in both metric directions over provenance-stamped bench
+JSONs and REFUSES (exit 2) to compare runs whose provenance is missing
+or disagrees on platform/device_kind — a cross-platform delta is a
+category error, not a regression."""
+
+import json
+
+import pytest
+
+from tools import bench_trend as bt
+
+
+def bench_json(tmp_path, name, metrics, *, platform="cpu",
+               device_kind="cpu", git_sha="abc123", provenance=True):
+    payload = dict(metrics)
+    if provenance:
+        payload["provenance"] = {
+            "platform": platform, "device_kind": device_kind,
+            "git_sha": git_sha, "backend": platform,
+        }
+        if git_sha is None:
+            del payload["provenance"]["git_sha"]
+    path = tmp_path / name
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# direction inference
+# ---------------------------------------------------------------------------
+
+
+def test_direction_inferred_from_metric_leaf():
+    assert bt.lower_is_better("ttft_p99_ms")
+    assert bt.lower_is_better("routed.lanes.interactive.ttft_p99_ms")
+    assert bt.lower_is_better("wall_s")
+    assert bt.lower_is_better("queue_wait_seconds")
+    assert not bt.lower_is_better("tokens_per_sec")
+    assert not bt.lower_is_better("prefix_hits")
+
+
+def test_parse_metric_override_and_bad_direction():
+    assert bt.parse_metric("x.tokens_per_sec") == ("x.tokens_per_sec", False)
+    assert bt.parse_metric("score_ms:higher") == ("score_ms", False)
+    assert bt.parse_metric("throughput:lower") == ("throughput", True)
+    with pytest.raises(ValueError):
+        bt.parse_metric("x:sideways")
+
+
+def test_lookup_dotted_paths():
+    d = {"routed": {"lanes": {"interactive": {"ttft_p99_ms": 7.0}}}}
+    assert bt.lookup(d, "routed.lanes.interactive.ttft_p99_ms") == 7.0
+    assert bt.lookup(d, "routed.lanes.batch.ttft_p99_ms") is None
+    assert bt.lookup(d, "nope") is None
+
+
+# ---------------------------------------------------------------------------
+# trend verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_latency_regression_fails(tmp_path):
+    old = bench_json(tmp_path, "old.json", {"ttft_p99_ms": 10.0},
+                     git_sha="aaa")
+    new = bench_json(tmp_path, "new.json", {"ttft_p99_ms": 15.0},
+                     git_sha="bbb")
+    assert bt.main([old, new, "--metric", "ttft_p99_ms",
+                    "--max-regress-pct", "10"]) == 1
+
+
+def test_throughput_regression_fails_and_latency_drop_passes(tmp_path):
+    old = bench_json(tmp_path, "old.json",
+                     {"tokens_per_sec": 100.0, "ttft_p99_ms": 10.0},
+                     git_sha="aaa")
+    new = bench_json(tmp_path, "new.json",
+                     {"tokens_per_sec": 80.0, "ttft_p99_ms": 8.0},
+                     git_sha="bbb")
+    # throughput fell 20%: regression
+    assert bt.main([old, new, "--metric", "tokens_per_sec"]) == 1
+    # latency fell 20%: improvement
+    assert bt.main([old, new, "--metric", "ttft_p99_ms"]) == 0
+    # both, within a huge budget: ok
+    assert bt.main([old, new, "--metric", "tokens_per_sec",
+                    "--metric", "ttft_p99_ms",
+                    "--max-regress-pct", "50"]) == 0
+
+
+def test_direction_override_flips_verdict(tmp_path):
+    old = bench_json(tmp_path, "old.json", {"score_ms": 10.0}, git_sha="a")
+    new = bench_json(tmp_path, "new.json", {"score_ms": 20.0}, git_sha="b")
+    assert bt.main([old, new, "--metric", "score_ms"]) == 1  # _ms: lower
+    assert bt.main([old, new, "--metric", "score_ms:higher"]) == 0
+
+
+def test_three_run_trend_compares_first_to_last(tmp_path):
+    runs = [bench_json(tmp_path, f"r{i}.json", {"ttft_p99_ms": v},
+                       git_sha=f"sha{i}")
+            for i, v in enumerate([10.0, 30.0, 10.5])]
+    # the middle spike does not matter; first->last is +5%
+    assert bt.main(runs + ["--metric", "ttft_p99_ms"]) == 0
+
+
+def test_missing_metric_and_zero_baseline_fail(tmp_path):
+    old = bench_json(tmp_path, "old.json", {"a_ms": 0.0}, git_sha="a")
+    new = bench_json(tmp_path, "new.json", {"a_ms": 5.0}, git_sha="b")
+    assert bt.main([old, new, "--metric", "b_ms"]) == 1
+    assert bt.main([old, new, "--metric", "a_ms"]) == 1  # no trend from 0
+
+
+# ---------------------------------------------------------------------------
+# the provenance refusal gate (exit 2, BEFORE any metric math)
+# ---------------------------------------------------------------------------
+
+
+def test_refuses_unstamped_run(tmp_path):
+    old = bench_json(tmp_path, "old.json", {"ttft_p99_ms": 10.0},
+                     git_sha="aaa")
+    new = bench_json(tmp_path, "new.json", {"ttft_p99_ms": 1.0},
+                     provenance=False)
+    # the candidate IMPROVED — refused anyway: unstamped is uncomparable
+    assert bt.main([old, new, "--metric", "ttft_p99_ms"]) == 2
+
+
+def test_refuses_run_without_git_sha(tmp_path):
+    old = bench_json(tmp_path, "old.json", {"ttft_p99_ms": 10.0},
+                     git_sha="aaa")
+    new = bench_json(tmp_path, "new.json", {"ttft_p99_ms": 10.0},
+                     git_sha=None)
+    assert bt.main([old, new, "--metric", "ttft_p99_ms"]) == 2
+
+
+def test_refuses_cross_platform_comparison(tmp_path):
+    old = bench_json(tmp_path, "old.json", {"tokens_per_sec": 100.0},
+                     platform="tpu", device_kind="TPU v5", git_sha="aaa")
+    new = bench_json(tmp_path, "new.json", {"tokens_per_sec": 10.0},
+                     platform="cpu", device_kind="cpu", git_sha="bbb")
+    assert bt.main([old, new, "--metric", "tokens_per_sec"]) == 2
+
+
+def test_refuses_device_kind_disagreement(tmp_path):
+    old = bench_json(tmp_path, "old.json", {"tokens_per_sec": 100.0},
+                     platform="tpu", device_kind="TPU v4", git_sha="aaa")
+    new = bench_json(tmp_path, "new.json", {"tokens_per_sec": 100.0},
+                     platform="tpu", device_kind="TPU v5", git_sha="bbb")
+    assert bt.main([old, new, "--metric", "tokens_per_sec"]) == 2
+
+
+def test_differing_git_sha_is_the_comparison_axis_not_a_refusal(tmp_path):
+    old = bench_json(tmp_path, "old.json", {"tokens_per_sec": 100.0},
+                     git_sha="aaa")
+    new = bench_json(tmp_path, "new.json", {"tokens_per_sec": 101.0},
+                     git_sha="bbb")
+    assert bt.main([old, new, "--metric", "tokens_per_sec"]) == 0
+
+
+def test_refuses_unreadable_json(tmp_path):
+    old = bench_json(tmp_path, "old.json", {"a_ms": 1.0}, git_sha="a")
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert bt.main([old, str(bad), "--metric", "a_ms"]) == 2
+    assert bt.main([old, str(tmp_path / "absent.json"),
+                    "--metric", "a_ms"]) == 2
+
+
+def test_refuses_bad_direction_suffix(tmp_path):
+    old = bench_json(tmp_path, "old.json", {"a_ms": 1.0}, git_sha="a")
+    new = bench_json(tmp_path, "new.json", {"a_ms": 1.0}, git_sha="b")
+    assert bt.main([old, new, "--metric", "a_ms:sideways"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end against real bench_serve --fleet --json output shape
+# ---------------------------------------------------------------------------
+
+
+def test_provenance_check_matches_stamp_provenance_shape(tmp_path):
+    """The gate accepts what obs.scaling.stamp_provenance actually
+    writes (same keys bench_serve/bench stamp with)."""
+    from distributed_tensorflow_tpu.obs.scaling import stamp_provenance
+
+    payload = {"tokens_per_sec": 100.0}
+    stamp_provenance(payload)
+    p1 = tmp_path / "r1.json"
+    p1.write_text(json.dumps(payload))
+    payload2 = {"tokens_per_sec": 99.0}
+    stamp_provenance(payload2)
+    p2 = tmp_path / "r2.json"
+    p2.write_text(json.dumps(payload2))
+    rc = bt.main([str(p1), str(p2), "--metric", "tokens_per_sec"])
+    # same-tree stamps always carry a git_sha here (repo checkout), so
+    # the comparison must proceed and pass (−1% within the 10% budget)
+    assert rc == 0

@@ -278,6 +278,38 @@ def test_interactive_p99_ttft_bounded_through_kill():
     assert kill_p99 <= 3 * base_p99 + 10.0, (kill_p99, base_p99)
 
 
+def test_kill_midstream_cannot_reset_tpot_clock():
+    """ISSUE 17 regression pin: router-side TPOT is measured from the
+    ORIGINAL first delivered token across replica deaths — a mid-stream
+    kill must not reset a request's TPOT clock on the survivor.
+    ``on_token`` stamps ``t_first_token`` only while it is None, and the
+    requeue path must leave it (and ``t_submit``) alone."""
+    cfg = fleet_decoder()
+    trace = shared_prefix_trace(n=6)
+    router, reg, rec, *_ = run_fleet(cfg, trace, kill_after_tokens=3)
+
+    t_requeue = min(e["t"] for e in rec.events()
+                    if e["kind"] == "serve_requeue")
+    crossed = [req for req in router.finished.values()
+               if req.requeues and req.t_first_token is not None]
+    assert crossed, "no killed request had delivered a token"
+    for req in crossed:
+        # the pre-kill stamp survived the survivor's re-prefill...
+        assert req.t_first_token <= t_requeue, (
+            f"rid {req.rid}: t_first_token {req.t_first_token} is AFTER "
+            f"the requeue at {t_requeue} — the TPOT clock was reset")
+        assert req.t_submit < req.t_first_token < req.t_finish
+        # ...and the finish-side observation used it: the per-token
+        # cadence the client saw INCLUDES the re-prefill detour
+        tpot = (req.t_finish - req.t_first_token) / (len(req.delivered) - 1)
+        assert tpot > 0
+    # one TPOT observation per finished multi-token request, none lost
+    finished_multi = sum(1 for req in router.finished.values()
+                         if req.t_first_token is not None
+                         and len(req.delivered) > 1)
+    assert reg.total(rt.ROUTER_TPOT_SECONDS) == finished_multi
+
+
 def test_prefix_routing_beats_random_on_shared_prefix_trace():
     """ISSUE 16 acceptance: routed prefix-hit rate strictly beats the
     seeded random baseline on a shared-system-prompt trace, measured as

@@ -125,26 +125,42 @@ def _fleet_trace(cfg, args, rng):
     return trace
 
 
-def _run_fleet(cfg, serve, args, trace, policy, kill_after):
+def _run_fleet(cfg, serve, args, trace, policy, kill_after,
+               trace_dir=None):
     """Drive one fleet over the trace; kill one busy replica once
     ``kill_after`` requests have finished (None = no chaos). Returns
-    the per-run report fragment."""
+    the per-run report fragment. With ``trace_dir`` every process-role
+    keeps a request ledger (obs/reqtrace.py) — router plus one per
+    replica incarnation — dumped there for tools/trace_view.py."""
     from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
     from distributed_tensorflow_tpu.obs.registry import Registry
+    from distributed_tensorflow_tpu.obs.reqtrace import ReqTrace
 
     reg, rec = Registry(), FlightRecorder(capacity=4096)
     engines = []
+    traces = []  # (filename, ReqTrace) to dump after the run
+
+    router_trace = None
+    if trace_dir is not None:
+        router_trace = ReqTrace(src="router")
+        traces.append(("reqtrace-router.jsonl", router_trace))
 
     def launch(index, incarnation):
+        eng_trace = None
+        if trace_dir is not None:
+            eng_trace = ReqTrace(src=f"w{index}i{incarnation}")
+            traces.append(
+                (f"reqtrace-w{index}i{incarnation}.jsonl", eng_trace))
         eng = serve.ServeEngine.with_random_params(
             cfg, seed=args.seed, num_slots=args.slots, paged=True,
             block_size=args.block_size, num_blocks=args.blocks,
-            prefill_chunk=args.prefill_chunk)
+            prefill_chunk=args.prefill_chunk, reqtrace=eng_trace)
         engines.append(eng)
         return serve.LocalReplica(eng)
 
     router = serve.Router(policy=policy, max_outstanding=args.slots,
-                          seed=args.seed, registry=reg, flightrec=rec)
+                          seed=args.seed, registry=reg, flightrec=rec,
+                          reqtrace=router_trace)
     sup = serve.ServeFleetSupervisor(
         launch, args.fleet, router=router, registry=reg, flightrec=rec,
         sleep=lambda s: None)
@@ -171,6 +187,14 @@ def _run_fleet(cfg, serve, args, trace, policy, kill_after):
         sup.pump()
     wall = time.perf_counter() - t0
     sup.stop()
+
+    if trace_dir is not None:
+        import os
+
+        os.makedirs(trace_dir, exist_ok=True)
+        for name, rt in traces:
+            rt.dump(os.path.join(trace_dir, name),
+                    reason=f"bench_serve_{policy}")
 
     from distributed_tensorflow_tpu.obs import goodput
 
@@ -235,7 +259,10 @@ def _fleet_bench(cfg, serve, args):
     warm.drain()
 
     kill_after = args.requests // 2 if args.preset == "chaos" else None
-    routed = _run_fleet(cfg, serve, args, trace, "prefix", kill_after)
+    # only the routed (headline) run is traced: the random baseline is
+    # a comparison control, not a latency story anyone debugs
+    routed = _run_fleet(cfg, serve, args, trace, "prefix", kill_after,
+                        trace_dir=args.trace)
     rand = _run_fleet(cfg, serve, args, trace, "random", kill_after)
 
     result = scaling.stamp_provenance({
@@ -296,9 +323,15 @@ def main(argv=None):
                     help="shared system prompts in the fleet trace")
     ap.add_argument("--arrival-ms", type=float, default=2.0,
                     help="mean interarrival of the open-loop trace")
+    ap.add_argument("--trace", type=str, default=None, metavar="DIR",
+                    help="with --fleet: dump per-process request-trace "
+                         "ledgers (dtf-reqtrace-1) for the routed run "
+                         "here, for tools/trace_view.py")
     args = ap.parse_args(argv)
     if args.fleet and args.dense:
         ap.error("--fleet drives paged replicas; drop --dense")
+    if args.trace and not args.fleet:
+        ap.error("--trace records the fleet's request ledger; add --fleet")
 
     from distributed_tensorflow_tpu import serve
     from distributed_tensorflow_tpu.models import transformer as tfm

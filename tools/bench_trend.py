@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel over provenance-stamped bench JSONs.
+
+Compares two or more bench result files (the ``--json`` outputs of
+tools/bench_serve.py / bench.py, each carrying the ``provenance`` block
+``obs.scaling.stamp_provenance`` wrote) in the order given — oldest
+first, candidate last — and exits nonzero when a named metric regressed
+by more than the threshold between the first and last run.
+
+Provenance is a precondition, not decoration: a throughput "regression"
+measured on a different platform or device kind is not a regression,
+it is a category error — and a run with no ``git_sha`` cannot be pinned
+to a commit at all. The tool therefore REFUSES to compare (exit 2,
+before any metric math) when:
+
+- a run is missing its ``provenance`` block or its ``git_sha``;
+- runs disagree on ``platform`` or ``device_kind`` (the masquerade
+  guard — the same rule ``validate_scaling_report`` applies inside one
+  report, applied across runs).
+
+``git_sha`` *differing* across runs is fine — that difference is the
+comparison axis.
+
+Metrics are dotted paths into the result dict
+(``routed.lanes.interactive.ttft_p99_ms``, ``tokens_per_sec``).
+Direction is inferred from the name — latency-shaped metrics
+(``*_ms``, ``*_s``, ``*_seconds``, ``wall_s``) regress UP, everything
+else (throughput, counts) regresses DOWN — and can be forced per metric
+with a ``metric:lower`` / ``metric:higher`` suffix naming which
+direction is better.
+
+Usage:
+    python tools/bench_trend.py old.json new.json \
+        --metric tokens_per_sec --metric ttft_p99_ms --max-regress-pct 10
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+#: name suffixes read as "lower is better" (latency/duration shapes)
+_LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds")
+
+
+def lookup(result: dict, path: str):
+    """Resolve a dotted path; returns None when any hop is missing."""
+    node = result
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def lower_is_better(metric: str) -> bool:
+    leaf = metric.rsplit(".", 1)[-1]
+    return leaf.endswith(_LOWER_BETTER_SUFFIXES)
+
+
+def parse_metric(spec: str):
+    """``path`` or ``path:lower`` / ``path:higher`` -> (path, lower?)."""
+    path, _, direction = spec.partition(":")
+    if direction not in ("", "lower", "higher"):
+        raise ValueError(f"bad metric direction {direction!r} in {spec!r} "
+                         f"(want 'lower' or 'higher')")
+    if direction:
+        return path, direction == "lower"
+    return path, lower_is_better(path)
+
+
+def check_provenance(runs) -> list:
+    """The refusal gate: every run pinned to a commit, all runs on one
+    platform/device_kind. Returns failures (empty == comparable)."""
+    failures = []
+    for path, result in runs:
+        prov = result.get("provenance")
+        if not isinstance(prov, dict):
+            failures.append(f"{path}: missing provenance block — "
+                            f"an unstamped bench cannot be compared")
+            continue
+        if not prov.get("git_sha"):
+            failures.append(f"{path}: provenance has no git_sha — "
+                            f"cannot pin this run to a commit")
+    if failures:
+        return failures
+    base_path, base = runs[0]
+    for key in ("platform", "device_kind"):
+        want = base["provenance"].get(key)
+        for path, result in runs[1:]:
+            got = result["provenance"].get(key)
+            if got != want:
+                failures.append(
+                    f"provenance disagreement on {key}: {base_path} ran on "
+                    f"{want!r} but {path} on {got!r} — cross-platform "
+                    f"deltas are not regressions, refusing to compare")
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("runs", nargs="+",
+                    help="bench JSONs, oldest first, candidate last")
+    ap.add_argument("--metric", action="append", default=[],
+                    required=True,
+                    help="dotted path into the result dict, optionally "
+                         "suffixed :lower/:higher (which direction is "
+                         "better); repeatable")
+    ap.add_argument("--max-regress-pct", type=float, default=10.0,
+                    metavar="N", help="fail on a regression worse than "
+                                      "N%% first->last (default 10)")
+    args = ap.parse_args(argv)
+    if len(args.runs) < 2:
+        ap.error("need at least two runs to compare")
+
+    runs = []
+    for path in args.runs:
+        try:
+            with open(path) as f:
+                runs.append((path, json.load(f)))
+        except (OSError, ValueError) as e:
+            print(f"REFUSED: {path}: unreadable bench JSON ({e})",
+                  file=sys.stderr)
+            return 2
+
+    prov_failures = check_provenance(runs)
+    if prov_failures:
+        for f in prov_failures:
+            print(f"REFUSED: {f}", file=sys.stderr)
+        return 2
+    shas = [r["provenance"]["git_sha"] for _, r in runs]
+    print(f"comparing {len(runs)} runs on "
+          f"{runs[0][1]['provenance'].get('platform')}/"
+          f"{runs[0][1]['provenance'].get('device_kind')}: "
+          f"{' -> '.join(str(s)[:12] for s in shas)}")
+
+    failures = []
+    for spec in args.metric:
+        try:
+            metric, lower = parse_metric(spec)
+        except ValueError as e:
+            print(f"REFUSED: {e}", file=sys.stderr)
+            return 2
+        values = [(path, lookup(result, metric)) for path, result in runs]
+        missing = [path for path, v in values
+                   if not isinstance(v, (int, float)) or isinstance(v, bool)]
+        if missing:
+            failures.append(
+                f"{metric}: missing/non-numeric in {missing}")
+            continue
+        first, last = float(values[0][1]), float(values[-1][1])
+        if first == 0:
+            failures.append(f"{metric}: baseline value is 0, no trend")
+            continue
+        # regression % is positive when the candidate moved the WRONG way
+        change = (last - first) / abs(first) * 100.0
+        regress = change if lower else -change
+        trend = " -> ".join(f"{float(v):g}" for _, v in values)
+        verdict = ("REGRESSED" if regress > args.max_regress_pct
+                   else "ok")
+        print(f"  {metric} [{'lower' if lower else 'higher'} is better]: "
+              f"{trend}  ({change:+.1f}%)  {verdict}")
+        if regress > args.max_regress_pct:
+            failures.append(
+                f"{metric}: {'+' if change > 0 else ''}{change:.1f}% "
+                f"first->last exceeds the {args.max_regress_pct:g}% "
+                f"budget ({'lower' if lower else 'higher'} is better)")
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

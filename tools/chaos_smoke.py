@@ -86,7 +86,7 @@ def _stage_fleet_dumps(fleet_dir: str, dumps_dir: str,
     shutil.rmtree(dumps_dir, ignore_errors=True)
     os.makedirs(dumps_dir, exist_ok=True)
     for pattern in ("fleet.jsonl", "flightrec-*.jsonl", "fleetsnap-*.json",
-                    "heartbeat-*.json"):
+                    "heartbeat-*.json", "reqtrace-*.jsonl"):
         for src in glob.glob(os.path.join(fleet_dir, pattern)):
             shutil.copy(src, dumps_dir)
     worker_dumps = sorted(
@@ -516,6 +516,7 @@ def serve_fleet_round() -> None:
     ci_fast merge gate."""
     from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
     from distributed_tensorflow_tpu.obs.registry import Registry
+    from distributed_tensorflow_tpu.obs.reqtrace import ReqTrace
     from distributed_tensorflow_tpu.serve import fleet as sf
     from distributed_tensorflow_tpu.serve import router as rt
 
@@ -544,8 +545,13 @@ def serve_fleet_round() -> None:
 
         rec = FlightRecorder()
         reg = Registry()
+        # router half of the request ledger; each serve/replica.py
+        # worker dumps its own half per pump (reqtrace-w<i>i<k>.jsonl),
+        # so the SIGKILLed victim's spans survive for the merge gate
+        router_trace = ReqTrace(src="router")
         router = rt.Router(policy="prefix", max_outstanding=2,
-                           registry=reg, flightrec=rec)
+                           registry=reg, flightrec=rec,
+                           reqtrace=router_trace)
         sup = sf.ServeFleetSupervisor(
             launch, 2, router=router, workdir=fleet_dir,
             registry=reg, flightrec=rec, poll_s=0.02,
@@ -598,10 +604,18 @@ def serve_fleet_round() -> None:
 
         rec.dump(os.path.join(fleet_dir, "fleet.jsonl"),
                  reason="chaos_smoke_serve_fleet")
+        router_trace.dump(os.path.join(fleet_dir, "reqtrace-router.jsonl"),
+                          reason="chaos_smoke_serve_fleet")
         _stage_fleet_dumps(
             fleet_dir, SERVE_FLEET_DUMPS_DIR, SERVE_FLEET_MERGED_ARTIFACT,
             (SERVE_FLEET_MERGED_EXPECT,),
             expected_workers=tuple(f"w{i}i0" for i in survivors))
+        # the victim's request-ledger half must have survived the
+        # SIGKILL: its per-pump dump is written BEFORE token events
+        # become visible (the ci_fast trace gate merges these)
+        assert os.path.exists(os.path.join(
+            SERVE_FLEET_DUMPS_DIR, f"reqtrace-w{victim}i0.jsonl")), (
+            "SIGKILLed replica left no request-trace dump")
     print("chaos_smoke: serve replica SIGKILL mid-stream -> requeue at "
           f"lane head -> survivor re-prefill -> all {total} streams "
           f"finished, {requeues} requeued, survivors leak-free OK "

@@ -79,6 +79,16 @@
 #      aligns replica clocks on the serve_route dispatch/ACK handshake
 #      and asserts replica-dead -> lane-head requeue -> survivor
 #      re-admission -> fleet_done
+#   7c. tools/trace_view.py — request-ledger gate (ISSUE 17): merge the
+#      same round's per-process request traces (router + both replica
+#      incarnations, including the SIGKILLed victim's surviving
+#      per-pump dump) into ONE per-request timeline, require a killed
+#      request's merged trace to carry the FULL causal chain — submit →
+#      route → admit → prefill → first token → death-requeue → re-route
+#      → re-admit → re-prefill → token → finish — with spans from at
+#      least two distinct replica processes, and render the slowest-k
+#      tail-attribution report (phase durations must sum to measured
+#      TTFT within 1%)
 #
 # Usage: tools/ci_fast.sh   (extra args are passed to smoke_collect)
 set -euo pipefail
@@ -139,4 +149,15 @@ env JAX_PLATFORMS=cpu python tools/postmortem.py --merge \
   "${DTF_SERVE_FLEET_DUMPS:-artifacts/serve_fleet_dumps}"/flightrec-w*.jsonl \
   --out "${DTF_SERVE_FLEET_MERGED:-artifacts/serve_fleet_merged_postmortem.jsonl}" --quiet \
   --expect 'serve_replica_dead,serve_requeue,serve_admit,fleet_done'
+# request ledger (ISSUE 17): one killed request's merged trace must tell
+# the WHOLE story across both replica processes on one aligned timeline,
+# and every slow request's TTFT must decompose into named phases that
+# sum to the measurement
+env JAX_PLATFORMS=cpu python tools/trace_view.py \
+  "${DTF_SERVE_FLEET_DUMPS:-artifacts/serve_fleet_dumps}"/reqtrace-router.jsonl \
+  "${DTF_SERVE_FLEET_DUMPS:-artifacts/serve_fleet_dumps}"/reqtrace-w*.jsonl \
+  --out "${DTF_SERVE_FLEET_TRACE:-artifacts/serve_fleet_trace_merged.jsonl}" \
+  --slowest 3 \
+  --expect 'queue_wait,route,admission_block,prefill_chunks,decode_gap,requeue_reprefill,route,admission_block,prefill_chunks,decode_gap,finish' \
+  --require-replicas 2 >/dev/null
 echo "ci_fast: all gates passed"

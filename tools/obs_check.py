@@ -95,6 +95,7 @@ def check(verbose: bool = True) -> list[str]:
     failures += _check_goodput(reg)
     failures += _check_scaling()
     failures += _check_fleetview()
+    failures += _check_reqtrace()
 
     if verbose:
         print(text, end="")
@@ -412,6 +413,129 @@ def _check_fleetview() -> list[str]:
         _, _, mf = fv.merge_timelines(fleet_dump, [anon_dump])
         if not any("identity" in f for f in mf):
             failures.append(f"merge missed a missing worker identity: {mf}")
+    return failures
+
+
+def _check_reqtrace() -> list[str]:
+    """Request-ledger gate (obs/reqtrace.py): a two-process fake-clock
+    serve story — router + one replica with a skewed clock, a
+    death-requeue hop included — must dump valid ``dtf-reqtrace-1``
+    files, merge into ONE per-request timeline whose spans still
+    partition wall time, and the must-fail corpora — a torn dump, a
+    span ending before it starts, an unknown phase, a duplicate rid —
+    must each be caught. Pure host code: no device, no jax."""
+    import os
+
+    from distributed_tensorflow_tpu.obs import reqtrace as rt
+
+    failures: list[str] = []
+
+    class _Clk:
+        def __init__(self, t):
+            self.t = float(t)
+
+        def __call__(self):
+            return self.t
+
+    with tempfile.TemporaryDirectory(prefix="obs_check_rt_") as d:
+        rclk, wclk = _Clk(100.0), _Clk(900.0)  # 800s apart, same story
+        router = rt.ReqTrace(src="router", clock=rclk)
+        replica = rt.ReqTrace(src="w0i0", clock=wclk)
+
+        # rid 1: submit -> route -> ingest -> admit/prefill -> token ->
+        # death-requeue -> re-route (the chain the serve seams emit)
+        router.transition(1, "queue_wait", lane="interactive")
+        rclk.t = 101.5
+        router.transition(1, "route", replica=0, requeue=0)
+        wclk.t = 901.5  # ingest at the same fake instant as dispatch:
+        # the dispatch->ingest lower bound recovers the skew EXACTLY
+        replica.transition(1, "admission_block", requeue=0)
+        wclk.t = 902.0
+        replica.transition(1, "prefill_chunks", slot=0)
+        wclk.t = 903.0
+        replica.transition(1, "decode_gap")  # replica samples...
+        rclk.t = 103.0
+        router.transition(1, "decode_gap", n=1)  # ...router delivers
+        rclk.t = 104.0
+        router.transition(1, "requeue_reprefill", replica=0, delivered=1)
+        rclk.t = 105.0
+        router.finish(1, "max_new_tokens")
+        try:
+            router.transition(1, "warp_speed")  # dtflint: disable=closed-vocab
+            failures.append("transition accepted an unknown phase")
+        except ValueError:
+            pass
+
+        rp = router.dump(os.path.join(d, "router.jsonl"), "obs_check")
+        wp = replica.dump(os.path.join(d, "w0.jsonl"), "obs_check",
+                          extra={"worker": 0, "incarnation": 0})
+        for p in (rp, wp):
+            for f in rt.validate_dump(p):
+                failures.append(f"reqtrace dump invalid: {f}")
+
+        header, merged, mf = rt.merge_traces(rp, [wp], reason="obs_check")
+        failures.extend(f"consistent traces failed to merge: {m}"
+                        for m in mf)
+        off = header.get("offsets", {}).get("w0i0")
+        if off is None or abs(off - (-800.0)) > 1e-6:
+            failures.append(f"merge recovered offset {off}, want -800.0")
+        if len(merged) != 1 or merged[0]["rid"] != 1:
+            failures.append(f"merged records wrong: {merged}")
+        else:
+            rec = merged[0]
+            if sorted(rec["sources"]) != ["router", "w0i0"]:
+                failures.append(f"merged sources wrong: {rec['sources']}")
+            try:
+                parts = rt.phase_partition(rec)
+                if abs(parts[0][1] - 100.0) > 1e-9 \
+                        or abs(parts[-1][2] - 105.0) > 1e-9:
+                    failures.append(
+                        f"merged timeline bounds wrong: {parts}")
+            except ValueError as e:
+                failures.append(f"merged spans do not partition: {e}")
+            if not rt.span_chain_matches(rec, [
+                    "queue_wait", "route", "admission_block",
+                    "prefill_chunks", "decode_gap", "requeue_reprefill",
+                    ("finish", {"reason": "max_new_tokens"})]):
+                failures.append("merged record lost the causal chain")
+        mp = os.path.join(d, "merged.jsonl")
+        rt.write_merged(mp, header, merged)
+        if rt.load_dump(mp)[0].get("schema") != rt.MERGED_SCHEMA:
+            failures.append("write_merged lost the merged schema tag")
+
+        # the validator must catch what transition() can never produce
+        with open(rp) as f_in:
+            lines = f_in.read().splitlines()
+        ok_rec = json.loads(lines[1])
+
+        def corrupt(name, mutate_lines, needle):
+            bad = os.path.join(d, name)
+            # reviewed: scratch corpus for the validator's must-fail
+            # probes, torn-on-crash is irrelevant (tempdir-only file)
+            with open(bad, "w") as f_out:  # dtflint: disable=atomic-durable-write
+                f_out.write("\n".join(mutate_lines) + "\n")
+            got = rt.validate_dump(bad)
+            if not any(needle in g for g in got):
+                failures.append(
+                    f"validator missed a {needle!r} violation: {got}")
+
+        # torn dump: header claims more records than the file holds
+        corrupt("torn.jsonl", [lines[0]], "torn dump")
+        # span end before start
+        bent = json.loads(lines[1])
+        bent["spans"][0]["t1"] = bent["spans"][0]["t0"] - 1.0
+        corrupt("bent.jsonl", [lines[0], json.dumps(bent)], "before start")
+        # unknown phase
+        alien = json.loads(lines[1])
+        alien["spans"][0]["phase"] = "warp_speed"
+        corrupt("alien.jsonl", [lines[0], json.dumps(alien)],
+                "unknown phase")
+        # duplicate rid within one dump
+        two = json.loads(lines[0])
+        two["records"] = 2
+        corrupt("dup.jsonl",
+                [json.dumps(two), json.dumps(ok_rec), json.dumps(ok_rec)],
+                "duplicate rid")
     return failures
 
 

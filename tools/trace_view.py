@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Render request-ledger dumps as per-request waterfalls and attribute
+tail latency to named lifecycle phases.
+
+Inputs are ``dtf-reqtrace-1`` dumps (obs/reqtrace.py): one from the
+router process (header ``src == "router"``) plus any number of replica
+dumps (``src == w<i>i<k>``). The tool validates every dump, aligns the
+replica clocks onto the router clock with the per-request anchor
+protocol (dispatch happens-before ingest / sample happens-before
+delivery — ``obs.reqtrace.merge_traces``), and rebuilds each request as
+ONE gap-free span timeline, even when a death-requeue hopped it across
+replica processes. A single input whose header carries
+``dtf-reqtrace-merged-1`` is rendered as an already-merged trace.
+
+Outputs:
+
+- a per-rid summary (and with ``--rid`` a full text waterfall);
+- ``--out merged.jsonl`` — the merged trace, atomically written;
+- ``--chrome trace.json`` — Chrome-trace JSON (load in
+  ``chrome://tracing`` / Perfetto; one track per rid);
+- ``--slowest K`` — the tail-attribution report: for the K slowest
+  requests by TTFT, decompose TTFT into per-phase seconds
+  (queue_wait / route / admission_block / prefill_chunks /
+  requeue_reprefill / ...). Because spans partition wall time, the
+  phase durations must sum to the measured TTFT within 1% — the tool
+  FAILS if they do not (a torn or mis-merged trace cannot silently
+  produce a plausible report);
+- ``--expect p1,p2[attr=v],...`` — causal gate (exit 1 on miss): some
+  request's merged lifecycle must contain the phases as a subsequence
+  (``finish[reason=...]`` matches the terminal record). With ``--rid``
+  the gate pins that specific request. ``--require-replicas N``
+  additionally requires the matching request to carry spans from at
+  least N distinct replica processes — the killed-request gate in
+  tools/ci_fast.sh proves the merged trace really spans both lives.
+
+Usage:
+    python tools/trace_view.py router.jsonl replica*.jsonl \
+        --out merged.jsonl --slowest 3 \
+        --expect 'queue_wait,route,admission_block,prefill_chunks' \
+        --require-replicas 2
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+#: relative slack on "phase durations sum to measured latency" — the
+#: acceptance bar; a correct merge is exact up to float rounding
+SUM_TOLERANCE = 0.01
+
+
+def parse_expect(spec: str):
+    """``phase`` or ``phase[attr=v,...]`` items, comma-separated at the
+    top level (tools/postmortem.py's expect grammar, phases for kinds)."""
+    from tools.postmortem import parse_expect as pm_parse
+
+    return pm_parse(spec)
+
+
+def _sources(rec) -> set:
+    srcs = set(rec.get("sources") or ())
+    for span in rec.get("spans", ()):
+        if "src" in span:
+            srcs.add(span["src"])
+    return srcs
+
+
+def _replica_sources(rec) -> set:
+    return {s for s in _sources(rec) if s != "router"}
+
+
+def _span_attrs(span) -> dict:
+    return {k: v for k, v in span.items()
+            if k not in ("phase", "t0", "t1", "src")}
+
+
+def render_waterfall(rec, out=sys.stdout) -> None:
+    """Text waterfall for one request, t=0 at its first transition."""
+    from distributed_tensorflow_tpu.obs import reqtrace as rt
+
+    spans = rec.get("spans", ())
+    if not spans:
+        print(f"rid {rec.get('rid')}: no spans", file=out)
+        return
+    t_base = float(spans[0]["t0"])
+    t_end = max(float(s.get("t1") or s["t0"]) for s in spans)
+    total = max(t_end - t_base, 1e-12)
+    print(f"rid {rec['rid']}  finish={rec.get('finish_reason')}  "
+          f"sources={','.join(sorted(_sources(rec))) or '-'}  "
+          f"total={total:.6f}s", file=out)
+    for span in spans:
+        t0 = float(span["t0"]) - t_base
+        t1 = (float(span["t1"]) - t_base
+              if span.get("t1") is not None else t0)
+        # proportional bar: where in the request's life this span sits
+        width = 32
+        a = int(round(t0 / total * width))
+        b = max(a + 1, int(round(t1 / total * width)))
+        bar = " " * a + "#" * (b - a)
+        attrs = " ".join(f"{k}={v}" for k, v in
+                         sorted(_span_attrs(span).items()))
+        src = f"{span.get('src', ''):<8}"
+        print(f"  t+{t0:9.6f}  {t1 - t0:9.6f}s  |{bar:<{width}}| "
+              f"{src}{span['phase']:<18} {attrs}".rstrip(), file=out)
+    ttft = rt.first_token_t(rec)
+    if ttft is not None:
+        print(f"  ttft={ttft - t_base:.6f}s", file=out)
+
+
+def chrome_trace(records) -> list:
+    """Chrome-trace "X" (complete) events, one track per rid, µs since
+    the earliest transition across all records."""
+    t_base = min((float(s["t0"]) for r in records
+                  for s in r.get("spans", ())), default=0.0)
+    events = []
+    for rec in records:
+        for span in rec.get("spans", ()):
+            t0 = float(span["t0"])
+            t1 = float(span["t1"]) if span.get("t1") is not None else t0
+            args = _span_attrs(span)
+            if span.get("src"):
+                args["src"] = span["src"]
+            events.append({
+                "name": span["phase"], "cat": "reqtrace", "ph": "X",
+                "ts": (t0 - t_base) * 1e6, "dur": (t1 - t0) * 1e6,
+                "pid": 1, "tid": int(rec["rid"]), "args": args,
+            })
+    return events
+
+
+def tail_report(records, k, out=sys.stdout) -> list:
+    """The tail-attribution report: slowest-k by TTFT, each TTFT
+    decomposed into per-phase seconds. Returns failures (a decomposition
+    that does not sum to the measured TTFT within ``SUM_TOLERANCE``)."""
+    from distributed_tensorflow_tpu.obs import reqtrace as rt
+
+    failures = []
+    rows = []
+    for rec in records:
+        spans = rec.get("spans", ())
+        if not spans:
+            continue
+        t_submit = float(spans[0]["t0"])
+        t_first = rt.first_token_t(rec)
+        if t_first is None:
+            continue  # never delivered a token: no TTFT to attribute
+        try:
+            parts = rt.attribute_window(rec, t_submit, t_first)
+        except ValueError as e:
+            failures.append(f"rid {rec['rid']}: {e}")
+            continue
+        rows.append((t_first - t_submit, rec, parts))
+    rows.sort(key=lambda r: -r[0])
+    print(f"slowest {min(k, len(rows))} of {len(rows)} requests by TTFT:",
+          file=out)
+    for ttft, rec, parts in rows[:k]:
+        total = sum(parts.values())
+        if abs(total - ttft) > max(SUM_TOLERANCE * ttft, 1e-9):
+            failures.append(
+                f"rid {rec['rid']}: phase durations sum to {total:.6f}s "
+                f"but measured TTFT is {ttft:.6f}s (>1% apart — torn or "
+                f"mis-merged trace)")
+        breakdown = " ".join(
+            f"{phase}={parts[phase]:.6f}"
+            for phase in sorted(parts, key=parts.get, reverse=True))
+        print(f"  rid {rec['rid']:<5} ttft={ttft:.6f}s  "
+              f"[{','.join(sorted(_replica_sources(rec))) or '-'}]  "
+              f"{breakdown}", file=out)
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("dumps", nargs="+",
+                    help="dtf-reqtrace-1 dumps (one with src=router) or "
+                         "a single dtf-reqtrace-merged-1 file")
+    ap.add_argument("--out", help="write the merged trace here (atomic)")
+    ap.add_argument("--chrome", help="write Chrome-trace JSON here")
+    ap.add_argument("--rid", type=int, default=None,
+                    help="waterfall (and pin --expect to) this request")
+    ap.add_argument("--slowest", type=int, default=0, metavar="K",
+                    help="tail-attribution report for the K slowest "
+                         "requests by TTFT")
+    ap.add_argument("--expect", action="append", default=[],
+                    help="phase chain gate: p1,p2[attr=v],... "
+                         "(repeatable; finish[reason=..] is terminal)")
+    ap.add_argument("--require-replicas", type=int, default=0, metavar="N",
+                    help="the gated request must carry spans from >= N "
+                         "distinct replica processes")
+    args = ap.parse_args(argv)
+
+    from distributed_tensorflow_tpu.obs import reqtrace as rt
+
+    failures = []
+    first_header = {}
+    try:
+        first_header, _ = rt.load_dump(args.dumps[0])
+    except (OSError, ValueError) as e:
+        print(f"FAIL: {args.dumps[0]}: {e}", file=sys.stderr)
+        return 1
+
+    if len(args.dumps) == 1 \
+            and first_header.get("schema") == rt.MERGED_SCHEMA:
+        header, records = rt.load_dump(args.dumps[0])
+    else:
+        routers = []
+        for path in args.dumps:
+            for f in rt.validate_dump(path):
+                failures.append(f"{path}: {f}")
+            try:
+                h, _ = rt.load_dump(path)
+            except (OSError, ValueError):
+                continue
+            if h.get("src") == "router":
+                routers.append(path)
+        if len(routers) != 1:
+            failures.append(
+                f"need exactly one dump with src=router, got {routers}")
+        if failures:
+            for f in failures:
+                print(f"FAIL: {f}", file=sys.stderr)
+            return 1
+        replicas = [p for p in args.dumps if p != routers[0]]
+        header, records, merge_failures = rt.merge_traces(
+            routers[0], replicas, reason="trace_view")
+        failures.extend(merge_failures)
+
+    if args.out and not failures:
+        rt.write_merged(args.out, header, records)
+        print(f"merged trace -> {args.out} "
+              f"({len(records)} requests, offsets "
+              f"{header.get('offsets', {})})")
+    if args.chrome and not failures:
+        tmp = f"{args.chrome}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": chrome_trace(records),
+                       "displayTimeUnit": "ms"}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, args.chrome)
+        print(f"chrome trace -> {args.chrome}")
+
+    by_rid = {rec["rid"]: rec for rec in records}
+    if args.rid is not None:
+        rec = by_rid.get(args.rid)
+        if rec is None:
+            failures.append(f"rid {args.rid} not in the merged trace")
+        else:
+            render_waterfall(rec)
+    else:
+        for rec in records:
+            spans = rec.get("spans", ())
+            dur = (max((float(s.get("t1") or s["t0"])) for s in spans)
+                   - float(spans[0]["t0"])) if spans else 0.0
+            print(f"rid {rec['rid']:<5} spans={len(spans):<4} "
+                  f"finish={rec.get('finish_reason')}  "
+                  f"sources={','.join(sorted(_sources(rec))) or '-'}  "
+                  f"total={dur:.6f}s")
+
+    if args.slowest:
+        failures.extend(tail_report(records, args.slowest))
+
+    gated = ([by_rid[args.rid]]
+             if args.rid is not None and args.rid in by_rid
+             else records)
+    for spec in args.expect:
+        chain = parse_expect(spec)
+        hits = [rec for rec in gated if rt.span_chain_matches(rec, chain)]
+        if args.require_replicas:
+            hits = [rec for rec in hits
+                    if len(_replica_sources(rec)) >= args.require_replicas]
+        if not hits:
+            failures.append(
+                f"no request matches expect chain {spec!r}"
+                + (f" with >= {args.require_replicas} replica sources"
+                   if args.require_replicas else ""))
+        else:
+            print(f"expect ok: {spec!r} matched rid(s) "
+                  f"{sorted(r['rid'] for r in hits)}")
+    if not args.expect and args.require_replicas:
+        hits = [rec for rec in gated
+                if len(_replica_sources(rec)) >= args.require_replicas]
+        if not hits:
+            failures.append(
+                f"no request carries spans from >= "
+                f"{args.require_replicas} replica processes")
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
