@@ -68,6 +68,8 @@ EVENT_KINDS = (
     "eval_end",             # sharded eval pass done        {step, batches}
     # checkpoint lifecycle (train/checkpoint.py)
     "ckpt_save",            # checkpoint written            {step, trigger}
+    "ckpt_async_begin",     # async snapshot enqueued       {step, trigger}
+    "ckpt_async_commit",    # background commit published   {step, seconds}
     "ckpt_restore",         # state restored                {step, fallback}
     "ckpt_quarantine",      # corrupt step condemned        {step, note}
     # retry/backoff (resilience/retry.py)
@@ -101,6 +103,12 @@ EVENT_KINDS = (
     # merged cross-worker timeline aligns on (obs/fleetview.py)
     "elastic_hold",         # worker paused at a resize barrier {step, version}
     "elastic_release",      # worker applied a steady plan  {version, world, barrier, rank}
+    # peer-to-peer joiner catch-up (resilience/fleet.py): a rejoining
+    # worker asks a live survivor for its newest valid step over the
+    # file control plane instead of replaying from its own older ckpt
+    "catchup_offer",        # survivor exported a verified step {step, peer, worker}
+    "catchup_restore",      # joiner imported a peer's step {step, peer, seconds}
+    "catchup_fallback",     # no usable offer within budget {worker, budget_s}
     # fleet telemetry snapshots (obs/fleetview.py)
     "fleetsnap_export",     # worker exported a snapshot    {seq, worker}
     "fleetsnap_merge",      # fleet folded a new snapshot   {worker, seq, pid, incarnation}

@@ -48,6 +48,7 @@ __all__ = [
     "WASTE_RETRY_BACKOFF",
     "WASTE_RESTART_RECOVERY",
     "WASTE_ELASTIC_RESIZE",
+    "WASTE_ASYNC_CKPT",
     "WASTE_CAUSES",
     "note_productive",
     "note_wasted",
@@ -68,9 +69,10 @@ WASTE_COMPILE_WARMUP = "compile_warmup"
 WASTE_RETRY_BACKOFF = "retry_backoff"
 WASTE_RESTART_RECOVERY = "restart_recovery"
 WASTE_ELASTIC_RESIZE = "elastic_resize"
+WASTE_ASYNC_CKPT = "async_checkpoint"
 WASTE_CAUSES = (
     WASTE_COMPILE_WARMUP, WASTE_RETRY_BACKOFF, WASTE_RESTART_RECOVERY,
-    WASTE_ELASTIC_RESIZE,
+    WASTE_ELASTIC_RESIZE, WASTE_ASYNC_CKPT,
 )
 
 

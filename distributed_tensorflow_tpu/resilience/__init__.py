@@ -40,6 +40,7 @@ from .anomaly import (  # noqa: F401
     read_quarantine,
 )
 from .faults import (  # noqa: F401
+    AsyncCommitKill,
     ClockStall,
     CorruptCheckpoint,
     DataError,
@@ -50,6 +51,7 @@ from .faults import (  # noqa: F401
     Hang,
     NaNBatch,
     Sigterm,
+    SlowWriter,
     TransientIOError,
     corrupt_shard,
     truncate_shard,
@@ -69,6 +71,7 @@ from .fleet import (  # noqa: F401
     HeartbeatMonitor,
     HeartbeatWriter,
     WorkerDead,
+    clear_catchup,
     clear_restore_step,
     evict_steps_above,
     heartbeat_path,
@@ -77,6 +80,7 @@ from .fleet import (  # noqa: F401
     read_heartbeat,
     read_incarnation,
     read_restore_step,
+    request_catchup,
     valid_steps,
     write_incarnation,
     write_restore_step,

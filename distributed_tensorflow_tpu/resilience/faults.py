@@ -32,6 +32,16 @@ it drives):
   at the Nth supervisor restart boundary (``FaultPlan.restart_hook``
   seam): the torn-write-discovered-at-restore fault that
   ``Checkpointer.restore(fallback=True)`` must quarantine and fall past.
+- ``AsyncCommitKill(step)`` — SIGKILLs the process from INSIDE the
+  background async-save writer, after the step's shards are on disk but
+  BEFORE the manifest publish/rename (``FaultPlan.save_hook`` seam →
+  ``Checkpointer.save_hooks``): the death-mid-background-write fault the
+  snapshot-then-commit layout must make invisible — the torn write stays
+  in ``.pending/`` and no restore path may land on it.
+- ``SlowWriter(step, delay_s)`` — stalls the background writer at the
+  start of step N's commit (same seam): drives the bounded
+  wait()/close() join, the save-phase heartbeat window, and the
+  retention-vs-slow-writer ordering tests.
 
 Checkpoint corruption is a disk-level fault, not a run-level one, so it
 is a pair of standalone helpers (``truncate_shard`` / ``corrupt_shard``)
@@ -198,8 +208,32 @@ class CorruptCheckpoint:
     nbytes: int = 1
 
 
+@dataclasses.dataclass(frozen=True)
+class AsyncCommitKill:
+    """SIGKILL our own process from the background async-save writer at
+    step >= ``step``, between the shard writes and the manifest
+    publish — the widest torn-write window the snapshot-then-commit
+    layout has (``FaultPlan.save_hook`` seam). The kill is immediate and
+    unhandleable; the staged ``.pending/<step>`` dir must never become
+    restorable."""
+
+    step: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SlowWriter:
+    """Sleep ``delay_s`` inside the background async-save writer before
+    step >= ``step``'s shard writes begin (``FaultPlan.save_hook``
+    seam) — a slow/stuck writer thread as seen by wait()'s bounded
+    join, the heartbeat save-phase window, and retention."""
+
+    step: int
+    delay_s: float = 1.0
+
+
 Fault = (Sigterm | DataError | NaNBatch | ClockStall | Hang
-         | TransientIOError | CorruptCheckpoint)
+         | TransientIOError | CorruptCheckpoint | AsyncCommitKill
+         | SlowWriter)
 
 
 # ---------------------------------------------------------------------------
@@ -256,6 +290,10 @@ class FaultPlan:
                 # fires at the first restart boundary; `at` drawn anyway
                 # so every kind consumes rng state uniformly
                 faults.append(CorruptCheckpoint(restart=1))
+            elif kind == "async_commit_kill":
+                faults.append(AsyncCommitKill(at))
+            elif kind == "slow_writer":
+                faults.append(SlowWriter(at, delay_s=rng.uniform(0.5, 5.0)))
             else:
                 raise ValueError(f"unknown fault kind {kind!r}")
         return cls(tuple(faults))
@@ -292,6 +330,51 @@ class FaultPlan:
                     "(step %d) at restart %d: %s",
                     fault.nbytes, step, restart_index, path,
                 )
+
+        return hook
+
+    def save_hook(self, flush=None, sleep=None):
+        """A ``Checkpointer.save_hooks`` entry firing this plan's
+        background-writer faults through the production async-commit
+        seam. ``stage`` is the writer's position: ``async_begin`` (the
+        SlowWriter stall point, before any shard write) and
+        ``shards_done`` (the AsyncCommitKill window — shards durable,
+        manifest NOT yet published).
+
+        ``flush``: called after a kill fault is recorded and before
+        SIGKILL lands, so the flight-recorder ring reaches disk — the
+        postmortem's only record of a death this abrupt. ``sleep``:
+        injectable stall for tests (default: real ``time.sleep``)."""
+
+        def hook(stage: str, step: int) -> None:
+            for i, fault in enumerate(self.faults):
+                if i in self._fired:
+                    continue
+                if (isinstance(fault, SlowWriter)
+                        and stage == "async_begin" and step >= fault.step):
+                    self._fired.add(i)
+                    _record_fault("slow_writer", step=step,
+                                  delay_s=fault.delay_s)
+                    logger.warning(
+                        "fault: stalling the async checkpoint writer "
+                        "%.2fs at step %d", fault.delay_s, step)
+                    if sleep is not None:
+                        sleep(fault.delay_s)
+                    else:
+                        import time as time_lib
+
+                        time_lib.sleep(fault.delay_s)
+                elif (isinstance(fault, AsyncCommitKill)
+                        and stage == "shards_done" and step >= fault.step):
+                    self._fired.add(i)
+                    _record_fault("async_commit_kill", step=step)
+                    logger.warning(
+                        "fault: SIGKILL inside the async commit window "
+                        "at step %d (shards written, manifest not "
+                        "published)", step)
+                    if flush is not None:
+                        flush()
+                    os.kill(os.getpid(), signal_lib.SIGKILL)
 
         return hook
 

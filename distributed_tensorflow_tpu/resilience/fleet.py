@@ -59,6 +59,7 @@ import dataclasses
 import json
 import logging
 import os
+import shutil
 import signal as signal_lib
 import threading
 import time
@@ -394,6 +395,161 @@ def newest_common_valid_step(ckpt_dirs: Sequence[str]) -> int | None:
 
 
 # ---------------------------------------------------------------------------
+# Peer-to-peer joiner catch-up (file control plane)
+#
+# A rejoining worker restores from its OWN newest valid step and replays
+# the deterministic stream — correct, but the replay grows linearly with
+# how far behind the joiner's retention left it. Catch-up shortcuts the
+# replay: the joiner posts a request under <fleet_dir>/catchup/, a live
+# survivor claims it (atomic rename — first claimer wins), exports a
+# verified copy of its newest valid step, and publishes it as an offer
+# (also by rename, so the joiner never sees a half-copied export). The
+# joiner verifies the offer with the SAME manifest CRC + per-shard size
+# discipline as the restore ceiling (``_step_dir_valid``), imports it
+# atomically into its own checkpoint dir, and restores from it — every
+# shard then passes through the CRC-trailered ``read_payload`` at
+# restore time, so a corrupted transfer quarantines instead of loading.
+#
+# Incarnation-fenced end to end: requests carry the joiner's
+# incarnation, survivors ignore requests from any other incarnation,
+# and offers echo it back — a stale offer from a previous gang can
+# never be imported. No survivor answering within ``budget_s`` is not
+# an error: the joiner falls back to deterministic replay, which is the
+# pre-catchup behavior. Trajectory identity is preserved either way:
+# in the collective-free rig every worker steps the full global batch,
+# so a survivor's step-S state IS the straight run's step-S state.
+# ---------------------------------------------------------------------------
+
+CATCHUP_DIRNAME = "catchup"
+
+#: metric name (documented in docs/observability.md)
+REJOIN_CATCHUP_SECONDS = "rejoin_catchup_seconds"
+
+
+def _catchup_dir(fleet_dir: str) -> str:
+    return os.path.join(
+        os.path.abspath(os.path.expanduser(fleet_dir)), CATCHUP_DIRNAME)
+
+
+def clear_catchup(fleet_dir: str) -> None:
+    """Drop the whole catch-up exchange — every fresh fleet run starts
+    here (like ``clear_shard_plan``): a previous incarnation's offers
+    must never be importable by this run's joiners."""
+    shutil.rmtree(_catchup_dir(fleet_dir), ignore_errors=True)
+
+
+def clear_catchup_for(fleet_dir: str, worker: int) -> None:
+    """Drop any stale request/claim/offer addressed to ``worker`` —
+    called before launching its replacement, so the new joiner's
+    exchange starts clean."""
+    cdir = _catchup_dir(fleet_dir)
+    for name in (f"req-{worker}.json", f"claim-{worker}.json"):
+        try:
+            os.remove(os.path.join(cdir, name))
+        # reviewed: sound drop — the file usually does not exist, and
+        # absence IS the clean state this helper establishes
+        except OSError:  # dtflint: disable=exception-hygiene
+            pass
+    shutil.rmtree(os.path.join(cdir, f"offer-{worker}"), ignore_errors=True)
+    shutil.rmtree(os.path.join(cdir, f".export-{worker}"), ignore_errors=True)
+
+
+def _read_offer(offer_dir: str) -> dict | None:
+    try:
+        with open(os.path.join(offer_dir, "OFFER.json")) as f:
+            d = json.load(f)
+        return {"step": int(d["step"]), "incarnation": int(d["incarnation"]),
+                "from_worker": int(d["from_worker"])}
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        logger.warning("catchup: unreadable offer in %s (%s); ignoring",
+                       offer_dir, e)
+        return None
+
+
+def request_catchup(
+    fleet_dir: str, worker: int, incarnation: int, ckpt_dir: str, *,
+    budget_s: float = 15.0, poll_s: float = 0.2,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+    flightrec: FlightRecorder | None = None,
+    registry: Registry | None = None,
+) -> int | None:
+    """Joiner side: ask a live survivor for a newer valid step than this
+    worker's own retention holds, import it into ``ckpt_dir``, and
+    return the imported step — or None after ``budget_s`` with no usable
+    offer (the caller restores its own newest step and replays, exactly
+    as before catch-up existed)."""
+    rec = flightrec if flightrec is not None else flightrec_lib.default_recorder()
+    reg = registry if registry is not None else default_registry()
+    cdir = _catchup_dir(fleet_dir)
+    os.makedirs(cdir, exist_ok=True)
+    d = os.path.abspath(os.path.expanduser(ckpt_dir))
+    have = newest_valid_step(d)
+    # a previous incarnation of this slot may have left a half-finished
+    # exchange behind; start clean so its offer can't race ours
+    clear_catchup_for(fleet_dir, worker)
+    offer_dir = os.path.join(cdir, f"offer-{worker}")
+    _atomic_write(os.path.join(cdir, f"req-{worker}.json"), json.dumps({
+        "worker": int(worker), "incarnation": int(incarnation),
+        "have_step": have}))
+    t0 = clock()
+    deadline = t0 + budget_s
+    while True:
+        meta = _read_offer(offer_dir)
+        if meta is not None:
+            if meta["incarnation"] != int(incarnation):
+                # previous gang's leftovers — discard, keep waiting
+                shutil.rmtree(offer_dir, ignore_errors=True)
+            else:
+                step = meta["step"]
+                src = os.path.join(offer_dir, str(step))
+                if ((have is None or step > have) and os.path.isdir(src)
+                        and _step_dir_valid(src, step)):
+                    dst = os.path.join(d, str(step))
+                    tmp = os.path.join(d, f".catchup-{step}")
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    shutil.copytree(src, tmp)
+                    if os.path.isdir(dst):
+                        # a torn/invalid local dir at this step (it can't
+                        # be valid: step > our newest valid) — replace it
+                        shutil.rmtree(dst)
+                    os.rename(tmp, dst)
+                    seconds = max(clock() - t0, 0.0)
+                    rec.emit("catchup_restore", step=step,
+                             peer=meta["from_worker"],
+                             seconds=round(seconds, 6))
+                    reg.histogram(
+                        REJOIN_CATCHUP_SECONDS,
+                        "joiner catch-up wall seconds, request to import",
+                    ).observe(seconds)
+                    logger.warning(
+                        "catchup: worker %d imported step %d from peer %d "
+                        "in %.2fs", worker, step, meta["from_worker"],
+                        seconds)
+                    shutil.rmtree(offer_dir, ignore_errors=True)
+                    clear_catchup_for(fleet_dir, worker)
+                    return step
+                # the survivor's newest is no better than ours, or the
+                # export failed verification — replay is the answer
+                logger.warning(
+                    "catchup: worker %d discarding unusable offer of step "
+                    "%d (have %s)", worker, step, have)
+                shutil.rmtree(offer_dir, ignore_errors=True)
+                break
+        if clock() >= deadline:
+            break
+        sleep(poll_s)
+    # fallback: withdraw the request so no survivor exports into the void
+    clear_catchup_for(fleet_dir, worker)
+    rec.emit("catchup_fallback", worker=worker, budget_s=budget_s)
+    logger.warning("catchup: worker %d got no usable offer within %.1fs; "
+                   "falling back to deterministic replay", worker, budget_s)
+    return None
+
+
+# ---------------------------------------------------------------------------
 # Heartbeats: writer (worker side) and monitor (fleet side) — factored
 # into .liveness (shared with serve/fleet.py) and re-exported above:
 # Heartbeat, read_heartbeat, HeartbeatWriter, HeartbeatMonitor, the
@@ -433,6 +589,12 @@ class ElasticWorker:
     stream — typically ``ElasticStream.reshard`` (data/pipeline.py)
     through a WorkerShard. Plain ints cross the seam so this module
     never imports the (jax-importing) data package.
+
+    With ``ckpt_dir`` given, every poll (and every spin of a hold
+    barrier — survivors are usually HELD while a joiner catches up)
+    also serves peer catch-up requests: this worker claims a pending
+    request and exports its newest valid step as an offer (see the
+    catch-up protocol above). ``ckpt_dir=None`` disables serving.
     """
 
     def __init__(self, fleet_dir: str, worker: int, writer: HeartbeatWriter,
@@ -441,7 +603,8 @@ class ElasticWorker:
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep,
                  poll_s: float = 0.05, hold_timeout_s: float = 120.0,
-                 flightrec: FlightRecorder | None = None):
+                 flightrec: FlightRecorder | None = None,
+                 ckpt_dir: str | None = None):
         if poll_s <= 0 or hold_timeout_s <= 0:
             raise ValueError("poll_s and hold_timeout_s must be positive")
         self.fleet_dir = fleet_dir
@@ -461,10 +624,13 @@ class ElasticWorker:
         self.applied_version = 0
         #: (rank | None, world) from the newest applied steady plan
         self.assignment: tuple[int | None, int] | None = None
+        #: checkpoint dir served to catching-up peers (None = don't)
+        self.ckpt_dir = ckpt_dir
 
     def poll(self, step: int | None = None) -> None:
         """One step-seam poll; blocks only while the fleet holds this
         worker at a resize barrier."""
+        self.serve_catchup()
         plan = read_shard_plan(self.fleet_dir)
         if plan is None or plan.version <= self.applied_version:
             return
@@ -513,6 +679,93 @@ class ElasticWorker:
                     f"elastic hold abandoned: no release within "
                     f"{self.hold_timeout_s}s of plan v{plan.version}")
             self.writer.beat()  # liveness while paused
+            # serve catch-up from inside the barrier too: on a rejoin
+            # hold, the SURVIVORS are exactly the workers parked here
+            # while the joiner asks for a step
+            self.serve_catchup()
+
+    def serve_catchup(self) -> None:
+        """Answer at most one pending peer catch-up request (see the
+        protocol comment above ``request_catchup``). No-op without a
+        ``ckpt_dir`` or when no request is pending."""
+        if self.ckpt_dir is None:
+            return
+        cdir = _catchup_dir(self.fleet_dir)
+        try:
+            names = os.listdir(cdir)
+        except FileNotFoundError:
+            return
+        for name in sorted(names):
+            if name.startswith("req-") and name.endswith(".json"):
+                if self._serve_one(os.path.join(cdir, name)):
+                    return
+
+    def _serve_one(self, req_path: str) -> bool:
+        try:
+            with open(req_path) as f:
+                req = json.load(f)
+            peer = int(req["worker"])
+            inc = int(req["incarnation"])
+            have = req.get("have_step")
+        except (OSError, ValueError, KeyError, TypeError):
+            return False  # torn/claimed under us — someone else's problem
+        if peer == self.worker:
+            return False
+        my_inc = getattr(self.writer, "incarnation", None)
+        if my_inc is not None and inc != int(my_inc):
+            # a previous gang's request: drop it so it can never trigger
+            # an export nobody of this incarnation will import
+            try:
+                os.remove(req_path)
+            # reviewed: sound drop — a concurrent survivor already
+            # removed or claimed the stale request; either way it is gone
+            except OSError:  # dtflint: disable=exception-hygiene
+                pass
+            return False
+        step = newest_valid_step(self.ckpt_dir)
+        if step is None or (have is not None and step <= int(have)):
+            # nothing better than the joiner already holds: leave the
+            # request for a peer with a newer step (or the budget)
+            return False
+        cdir = os.path.dirname(req_path)
+        claim = os.path.join(cdir, f"claim-{peer}.json")
+        try:
+            os.rename(req_path, claim)  # first claimer wins
+        except OSError:
+            return False
+        tmp = os.path.join(cdir, f".export-{peer}")
+        offer = os.path.join(cdir, f"offer-{peer}")
+        src = os.path.join(
+            os.path.abspath(os.path.expanduser(self.ckpt_dir)), str(step))
+        try:
+            shutil.rmtree(tmp, ignore_errors=True)
+            shutil.copytree(src, os.path.join(tmp, str(step)))
+            # re-verify the COPY: retention racing the export could have
+            # truncated it mid-copytree
+            if not _step_dir_valid(os.path.join(tmp, str(step)), step):
+                raise OSError(f"export of step {step} failed verification")
+            _atomic_write(os.path.join(tmp, "OFFER.json"), json.dumps({
+                "step": step, "incarnation": inc,
+                "from_worker": self.worker}))
+            shutil.rmtree(offer, ignore_errors=True)
+            os.rename(tmp, offer)  # publish: rename makes it whole-or-absent
+        except OSError as e:
+            logger.warning("catchup: worker %d failed exporting step %d for "
+                           "peer %d (%s)", self.worker, step, peer, e)
+            shutil.rmtree(tmp, ignore_errors=True)
+            try:
+                os.rename(claim, req_path)  # another survivor may succeed
+            # reviewed: sound drop — the joiner withdrew its request
+            # (clear_catchup_for) or gave up while we exported; the
+            # export failure itself was logged above
+            except OSError:  # dtflint: disable=exception-hygiene
+                pass
+            return False
+        self.flightrec.emit("catchup_offer", step=step, peer=peer,
+                            worker=self.worker)
+        logger.warning("catchup: worker %d exported step %d for joiner %d",
+                       self.worker, step, peer)
+        return True
 
     def _apply(self, plan: ShardPlan) -> None:
         self.applied_version = plan.version
@@ -779,6 +1032,7 @@ class FleetSupervisor:
         write_incarnation(self.workdir, self.incarnation)
         clear_restore_step(self.workdir)
         clear_shard_plan(self.workdir)
+        clear_catchup(self.workdir)
         self.restarts = 0
         self.resizes = 0
         self._ceiling = None
@@ -1208,6 +1462,9 @@ class FleetSupervisor:
         # gang came live; left behind it would cap a joiner's restore at
         # the old ceiling and force a needless long replay
         clear_restore_step(self.workdir)
+        # ... and the dead worker's half-finished catch-up exchange must
+        # not be mistaken by its replacement for an answer to ITS request
+        clear_catchup_for(self.workdir, index)
         handle = self.launch(index, self.incarnation)
         self._workers[index] = _Worker(
             index=index, handle=handle,

@@ -297,12 +297,18 @@ class Supervisor:
                         return state
             finally:
                 if ckpt is not None:
+                    # close() joins the async background writer (bounded)
+                    # and re-raises its stored error after a clean
+                    # shutdown — a failed attempt's exception must not be
+                    # masked by it, so it is logged here (the success
+                    # path already surfaced it via Checkpointer.wait in
+                    # CheckpointCallback.on_train_end)
                     try:
                         ckpt.close()
                     except Exception:
                         logger.exception(
-                            "closing checkpointer after attempt %d failed",
-                            restarts,
+                            "closing checkpointer (async writer join) "
+                            "after attempt %d failed", restarts,
                         )
             if restarts >= self.cfg.max_restarts:
                 self.flightrec.emit("sup_exhausted", cause=cause,

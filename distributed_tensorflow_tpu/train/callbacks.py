@@ -717,10 +717,22 @@ class CheckpointCallback(Callback):
     def on_train_end(self, trainer):
         if trainer.failed:
             # Aborting on an error (e.g. NaNGuard): the in-memory state may
-            # be poisoned — never let it become the latest checkpoint.
+            # be poisoned — never let it become the latest checkpoint. The
+            # background writer is still joined (bounded) so teardown never
+            # races a half-written commit — but its stored error must not
+            # MASK the failure that aborted the run: log it and let the
+            # original exception propagate.
             logger.warning("skipping final checkpoint: training failed")
-            self.manager.wait()
+            try:
+                self.manager.wait()
+            except Exception:
+                logger.exception(
+                    "async checkpoint writer also failed during aborted run")
             return
+        # final save is synchronous by contract; wait() then drains any
+        # in-flight cadence commit and re-raises a stored background-save
+        # error — a failed async save poisons the run here instead of
+        # silently dropping a step
         self.manager.save(int(trainer.state.step), trainer.state, force=True,
                           trigger="final")
         self.manager.wait()

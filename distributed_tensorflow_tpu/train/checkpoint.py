@@ -15,6 +15,22 @@ coordinated save and a clean exit, and recovery is restart-and-resume
 rather than the reference's in-session _RecoverableSession retry loop
 (monitored_session.py:1302) — TPU slices fail whole, so elasticity is
 checkpoint-restart (SURVEY.md §5.3).
+
+Async cadence saves use a native snapshot-then-commit path (ISSUE 18):
+the step boundary takes a host snapshot (`jax.device_get` — donation-safe,
+the live buffers may be consumed by the next step immediately), then one
+background writer thread streams per-leaf shards through the checksummed
+atomic IO (runtime/io.py: tmp+fsync+replace, CRC trailer) into a staging
+dir under ``<dir>/.pending/<step>``, writes MANIFEST.dtf LAST, and
+publishes the whole step with a single ``os.rename`` into the digit step
+dir. Death at ANY instant therefore leaves either a fully valid step or
+nothing: the staging dir is not a digit name, so torn background writes
+are invisible to ``latest_step``, ``restore(fallback=True)``,
+``resilience/fleet.valid_steps`` and the fleet's restore ceiling.
+Emergency / preemption / final saves stay synchronous (orbax path). A
+failed background save is never silently dropped: its exception is stored
+and re-raised from the next ``save()`` / ``wait()`` / ``latest_step()`` /
+``close()``, poisoning `latest` instead of skipping a step.
 """
 
 from __future__ import annotations
@@ -23,8 +39,11 @@ import dataclasses
 import json
 import logging
 import os
+import queue
+import shutil
 import signal
 import threading
+import time
 from typing import Any
 
 import jax
@@ -32,6 +51,7 @@ import orbax.checkpoint as ocp
 from jax.sharding import Mesh
 
 from ..obs import flightrec as flightrec_lib
+from ..obs import goodput as goodput_lib
 from ..parallel import cluster
 from ..parallel import sharding as sharding_lib
 # submodule import: resilience/retry.py has no train/ dependency, so this
@@ -40,6 +60,14 @@ from ..resilience.retry import RetryExhausted, RetryPolicy, retry_call
 from ..utils import config as config_lib
 
 logger = logging.getLogger(__name__)
+
+#: staging subdir of the background writer — NOT a digit name, so every
+#: step-listing consumer (latest_step, fallback restore, fleet
+#: valid_steps/newest_common_valid_step) is blind to in-flight writes
+PENDING_DIRNAME = ".pending"
+
+#: histogram of background commit latency (enqueue → published step dir)
+CKPT_ASYNC_COMMIT_SECONDS = "ckpt_async_commit_seconds"
 
 
 def step_dir(directory: str, step: int) -> str:
@@ -50,6 +78,11 @@ def step_dir(directory: str, step: int) -> str:
     return os.path.join(
         os.path.abspath(os.path.expanduser(directory)), str(step)
     )
+
+
+def _shard_name(index: int) -> str:
+    """Native async-commit shard file name for one pytree leaf."""
+    return f"shard-{index:05d}.dtf"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,8 +153,9 @@ class PreemptionWatcher:
 
 class Checkpointer:
     """Save/restore + retention + preemption, over an orbax
-    CheckpointManager. One instance per run; also usable standalone for
-    eval-side restore (SURVEY.md §3.5 pattern)."""
+    CheckpointManager (sync saves) plus a native snapshot-then-commit
+    background writer (async cadence saves). One instance per run; also
+    usable standalone for eval-side restore (SURVEY.md §3.5 pattern)."""
 
     def __init__(self, cfg: CheckpointConfig, mesh: Mesh, spec_tree: Any = None,
                  io_retry: RetryPolicy | None = None, registry=None,
@@ -151,13 +185,23 @@ class Checkpointer:
                           else flightrec_lib.default_recorder())
         self.watcher = PreemptionWatcher() if cfg.save_on_preemption else None
         options = ocp.CheckpointManagerOptions(
-            max_to_keep=cfg.max_to_keep,
+            # with the native async path in play, retention is ours
+            # (_apply_retention over the committed digit dirs — orbax's
+            # GC would race the background commits and has been observed
+            # deleting steps it only learned about via reload())
+            max_to_keep=None if cfg.async_save else cfg.max_to_keep,
             save_interval_steps=cfg.save_interval_steps,
-            enable_async_checkpointing=cfg.async_save,
+            # async cadence saves use the native snapshot-then-commit
+            # writer below; orbax handles only the synchronous triggers
+            enable_async_checkpointing=False,
         )
-        self.manager = ocp.CheckpointManager(
-            os.path.abspath(os.path.expanduser(cfg.directory)), options=options
-        )
+        base = os.path.abspath(os.path.expanduser(cfg.directory))
+        # crash leftovers of a previous incarnation's background writer:
+        # .pending was never published, is not restorable by design, and
+        # a new writer re-stages from scratch
+        shutil.rmtree(os.path.join(base, PENDING_DIRNAME),
+                      ignore_errors=True)
+        self.manager = ocp.CheckpointManager(base, options=options)
         self._finite_check = None
         #: (step, thread) for in-flight async manifest stampers
         self._manifest_threads: list[tuple[int, threading.Thread]] = []
@@ -167,6 +211,24 @@ class Checkpointer:
         #: the phase while the newer save's shard writes are in flight)
         self._hb_lock = threading.Lock()
         self._hb_save_seq = 0
+        #: fault-injection seam: callables ``hook(stage, step)`` invoked
+        #: by the background writer at ``async_begin`` (before shard
+        #: writes) and ``shards_done`` (after shards, BEFORE the
+        #: manifest publish) — resilience/faults.py plugs SlowWriter /
+        #: AsyncCommitKill / fsync-error faults in here, through the
+        #: exact code path production uses
+        self.save_hooks: list = []
+        self._async_q: queue.Queue = queue.Queue()
+        self._async_thread: threading.Thread | None = None
+        #: condition over the in-flight step set; the writer notifies on
+        #: every completion (commit or failure) so wait() can drain
+        self._async_cv = threading.Condition()
+        self._async_steps: set[int] = set()
+        #: first unreported background-save failure — re-raised from the
+        #: next save()/wait()/latest_step()/close(), so a torn async
+        #: save poisons `latest` instead of silently skipping a step
+        self._async_error: BaseException | None = None
+        self._retention_lock = threading.Lock()
 
     # -- save -------------------------------------------------------------
     def maybe_save(self, step: int, state: Any) -> bool:
@@ -236,29 +298,45 @@ class Checkpointer:
 
     def save(self, step: int, state: Any, force: bool = False,
              trigger: str = "cadence") -> bool:
-        """``trigger`` labels the flight-recorder event only (cadence /
-        preemption / final / emergency) — save semantics are identical."""
-        if step in self.manager.all_steps():
+        """``trigger`` labels the flight-recorder event (cadence /
+        preemption / final / emergency) and selects the write path: with
+        ``async_save``, cadence saves go through the native
+        snapshot-then-commit background writer; every other trigger —
+        the run is ending or the scheduler is about to kill us — stays
+        synchronous."""
+        native_async = self.cfg.async_save and trigger == "cadence"
+        if native_async:
+            # a failed background save must fail the RUN at the very
+            # next save boundary, not silently leave a step hole
+            self._raise_async_error()
+        if self._step_exists(step):
             return False  # already saved (e.g. cadence save + final save)
+        if native_async and not force:
+            # mirror orbax's should_save cadence (first opportunity
+            # always saves; then the save_interval_steps grid)
+            last = self._newest_known_step()
+            if last is not None and last >= step:
+                return False
+            if (last is not None
+                    and step % max(self.cfg.save_interval_steps, 1) != 0):
+                return False
         if self.cfg.validate_before_save and not self._params_finite(state):
             logger.error(
                 "refusing to checkpoint at step %d: non-finite params", step
             )
             return False
-        # Transient-IO retry around the orbax save call. With async_save
-        # the heavy shard writes happen later on orbax's own threads (their
-        # failures surface at wait_until_finished); the sync path — and the
-        # metadata/dispatch work of the async one — gets the retry budget.
+        if native_async:
+            return self._save_async(step, state, trigger)
+        # Transient-IO retry around the (synchronous) orbax save call.
         prev_phase = None
         seq = 0
         if self.heartbeat is not None:
-            # phase "save" for the WRITE's duration — including the
-            # async shard writes on orbax's background threads, not just
-            # the dispatch: a worker that dies anywhere inside this
-            # window may leave a torn step dir, and the fleet's elastic
-            # path reads the phase to fall back to a gang stop instead
-            # of shrinking around unverified state. ("save" never nests:
-            # a prior save's pending restore must not be re-captured.)
+            # phase "save" for the WRITE's duration: a worker that dies
+            # anywhere inside this window may leave a torn step dir, and
+            # the fleet's elastic path reads the phase to fall back to a
+            # gang stop instead of shrinking around unverified state.
+            # ("save" never nests: a prior save's pending restore must
+            # not be re-captured.)
             prev_phase = self.heartbeat.phase
             if prev_phase == "save":
                 prev_phase = "train"
@@ -277,17 +355,7 @@ class Checkpointer:
             )
         finally:
             if self.heartbeat is not None:
-                if saved and self.cfg.async_save:
-                    # the heavy shard writes are still in flight on
-                    # orbax's threads: restore the phase only once the
-                    # commit lands
-                    threading.Thread(
-                        target=self._restore_phase_after_commit,
-                        args=(prev_phase, seq), daemon=True,
-                        name=f"ckpt-hb-phase-{step}",
-                    ).start()
-                else:
-                    self._restore_phase(prev_phase, seq)
+                self._restore_phase(prev_phase, seq)
         if saved:
             self.flightrec.emit("ckpt_save", step=step, trigger=trigger)
         if saved and cluster.is_chief():
@@ -296,18 +364,218 @@ class Checkpointer:
             self._manifest_threads = [
                 (s, t) for s, t in self._manifest_threads if t.is_alive()
             ]
-            if self.cfg.async_save:
-                # manifest can only cover files that exist: wait for the
-                # async commit on a side thread, then stamp the step dir
-                t = threading.Thread(
-                    target=self._manifest_after_commit, args=(step,),
-                    daemon=True, name=f"ckpt-manifest-{step}",
-                )
-                t.start()
-                self._manifest_threads.append((step, t))
-            else:
-                self._write_manifest(step)
+            self._write_manifest(step)
+        if saved and self.cfg.async_save:
+            # retention is native whenever async saves are on (the orbax
+            # manager runs with max_to_keep=None then) — sync triggers
+            # must GC too or final/preemption saves grow the dir forever
+            self._apply_retention()
         return saved
+
+    # -- native async snapshot-then-commit (ISSUE 18) ----------------------
+    def _save_async(self, step: int, state: Any, trigger: str) -> bool:
+        """Snapshot on the caller thread (the only part that stalls
+        training — booked as ``async_checkpoint`` waste), then hand the
+        host copy to the background writer. The heartbeat save-phase
+        window opens HERE and is closed by the writer only after the
+        commit publishes (or fails), so a death anywhere inside the
+        background write shows phase ``save`` to the fleet."""
+        t0 = time.perf_counter()
+        # device→host copy; donation-safe: the live device buffers may
+        # be consumed by the next train step the moment save() returns
+        snapshot = jax.device_get(state)
+        prev_phase = None
+        seq = 0
+        if self.heartbeat is not None:
+            prev_phase = self.heartbeat.phase
+            if prev_phase == "save":
+                prev_phase = "train"
+            with self._hb_lock:
+                self._hb_save_seq += 1
+                seq = self._hb_save_seq
+            self.heartbeat.beat(step=step, phase="save")
+        with self._async_cv:
+            self._async_steps.add(step)
+        self._ensure_writer()
+        self.flightrec.emit("ckpt_async_begin", step=step, trigger=trigger)
+        self._async_q.put((step, snapshot, trigger, prev_phase, seq,
+                           time.perf_counter()))
+        host_cost = time.perf_counter() - t0
+        # the honest host-side bill of an async save: snapshot+enqueue
+        # stall the step boundary; the shard/fsync work overlaps compute
+        goodput_lib.note_wasted(goodput_lib.WASTE_ASYNC_CKPT, host_cost,
+                                registry=self.registry)
+        if cluster.is_chief():
+            logger.info("async checkpoint snapshot at step %d (%.3fs host)",
+                        step, host_cost)
+        return True
+
+    def _ensure_writer(self) -> None:
+        if self._async_thread is None or not self._async_thread.is_alive():
+            self._async_thread = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="ckpt-async-writer",
+            )
+            self._async_thread.start()
+
+    def _writer_loop(self) -> None:
+        """Single FIFO writer: commits land in save order, so retention
+        (which runs after each commit) can never evict a step that a
+        LATER-queued write still needs — and the staging dir keeps every
+        in-flight write out of retention's sight entirely."""
+        while True:
+            item = self._async_q.get()
+            if item is None:
+                return
+            step, snapshot, trigger, prev_phase, seq, t_enq = item
+            try:
+                retry_call(
+                    lambda: self._commit_async(step, snapshot, trigger,
+                                               t_enq),
+                    policy=self.io_retry, site="ckpt_save",
+                    registry=self.registry, flightrec=self.flightrec,
+                )
+            except BaseException as e:  # noqa: BLE001 — stored, re-raised
+                #                         from the next save()/wait()
+                with self._async_cv:
+                    if self._async_error is None:
+                        self._async_error = e
+                logger.exception(
+                    "background checkpoint commit for step %d failed; the "
+                    "failure will surface at the next save()/wait()", step)
+                shutil.rmtree(self._pending_dir(step), ignore_errors=True)
+            finally:
+                with self._async_cv:
+                    self._async_steps.discard(step)
+                    self._async_cv.notify_all()
+                if self.heartbeat is not None:
+                    self._restore_phase(prev_phase, seq)
+
+    def _pending_dir(self, step: int) -> str:
+        base = os.path.abspath(os.path.expanduser(self.cfg.directory))
+        return os.path.join(base, PENDING_DIRNAME, str(step))
+
+    def _commit_async(self, step: int, snapshot: Any, trigger: str,
+                      t_enq: float) -> None:
+        """One background commit: stage per-leaf shards under
+        ``.pending/<step>`` through the checksummed atomic IO, write
+        MANIFEST.dtf LAST, then publish the whole dir with a single
+        rename to the digit step name. Interruptible at any instant:
+        until the rename, no step-listing consumer can see the write."""
+        from ..runtime import io as io_lib
+        from io import BytesIO
+
+        import numpy as np
+
+        pending = self._pending_dir(step)
+        final = self._step_dir(step)
+        shutil.rmtree(pending, ignore_errors=True)  # clean retry slate
+        os.makedirs(pending)
+        self._run_save_hooks("async_begin", step)
+        files = []
+        for i, leaf in enumerate(jax.tree.leaves(snapshot)):
+            buf = BytesIO()
+            np.save(buf, np.asarray(leaf), allow_pickle=False)
+            name = _shard_name(i)
+            path = os.path.join(pending, name)
+            io_lib.write_payload(path, buf.getvalue())
+            files.append({"path": name, "bytes": os.path.getsize(path)})
+        self._run_save_hooks("shards_done", step)
+        if self.cfg.write_manifest:
+            payload = json.dumps({"step": step, "files": files}).encode()
+            io_lib.write_payload(os.path.join(pending, "MANIFEST.dtf"),
+                                 payload)
+        os.rename(pending, final)  # the commit point
+        dt = time.perf_counter() - t_enq
+        self.flightrec.emit("ckpt_save", step=step, trigger=trigger)
+        self.flightrec.emit("ckpt_async_commit", step=step,
+                            seconds=round(dt, 6))
+        reg = (self.registry if self.registry is not None
+               else goodput_lib.default_registry())
+        reg.histogram(
+            CKPT_ASYNC_COMMIT_SECONDS,
+            "background async-save commit latency (enqueue → published "
+            "step dir)",
+        ).observe(dt)
+        if cluster.is_chief():
+            logger.info("async checkpoint committed at step %d (%.3fs)",
+                        step, dt)
+        self._apply_retention()
+
+    def _run_save_hooks(self, stage: str, step: int) -> None:
+        for hook in list(self.save_hooks):
+            hook(stage, step)
+
+    def _committed_steps(self) -> list[int]:
+        """Published checkpoint steps, straight from the filesystem: the
+        digit dirs are the commit points of BOTH write paths (orbax's
+        tmp→rename and the native writer's .pending→rename), so this —
+        not the orbax manager's cached view — is the restore truth."""
+        base = os.path.abspath(os.path.expanduser(self.cfg.directory))
+        try:
+            names = os.listdir(base)
+        except FileNotFoundError:
+            return []
+        return sorted(int(n) for n in names
+                      if n.isdigit() and os.path.isdir(os.path.join(base, n)))
+
+    def _step_exists(self, step: int) -> bool:
+        if os.path.isdir(self._step_dir(step)):
+            return True
+        with self._async_cv:
+            return step in self._async_steps
+
+    def _newest_known_step(self) -> int | None:
+        steps = self._committed_steps()
+        with self._async_cv:
+            if self._async_steps:
+                steps = steps + [max(self._async_steps)]
+        return max(steps) if steps else None
+
+    def _apply_retention(self) -> None:
+        """Keep the newest ``max_to_keep`` PUBLISHED steps. Only digit
+        dirs are ever touched — the background writer stages under
+        ``.pending/`` until its single commit rename, so retention can
+        never pull a directory out from under an in-flight write."""
+        if not self.cfg.max_to_keep or self.cfg.max_to_keep <= 0:
+            return
+        with self._retention_lock:
+            steps = self._committed_steps()
+            evict = (steps[:-self.cfg.max_to_keep]
+                     if len(steps) > self.cfg.max_to_keep else [])
+            for s in evict:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            if evict:
+                logger.info("retention evicted checkpoint steps %s "
+                            "(max_to_keep=%d)", evict, self.cfg.max_to_keep)
+                # the orbax manager caches its step list; refresh so a
+                # later sync save/restore agrees with the filesystem
+                if hasattr(self.manager, "reload"):
+                    self.manager.reload()
+
+    def _drain_async(self, join_s: float) -> None:
+        """Bounded join of the in-flight background commits. Stragglers
+        (a stuck/slow writer — an injectable fault) are logged BY STEP
+        and left in flight for a later wait()/close() to retry."""
+        if self._async_thread is None:
+            return
+        deadline = time.monotonic() + join_s
+        with self._async_cv:
+            while self._async_steps:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    logger.error(
+                        "async checkpoint writer still busy with steps %s "
+                        "after %.1fs join; those checkpoints are not yet "
+                        "durable", sorted(self._async_steps), join_s)
+                    return
+                self._async_cv.wait(left)
+
+    def _raise_async_error(self) -> None:
+        with self._async_cv:
+            err, self._async_error = self._async_error, None
+        if err is not None:
+            raise err
 
     # -- native CRC manifest (runtime/io.py integration) -------------------
     def _step_dir(self, step: int) -> str:
@@ -325,23 +593,6 @@ class Checkpointer:
             if self.heartbeat.phase != "save":
                 return  # barrier/terminal phase owns it — never clobber
             self.heartbeat.beat(phase=prev_phase)
-
-    def _restore_phase_after_commit(self, prev_phase: str, seq: int) -> None:
-        try:
-            self.manager.wait_until_finished()
-        except Exception:
-            # the failure surfaces to the caller at the next wait(); the
-            # phase must still be restored or "save" sticks forever
-            logger.exception("async commit failed while heartbeat phase "
-                             "'save' was held")
-        self._restore_phase(prev_phase, seq)
-
-    def _manifest_after_commit(self, step: int) -> None:
-        try:
-            self.manager.wait_until_finished()
-            self._write_manifest(step)
-        except Exception:  # never kill the train loop from this thread
-            logger.exception("manifest write for step %d failed", step)
 
     def _write_manifest(self, step: int) -> None:
         """List every committed file of the step dir into MANIFEST.dtf,
@@ -424,7 +675,8 @@ class Checkpointer:
             os.replace(tmp, path)
 
     def wait(self, manifest_join_s: float = 60.0) -> None:
-        """Drain pending async commits AND their manifest stampers.
+        """Drain pending async commits AND their manifest stampers, then
+        surface any stored background-save failure.
 
         Every in-flight stamper thread is joined here with a bounded
         ``manifest_join_s`` timeout — saves only PRUNE dead entries from
@@ -433,7 +685,11 @@ class Checkpointer:
         silently lack MANIFEST.dtf. Stragglers that outlive the bound
         are logged BY STEP (so the operator knows exactly which
         checkpoint may be missing its integrity manifest) and kept for a
-        later wait()/close() to retry the join."""
+        later wait()/close() to retry the join. The background writer
+        gets the same bounded-join treatment, and a commit that FAILED
+        while nobody was looking re-raises here — never lost with its
+        thread."""
+        self._drain_async(manifest_join_s)
         self.manager.wait_until_finished()
         still_alive: list[tuple[int, threading.Thread]] = []
         for step, t in self._manifest_threads:
@@ -448,11 +704,17 @@ class Checkpointer:
                 )
                 still_alive.append((step, t))
         self._manifest_threads = still_alive
+        self._raise_async_error()
 
     # -- restore ----------------------------------------------------------
     def latest_step(self) -> int | None:
-        """latest_checkpoint analog ($TF checkpoint_management.py:329)."""
-        return self.manager.latest_step()
+        """latest_checkpoint analog ($TF checkpoint_management.py:329).
+        Reads the published digit dirs (the commit points of both write
+        paths); a stored background-save failure re-raises here first —
+        `latest` is poisoned, not quietly one step older than believed."""
+        self._raise_async_error()
+        steps = self._committed_steps()
+        return steps[-1] if steps else None
 
     def restore(self, abstract_state: Any, step: int | None = None,
                 fallback: bool = False) -> Any:
@@ -481,7 +743,7 @@ class Checkpointer:
             state = self._restore_step(step, abstract_state)
             self.flightrec.emit("ckpt_restore", step=step, fallback=False)
             return state
-        for s in sorted(self.manager.all_steps(), reverse=True):
+        for s in sorted(self._committed_steps(), reverse=True):
             if s > step:
                 continue  # explicit ceiling: never restore past `step`
             if self.cfg.write_manifest:
@@ -531,10 +793,10 @@ class Checkpointer:
         elif hasattr(self.manager, "reload"):
             self.manager.reload()  # pick up the chief's rename
 
-    def _restore_step(self, step: int, abstract_state: Any) -> Any:
+    def _target_tree(self, abstract_state: Any) -> Any:
         if self.spec_tree is not None:
             shardings = sharding_lib.tree_shardings(self.mesh, self.spec_tree)
-            target = jax.tree.map(
+            return jax.tree.map(
                 lambda s, shd: jax.ShapeDtypeStruct(
                     s.shape, s.dtype, sharding=shd
                 ),
@@ -542,17 +804,61 @@ class Checkpointer:
                 shardings,
                 is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
             )
+        return abstract_state
+
+    def _restore_step(self, step: int, abstract_state: Any) -> Any:
+        # native async-commit layout (per-leaf shard files) vs orbax —
+        # detected per step, so a dir can hold a mix of both
+        if os.path.exists(os.path.join(self._step_dir(step), _shard_name(0))):
+            state = retry_call(
+                lambda: self._restore_native(step, abstract_state),
+                policy=self.io_retry, site="ckpt_restore",
+                registry=self.registry, flightrec=self.flightrec,
+            )
         else:
-            target = abstract_state
-        state = retry_call(
-            lambda: self.manager.restore(
-                step, args=ocp.args.StandardRestore(target)),
-            policy=self.io_retry, site="ckpt_restore", registry=self.registry,
-            flightrec=self.flightrec,
-        )
+            if (step not in self.manager.all_steps()
+                    and hasattr(self.manager, "reload")):
+                self.manager.reload()  # saved before this manager existed
+            target = self._target_tree(abstract_state)
+            state = retry_call(
+                lambda: self.manager.restore(
+                    step, args=ocp.args.StandardRestore(target)),
+                policy=self.io_retry, site="ckpt_restore",
+                registry=self.registry, flightrec=self.flightrec,
+            )
         if cluster.is_chief():
             logger.info("restored checkpoint at step %d", step)
         return state
+
+    def _restore_native(self, step: int, abstract_state: Any) -> Any:
+        """Load a native async-committed step: one CRC-checked shard per
+        pytree leaf, flatten order = save order. Shape/dtype are checked
+        against the abstract target — a mismatched shard raises OSError
+        so the fallback walk quarantines the step instead of restoring
+        garbage."""
+        from ..runtime import io as io_lib
+        from io import BytesIO
+
+        import numpy as np
+
+        d = self._step_dir(step)
+        target = self._target_tree(abstract_state)
+        leaves, treedef = jax.tree.flatten(target)
+        out = []
+        for i, aval in enumerate(leaves):
+            data = io_lib.read_payload(os.path.join(d, _shard_name(i)))
+            arr = np.load(BytesIO(data), allow_pickle=False)
+            if (tuple(arr.shape) != tuple(aval.shape)
+                    or arr.dtype != aval.dtype):
+                raise OSError(
+                    f"checkpoint step {step}: shard {_shard_name(i)} is "
+                    f"{arr.dtype}{list(arr.shape)}, restore target wants "
+                    f"{aval.dtype}{list(aval.shape)}"
+                )
+            sharding = getattr(aval, "sharding", None)
+            out.append(jax.device_put(arr, sharding) if sharding is not None
+                       else jax.device_put(arr))
+        return jax.tree.unflatten(treedef, out)
 
     def quarantine_step(self, step: int, reason: str = "") -> str:
         """Move a failed step dir to ``<dir>/.corrupt/<step>`` (suffixing
@@ -588,12 +894,20 @@ class Checkpointer:
 
     def close(self) -> None:
         # Drain pending async commits AND their manifest stampers first —
-        # otherwise the daemon manifest thread dies with the process and the
-        # final checkpoint silently lacks its integrity manifest.
-        self.wait()
-        if self.watcher is not None:
-            self.watcher.close()  # reinstall pre-watcher signal handlers
-        self.manager.close()
+        # otherwise the daemon writer/stamper threads die with the process
+        # and the final checkpoint silently lacks shards or its manifest.
+        # wait() re-raises a stored background failure; the shutdown below
+        # still runs (try/finally), then the failure propagates to the
+        # caller — a lost async save surfaces even on the close path.
+        try:
+            self.wait()
+        finally:
+            if self._async_thread is not None and self._async_thread.is_alive():
+                self._async_q.put(None)
+                self._async_thread.join(timeout=5.0)
+            if self.watcher is not None:
+                self.watcher.close()  # reinstall pre-watcher signal handlers
+            self.manager.close()
 
 
 class PreemptionSaved(RuntimeError):

@@ -301,8 +301,9 @@ def _fleet(args, mesh, model, tx) -> int:
 
     from distributed_tensorflow_tpu.models import common
     from distributed_tensorflow_tpu.resilience import (
-        FaultPlan, Hang, RetryPolicy, Sigterm, Supervisor, SupervisorConfig,
-        SupervisorExhausted, fleet as fleet_lib,
+        AsyncCommitKill, FaultPlan, Hang, RetryPolicy, Sigterm, SlowWriter,
+        Supervisor, SupervisorConfig, SupervisorExhausted,
+        fleet as fleet_lib,
     )
     from distributed_tensorflow_tpu.resilience.supervisor import (
         POISONED, STALLED, TRANSIENT,
@@ -385,6 +386,16 @@ def _fleet(args, mesh, model, tx) -> int:
             # belongs to an earlier gang restart and must not roll our
             # restore back below our own newest valid step
             ceiling = None
+            if args.p2p_catchup:
+                # ask a live survivor for its newest valid step before
+                # building: a successful import becomes OUR newest valid
+                # step, so the restore below lands on it and the
+                # deterministic replay shrinks to the tail the survivor
+                # had not yet checkpointed. No answer within the budget
+                # = replay from our own newest, exactly as before.
+                fleet_lib.request_catchup(
+                    args.fleet_dir, args.worker_index, incarnation,
+                    args.workdir, budget_s=args.catchup_budget)
 
         # replica-mode reshard seam: the collective-free rig trains
         # every worker on the FULL global batch (the stand-in for the
@@ -405,7 +416,10 @@ def _fleet(args, mesh, model, tx) -> int:
 
         elastic_client = fleet_lib.ElasticWorker(
             args.fleet_dir, args.worker_index, writer,
-            on_reshard=on_reshard)
+            on_reshard=on_reshard,
+            # serve peer catch-up requests from the step seam and from
+            # inside resize-barrier holds (p2p rounds only)
+            ckpt_dir=args.workdir if args.p2p_catchup else None)
     faults = []
     if incarnation == args.fault_incarnation:
         # the incarnation counter is the cross-process fired-state: a
@@ -414,6 +428,11 @@ def _fleet(args, mesh, model, tx) -> int:
             faults.append(Hang(args.hang_at))
         if args.sigterm_at is not None:
             faults.append(Sigterm(args.sigterm_at))
+        if args.async_kill_at is not None:
+            faults.append(AsyncCommitKill(args.async_kill_at))
+        if args.slow_writer_at is not None:
+            faults.append(SlowWriter(args.slow_writer_at,
+                                     delay_s=args.slow_writer_delay))
     plan = FaultPlan(tuple(faults))
     loss_fn = common.classification_loss_fn(model)
 
@@ -426,17 +445,24 @@ def _fleet(args, mesh, model, tx) -> int:
     def build(restart_index: int):
         ckpt = Checkpointer(
             CheckpointConfig(directory=args.workdir, save_interval_steps=2,
-                             max_to_keep=10, async_save=False,
+                             max_to_keep=10, async_save=args.async_save,
                              preemption_check_every=1),
             mesh,
             # elastic: saves beat phase "save" so a death landing
             # mid-checkpoint makes the fleet gang-stop, never shrink
-            # around a possibly-torn step dir
+            # around a possibly-torn step dir (async: the bracket spans
+            # the whole background commit window)
             heartbeat=writer if args.elastic else None,
         )
+        # production fault seam: AsyncCommitKill/SlowWriter fire inside
+        # the background writer's commit stages; the flight recorder is
+        # flushed BEFORE the SIGKILL so the postmortem can prove where
+        # the death landed
+        ckpt.save_hooks.append(plan.save_hook(flush=dump_flightrec))
+        fb = not args.strict_restore
         state, specs, restored = init_or_restore(
             ckpt, common.make_init_fn(model, (8,)), tx, mesh,
-            jax.random.PRNGKey(0), fallback=True,
+            jax.random.PRNGKey(0), fallback=fb,
             # the gang ceiling binds the incarnation's FIRST restore
             # only: an in-process restart later in the same incarnation
             # must resume from its own newest valid step, not replay
@@ -445,7 +471,7 @@ def _fleet(args, mesh, model, tx) -> int:
         )
         start = int(state.step)
         if restored:
-            writer.note_restore(start, fallback=True)
+            writer.note_restore(start, fallback=fb)
         # heartbeat FIRST: it must record the step even when
         # CheckpointCallback raises PreemptionSaved (which skips every
         # later callback for that step), and before the fault callback
@@ -585,6 +611,36 @@ def main(argv=None) -> int:
                          "flightrec-w<i>i<incarnation>.jsonl into this "
                          "dir on every exit path (postmortem --merge "
                          "input)")
+    ap.add_argument("--async-save", action="store_true",
+                    help="fleet mode: cadence saves go through the "
+                         "background snapshot-then-commit writer "
+                         "(emergency/preemption/final stay synchronous)")
+    ap.add_argument("--async-kill-at", type=int, default=None,
+                    help="fleet mode: SIGKILL inside the async commit "
+                         "window (shards written, manifest NOT yet "
+                         "published) of the first async save at/after "
+                         "this GLOBAL step; gated on --fault-incarnation")
+    ap.add_argument("--slow-writer-at", type=int, default=None,
+                    help="fleet mode: stall the background writer before "
+                         "the first async commit at/after this GLOBAL "
+                         "step; gated on --fault-incarnation")
+    ap.add_argument("--slow-writer-delay", type=float, default=1.0,
+                    help="seconds --slow-writer-at stalls the writer")
+    ap.add_argument("--strict-restore", action="store_true",
+                    help="fleet mode: restore with fallback=False — the "
+                         "ceiling step must verify and restore directly "
+                         "(the async-kill round's proof that the torn "
+                         "step is invisible, not quarantined)")
+    ap.add_argument("--p2p-catchup", action="store_true",
+                    help="elastic mode: a rejoining replacement requests "
+                         "the newest valid step from a live survivor "
+                         "(resilience/fleet.request_catchup) before "
+                         "restoring; survivors serve peer requests from "
+                         "the step seam")
+    ap.add_argument("--catchup-budget", type=float, default=15.0,
+                    help="p2p mode: seconds the joiner waits for a "
+                         "survivor's offer before falling back to "
+                         "deterministic replay")
     args = ap.parse_args(argv)
     if args.fleet and not args.fleet_dir:
         raise SystemExit("--fleet requires --fleet-dir")

@@ -1,0 +1,169 @@
+"""Native async snapshot-then-commit checkpointing (ISSUE 18).
+
+The subprocess chaos round (tools/chaos_smoke.py async-kill) proves the
+death-at-any-instant contract end-to-end; these tests pin the in-process
+invariants it rests on: the staging dir keeps in-flight commits out of
+retention's sight, back-to-back saves racing a slow writer still
+converge to the retention set, the commit window is genuinely invisible
+(shards staged, no digit dir, manifest last), and a failed background
+commit poisons the run at the next save boundary instead of silently
+skipping a step.
+"""
+
+import os
+import threading
+
+import jax
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.resilience import (
+    AsyncCommitKill,
+    FaultPlan,
+    RetryExhausted,
+    RetryPolicy,
+    SlowWriter,
+)
+from distributed_tensorflow_tpu.train import (
+    CheckpointConfig,
+    Checkpointer,
+    init_or_restore,
+)
+
+from test_step import linear_init
+
+
+def _build(tmp_path, mesh8, name, **cfg_kw):
+    cfg_kw.setdefault("async_save", True)
+    cfg_kw.setdefault("save_on_preemption", False)
+    ckpt = Checkpointer(
+        CheckpointConfig(directory=str(tmp_path / name), **cfg_kw),
+        mesh8,
+        io_retry=RetryPolicy(max_attempts=1, base_s=0.0),
+    )
+    state, specs, _ = init_or_restore(
+        ckpt, linear_init, optax.sgd(0.1), mesh8, jax.random.PRNGKey(0)
+    )
+    return ckpt, state
+
+
+def test_back_to_back_async_saves_race_retention(mesh8, tmp_path):
+    """Three async saves queued while the FIRST commit is stalled by a
+    SlowWriter (injected sleep seam — an Event, so the race is
+    deterministic), with max_to_keep=2. Retention runs after each
+    commit but only ever sees PUBLISHED digit dirs, so the stalled and
+    queued writes are untouchable: after the drain the directory holds
+    exactly the newest two steps, no staging residue, no quarantine."""
+    ckpt, state = _build(tmp_path, mesh8, "race",
+                         max_to_keep=2, save_interval_steps=2)
+    release = threading.Event()
+    plan = FaultPlan([SlowWriter(0, delay_s=10.0)])
+    # fired-once: only the FIRST commit (step 2) blocks on the event
+    ckpt.save_hooks.append(plan.save_hook(sleep=lambda s: release.wait(30)))
+
+    assert ckpt.save(2, state, force=True)
+    assert ckpt.save(4, state, force=True)  # queues behind the stall
+    assert ckpt.save(6, state, force=True)
+    base = tmp_path / "race"
+    assert not (base / "2").exists()  # still staged, not published
+    release.set()
+    ckpt.wait()
+
+    assert sorted(int(n) for n in os.listdir(base) if n.isdigit()) == [4, 6]
+    pending = base / ".pending"
+    assert not pending.exists() or not os.listdir(pending)
+    assert not (base / ".corrupt").exists()
+    assert ckpt.verify_manifest(4) is True
+    assert ckpt.verify_manifest(6) is True
+    assert ckpt.latest_step() == 6
+    ckpt.close()
+
+
+def test_commit_window_is_invisible_until_publish(mesh8, tmp_path):
+    """Probed through the production hook seam at ``shards_done`` — the
+    exact instant AsyncCommitKill SIGKILLs in the chaos round: every
+    shard is already durable under ``.pending/<step>``, the manifest is
+    NOT yet written, and no digit dir exists, so a death here leaves
+    nothing any step-listing consumer can see."""
+    ckpt, state = _build(tmp_path, mesh8, "win")
+    seen = {}
+
+    def probe(stage, step):
+        if stage == "shards_done":
+            pending = tmp_path / "win" / ".pending" / str(step)
+            names = sorted(os.listdir(pending))
+            seen["shards"] = [n for n in names if n.endswith(".dtf")]
+            seen["manifest_staged"] = "MANIFEST.dtf" in names
+            seen["published"] = (tmp_path / "win" / str(step)).exists()
+
+    ckpt.save_hooks.append(probe)
+    assert ckpt.save(2, state, force=True)
+    ckpt.wait()
+    assert seen["shards"], "no shards staged at shards_done"
+    assert seen["manifest_staged"] is False  # manifest written LAST
+    assert seen["published"] is False        # rename is the commit point
+    assert ckpt.verify_manifest(2) is True   # ...and after it, all there
+    ckpt.close()
+
+
+def test_failed_background_commit_poisons_next_save(mesh8, tmp_path):
+    """A background commit that exhausts its retry budget must fail the
+    RUN at the next save()/wait() — raise-once with the original error —
+    and leave no staging residue behind."""
+    ckpt, state = _build(tmp_path, mesh8, "err")
+    armed = [True]
+
+    def explode(stage, step):
+        if stage == "shards_done" and armed[0]:
+            armed[0] = False
+            raise OSError("disk gone mid-commit")
+
+    ckpt.save_hooks.append(explode)
+    assert ckpt.save(2, state, force=True)
+    # surfaced as the retry layer's exhaustion, original OSError chained
+    with pytest.raises(RetryExhausted, match="disk gone"):
+        ckpt.wait()
+    ckpt.wait()  # raise-once: the error was surfaced, not resurfaced
+    assert not (tmp_path / "err" / "2").exists()  # never published
+    pending = tmp_path / "err" / ".pending"
+    assert not pending.exists() or not os.listdir(pending)
+    # the writer is not wedged: the next save commits normally
+    assert ckpt.save(4, state, force=True)
+    ckpt.wait()
+    assert ckpt.latest_step() == 4
+    ckpt.close()
+
+
+def test_async_commit_kill_fires_once_at_shards_done(mesh8, tmp_path):
+    """The AsyncCommitKill seam itself (SIGKILL replaced by recording —
+    the real kill is the chaos round's job): it must trigger at
+    ``shards_done`` of the armed step and never again on a rebuilt
+    hook list, the fire-once contract every plan fault carries."""
+    fired = []
+
+    class _Recorder(FaultPlan):
+        pass
+
+    plan = _Recorder([AsyncCommitKill(4)])
+    # monkeypatch the kill: record instead of dying
+    import distributed_tensorflow_tpu.resilience.faults as faults_mod
+
+    orig_kill = faults_mod.os.kill
+    faults_mod.os.kill = lambda pid, sig: fired.append(sig)
+    try:
+        ckpt, state = _build(tmp_path, mesh8, "kill")
+        ckpt.save_hooks.append(plan.save_hook())
+        assert ckpt.save(2, state, force=True)
+        ckpt.wait()
+        assert fired == []  # below the armed step
+        assert ckpt.save(4, state, force=True)
+        ckpt.wait()
+        assert len(fired) == 1
+        # a rebuilt hook list (supervisor restart) must not re-fire
+        ckpt.save_hooks[:] = [plan.save_hook()]
+        assert ckpt.save(6, state, force=True)
+        ckpt.wait()
+        assert len(fired) == 1
+        ckpt.close()
+    finally:
+        faults_mod.os.kill = orig_kill

@@ -55,6 +55,16 @@ def test_lookup_dotted_paths():
     assert bt.lookup(d, "nope") is None
 
 
+def test_lookup_list_indices():
+    """Numeric parts index into lists — the sweep-report shape
+    (``cells.0.steps_per_sec``) ci_fast's regression sentinel reads."""
+    d = {"cells": [{"steps_per_sec": 101.0}, {"steps_per_sec": 55.0}]}
+    assert bt.lookup(d, "cells.0.steps_per_sec") == 101.0
+    assert bt.lookup(d, "cells.1.steps_per_sec") == 55.0
+    assert bt.lookup(d, "cells.2.steps_per_sec") is None  # out of range
+    assert bt.lookup(d, "cells.x.steps_per_sec") is None  # not an index
+
+
 # ---------------------------------------------------------------------------
 # trend verdicts
 # ---------------------------------------------------------------------------

@@ -45,12 +45,22 @@ _LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds")
 
 
 def lookup(result: dict, path: str):
-    """Resolve a dotted path; returns None when any hop is missing."""
+    """Resolve a dotted path; returns None when any hop is missing.
+
+    Numeric parts index into lists (``cells.0.steps_per_sec``) so sweep
+    reports — whose leaves live inside a ``cells`` array — are reachable
+    with the same dotted syntax as flat bench dicts.
+    """
     node = result
     for part in path.split("."):
-        if not isinstance(node, dict) or part not in node:
+        if isinstance(node, list) and part.isdigit():
+            if int(part) >= len(node):
+                return None
+            node = node[int(part)]
+        elif isinstance(node, dict) and part in node:
+            node = node[part]
+        else:
             return None
-        node = node[part]
     return node
 
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Fast chaos smoke — the resilience gates quick enough for tools/ci_fast.sh.
 
-Five stages (full coverage lives in tests/test_resilience.py,
+Nine stages (full coverage lives in tests/test_resilience.py,
 tests/test_supervisor.py, tests/test_anomaly.py, tests/test_fleet.py
 and tests/test_serve.py; this is the canary that the recovery
 machinery is wired at all):
@@ -40,20 +40,41 @@ machinery is wired at all):
    to world 2, relaunches the slot, and the replacement rejoins at the
    next barrier — zero gang restarts, with `restart_recovery` at least
    10x below the gang-restart baseline (ISSUE 12 acceptance).
-7. **One serve-fleet failover round** (two serve/replica.py
+7. **One p2p catch-up rejoin round** (the same three-worker elastic
+   death as stage 6, run twice in one process): first WITHOUT
+   --p2p-catchup as the replay baseline, then WITH it — the replacement
+   requests the newest common valid checkpoint from a live survivor
+   over the file control plane (claim-by-rename, export re-verified,
+   offer rename-published, incarnation-fenced) instead of replaying.
+   Gates: catchup_restore fired and catchup_fallback did not, rejoin
+   wall (fleet_launch[rejoin] → fleet_done on the fleet clock) beats
+   the replay baseline measured in the SAME run, and every worker's
+   final params are bit-identical to an uninterrupted same-seed
+   single-process run (ISSUE 18 acceptance).
+8. **One async-commit-kill round** (two chaos_worker --fleet
+   --async-save --strict-restore subprocesses): worker 1 is SIGKILLed
+   INSIDE the background commit window of its step-4 async save
+   (AsyncCommitKill fires at the shards_done seam). The torn step must
+   be invisible — no `.corrupt` quarantine, no `.pending` residue, the
+   fleet restore ceiling lands on the last PUBLISHED step — and the
+   gang strict-restores it with fallback=False: nothing to fall back
+   from, because the manifest-last commit order means the torn step
+   never existed (ISSUE 18 acceptance).
+9. **One serve-fleet failover round** (two serve/replica.py
    subprocesses under ServeFleetSupervisor): one replica is SIGKILLed
    mid-stream, its in-flight requests requeue at their lane heads and
    re-prefill on the survivor — every stream finishes, the survivor's
    drain audit is leak-free, and the corpse (by design) never writes
    one (ISSUE 16 acceptance).
 
-The fleet and elastic rounds additionally stage every process's
-flight-recorder dump (plus telemetry snapshots and heartbeats) under
-``artifacts/{fleet,elastic}_dumps/``, merge them into ONE causally
-consistent cross-worker timeline (obs/fleetview.merge_timelines) at
-``artifacts/{fleet,elastic}_merged_postmortem.jsonl``, and assert the
-cross-process causal chains ci_fast re-gates with ``postmortem.py
---merge --expect`` (ISSUE 15).
+The fleet, elastic, p2p and async-kill rounds additionally stage every
+process's flight-recorder dump (plus telemetry snapshots and
+heartbeats) under ``artifacts/{fleet,elastic,p2p,asynckill}_dumps/``,
+merge them into ONE causally consistent cross-worker timeline
+(obs/fleetview.merge_timelines) at
+``artifacts/{fleet,elastic,p2p,asynckill}_merged_postmortem.jsonl``,
+and assert the cross-process causal chains ci_fast re-gates with
+``postmortem.py --merge --expect`` (ISSUE 15, ISSUE 18).
 
 Usage: JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 """
@@ -404,62 +425,105 @@ ELASTIC_MERGED_EXPECTS = (
 )
 
 
-def elastic_round(baseline_rr: float) -> None:
+#: pacing shared by the replay-baseline and p2p elastic rounds — they
+#: must be IDENTICAL runs up to the --p2p-catchup flags, or the rejoin
+#: wall-time comparison below measures configuration, not catch-up.
+#: Long enough that the survivors are still stepping (and therefore
+#: serving catch-up requests) when the replacement's request lands —
+#: and paced hard enough that the steps catch-up saves the joiner from
+#: replaying dominate scheduling noise in the wall-time comparison.
+ELASTIC_STEPS = 10
+ELASTIC_STEP_SLEEP = 1.2
+
+
+def _rejoin_wall_s(events) -> float:
+    """Rejoin wall time on the FLEET's clock: replacement launch →
+    fleet_done. The joiner is the round's straggler (its replay tail
+    runs after the survivors finish), so this window prices exactly
+    what catch-up exists to shrink."""
+    t0 = next(e["t"] for e in events
+              if e["kind"] == "fleet_launch" and e.get("rejoin"))
+    t1 = next(e["t"] for e in events if e["kind"] == "fleet_done")
+    return t1 - t0
+
+
+def _shrink_rejoin_round(d: str, p2p: bool, outs: bool = False):
+    """One 3-worker elastic shrink/rejoin round (worker 1 hard-dies at
+    step 3, the fleet shrinks, relaunches the slot, the replacement
+    rejoins). With ``p2p`` the workers run --p2p-catchup --async-save:
+    cadence saves go through the background writer and the replacement
+    imports a survivor's newest step instead of replaying from its own.
+    Returns (fleet result, registry, recorder, fleet_dir, rejoin wall
+    seconds)."""
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.obs.registry import Registry
+    from distributed_tensorflow_tpu.resilience import RetryPolicy
+    from distributed_tensorflow_tpu.resilience import fleet as fl
+
+    fleet_dir = os.path.join(d, "fleet")
+    os.makedirs(fleet_dir)
+    ckpt_dirs = [os.path.join(d, f"ckpt{i}") for i in range(3)]
+    launched = {}
+
+    def launch(i, incarnation):
+        n = launched.get(i, 0)
+        launched[i] = n + 1
+        args = [sys.executable, WORKER, ckpt_dirs[i], "--fleet",
+                "--elastic", "--fleet-dir", fleet_dir,
+                "--worker-index", str(i), "--steps", str(ELASTIC_STEPS),
+                "--step-sleep", str(ELASTIC_STEP_SLEEP),
+                "--flightrec-dir", fleet_dir]
+        if p2p:
+            args += ["--p2p-catchup", "--async-save"]
+        if outs:
+            args += ["--out", os.path.join(d, f"params{i}.npz")]
+        if i == 1 and n == 0:
+            args += ["--die-at", "3"]  # first launch only
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        # reviewed: a worker's stdout log stream, not durable state
+        log = open(os.path.join(  # dtflint: disable=atomic-durable-write
+            fleet_dir, f"worker{i}-n{n}.log"), "w")
+        try:
+            return subprocess.Popen(args, stdout=log,
+                                    stderr=subprocess.STDOUT, env=env)
+        finally:
+            log.close()
+
+    rec = FlightRecorder()
+    reg = Registry()
+    fleet = fl.FleetSupervisor(
+        launch, 3, fleet_dir,
+        fl.FleetConfig(max_restarts=2, elastic=True, min_workers=2,
+                       backoff=RetryPolicy(base_s=0.0, jitter=0.0),
+                       poll_s=0.2, heartbeat_timeout_s=20.0,
+                       stall_timeout_s=600.0, launch_grace_s=180.0,
+                       rejoin_grace_s=180.0, hold_timeout_s=120.0,
+                       term_grace_s=5.0, snapshot_poll_s=0.4),
+        ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec)
+    out = fleet.run()
+    assert out["restarts"] == 0, out
+    assert out["resizes"] == 2, out  # one shrink + one rejoin
+    return out, reg, rec, fleet_dir, _rejoin_wall_s(rec.events())
+
+
+def elastic_round(baseline_rr: float) -> float:
     """One of 3 workers hard-dies mid-run (os._exit, no save, no final
     heartbeat) → the ELASTIC fleet shrinks the gang to the survivors at
     a barrier instead of gang-stopping, relaunches the slot, and the
     replacement rejoins at the next barrier — zero gang restarts, zero
     restart_recovery seconds (vs. the gang-restart baseline's full
     outage window: the >= 10x acceptance bar of ISSUE 12). The dump is
-    left at ELASTIC_POSTMORTEM_ARTIFACT for the ci_fast gate."""
+    left at ELASTIC_POSTMORTEM_ARTIFACT for the ci_fast gate. Returns
+    the rejoin wall seconds — the DETERMINISTIC-REPLAY baseline the p2p
+    catch-up round must beat."""
     from distributed_tensorflow_tpu.obs import goodput
-    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
-    from distributed_tensorflow_tpu.obs.registry import Registry
-    from distributed_tensorflow_tpu.resilience import RetryPolicy
-    from distributed_tensorflow_tpu.resilience import fleet as fl
 
     os.makedirs(os.path.dirname(ELASTIC_POSTMORTEM_ARTIFACT), exist_ok=True)
     with tempfile.TemporaryDirectory(prefix="chaos_smoke_elastic_") as d:
-        fleet_dir = os.path.join(d, "fleet")
-        os.makedirs(fleet_dir)
-        ckpt_dirs = [os.path.join(d, f"ckpt{i}") for i in range(3)]
-        launched = {}
-
-        def launch(i, incarnation):
-            n = launched.get(i, 0)
-            launched[i] = n + 1
-            args = [sys.executable, WORKER, ckpt_dirs[i], "--fleet",
-                    "--elastic", "--fleet-dir", fleet_dir,
-                    "--worker-index", str(i), "--steps", "8",
-                    "--step-sleep", "0.25", "--flightrec-dir", fleet_dir]
-            if i == 1 and n == 0:
-                args += ["--die-at", "3"]  # first launch only
-            env = dict(os.environ)
-            env.pop("XLA_FLAGS", None)
-            env["JAX_PLATFORMS"] = "cpu"
-            # reviewed: a worker's stdout log stream, not durable state
-            log = open(os.path.join(  # dtflint: disable=atomic-durable-write
-                fleet_dir, f"worker{i}-n{n}.log"), "w")
-            try:
-                return subprocess.Popen(args, stdout=log,
-                                        stderr=subprocess.STDOUT, env=env)
-            finally:
-                log.close()
-
-        rec = FlightRecorder()
-        reg = Registry()
-        fleet = fl.FleetSupervisor(
-            launch, 3, fleet_dir,
-            fl.FleetConfig(max_restarts=2, elastic=True, min_workers=2,
-                           backoff=RetryPolicy(base_s=0.0, jitter=0.0),
-                           poll_s=0.2, heartbeat_timeout_s=20.0,
-                           stall_timeout_s=600.0, launch_grace_s=180.0,
-                           rejoin_grace_s=180.0, hold_timeout_s=120.0,
-                           term_grace_s=5.0, snapshot_poll_s=0.4),
-            ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec)
-        out = fleet.run()
-        assert out["restarts"] == 0, out
-        assert out["resizes"] == 2, out  # one shrink + one rejoin
+        out, reg, rec, fleet_dir, replay_wall = _shrink_rejoin_round(
+            d, p2p=False)
         rr = reg.get(goodput.WASTED_SECONDS,
                      cause=goodput.WASTE_RESTART_RECOVERY)
         elastic_rr = rr.value if rr is not None else 0.0
@@ -482,8 +546,184 @@ def elastic_round(baseline_rr: float) -> None:
     print("chaos_smoke: elastic death -> shrink@barrier -> replacement "
           "rejoin -> done OK (restart_recovery "
           f"{elastic_rr:.2f}s vs gang baseline {baseline_rr:.2f}s; "
+          f"replay rejoin wall {replay_wall:.2f}s; "
           f"postmortem at {ELASTIC_POSTMORTEM_ARTIFACT}; merged "
           f"cross-worker timeline at {ELASTIC_MERGED_ARTIFACT})")
+    return replay_wall
+
+
+#: staging/merge artifacts for the p2p catch-up round's cross-worker gate
+P2P_DUMPS_DIR = os.environ.get(
+    "DTF_P2P_DUMPS", os.path.join(_REPO, "artifacts", "p2p_dumps"))
+P2P_MERGED_ARTIFACT = os.environ.get(
+    "DTF_P2P_MERGED",
+    os.path.join(_REPO, "artifacts", "p2p_merged_postmortem.jsonl"))
+
+#: the CROSS-PROCESS catch-up story the merged p2p timeline must tell
+#: (shared with ci_fast.sh's --merge gate). Two chains, not one:
+#: offer→import causality is enforced by the file protocol itself (the
+#: joiner can only import a published offer), but the two events land
+#: ~one poll apart on DIFFERENT process clocks, finer than the merged
+#: timeline's alignment can order — so each chain anchors one side of
+#: the exchange against the fleet's own events instead. Which survivor
+#: claims the request is a race, so catchup_offer carries no src pin.
+P2P_MERGED_EXPECTS = (
+    "fleet_worker_dead,catchup_offer,fleet_done",
+    "fleet_worker_dead,catchup_restore[src=w1i1],fleet_rejoin,fleet_done",
+)
+
+
+def p2p_catchup_round(replay_wall: float) -> None:
+    """The elastic round again, with --p2p-catchup --async-save: the
+    replacement imports a live survivor's newest async-committed step
+    over the file control plane instead of replaying from its own
+    (older) checkpoint — the SAME run otherwise, so its rejoin wall
+    time must come in BELOW the deterministic-replay baseline. Final
+    params of every worker must be bit-identical to an uninterrupted
+    same-seed straight run: catch-up moves state, never the trajectory
+    (ISSUE 18 acceptance)."""
+    import numpy as np
+
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke_p2p_") as d:
+        out, reg, rec, fleet_dir, p2p_wall = _shrink_rejoin_round(
+            d, p2p=True, outs=True)
+        events = rec.events()
+        rec.dump(os.path.join(fleet_dir, "fleet.jsonl"),
+                 reason="chaos_smoke_p2p")
+        _stage_fleet_dumps(
+            fleet_dir, P2P_DUMPS_DIR, P2P_MERGED_ARTIFACT,
+            P2P_MERGED_EXPECTS,
+            expected_workers=("w0i1", "w1i1", "w2i1"))
+        # the joiner must have caught up VIA A PEER, not the fallback:
+        # its import step must beat the step-2 checkpoint its own dir
+        # held when it died
+        import json as _json
+
+        with open(os.path.join(P2P_MERGED_ARTIFACT)) as f:
+            merged = [_json.loads(line) for line in f if line.strip()]
+        restores = [e for e in merged if e.get("kind") == "catchup_restore"]
+        assert restores, "no catchup_restore in the merged p2p timeline"
+        assert int(restores[0]["step"]) > 2, restores
+        assert not any(e.get("kind") == "catchup_fallback" for e in merged), \
+            "joiner fell back to replay in the p2p round"
+        # rejoin must be CHEAPER than replaying the same distance
+        assert p2p_wall < replay_wall, (p2p_wall, replay_wall)
+
+        # bit-identity: an uninterrupted straight run (same seed, same
+        # target step, one process, no fleet) must agree with EVERY
+        # worker's final params — the death, the shrink, the import and
+        # the replay all left the trajectory untouched
+        straight = os.path.join(d, "straight.npz")
+        stdout = _run_worker(os.path.join(d, "straight_ckpt"),
+                             "--steps", str(ELASTIC_STEPS),
+                             "--out", straight)
+        assert f"CHAOS-DONE step={ELASTIC_STEPS}" in stdout, stdout
+        ref = dict(np.load(straight))
+        for i in range(3):
+            got = dict(np.load(os.path.join(d, f"params{i}.npz")))
+            assert set(got) == set(ref), (i, set(got), set(ref))
+            for k in ref:
+                assert np.array_equal(ref[k], got[k]), \
+                    f"worker {i} params[{k}] diverged from the straight run"
+    print("chaos_smoke: p2p catch-up rejoin OK (rejoin wall "
+          f"{p2p_wall:.2f}s vs replay baseline {replay_wall:.2f}s; "
+          f"import step {int(restores[0]['step'])}; params bit-identical "
+          f"to the straight run; merged timeline at {P2P_MERGED_ARTIFACT})")
+
+
+#: staging/merge artifacts for the async-commit-kill round's gate
+ASYNCKILL_DUMPS_DIR = os.environ.get(
+    "DTF_ASYNCKILL_DUMPS",
+    os.path.join(_REPO, "artifacts", "asynckill_dumps"))
+ASYNCKILL_MERGED_ARTIFACT = os.environ.get(
+    "DTF_ASYNCKILL_MERGED",
+    os.path.join(_REPO, "artifacts", "asynckill_merged_postmortem.jsonl"))
+
+#: the torn-write invisibility story (the ISSUE 18 ci gate, verbatim):
+#: the async save began, the SIGKILL landed INSIDE the commit window
+#: (shards written, manifest not yet published), and the relaunched
+#: gang restored the PREVIOUS step with fallback=False — the strict
+#: path, which would have raised on any torn state, proving the dead
+#: step never became visible
+ASYNCKILL_MERGED_EXPECTS = (
+    "ckpt_async_begin,fault_fired[fault=async_commit_kill],"
+    "ckpt_restore[fallback=False]",
+    "fleet_worker_dead,fleet_gang_stop,fleet_restart,fleet_done",
+)
+
+
+def async_kill_round() -> None:
+    """SIGKILL inside the async commit window: worker 1's background
+    writer dies BETWEEN writing its shards and publishing the manifest
+    (faults.AsyncCommitKill through the production save-hook seam). The
+    torn step must be invisible everywhere — the fleet's common-step
+    ceiling lands on the previous step, both relaunched workers restore
+    it with fallback=False (strict verify, no quarantine), and the run
+    finishes. ISSUE 18's first acceptance E2E."""
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.obs.registry import Registry
+    from distributed_tensorflow_tpu.resilience import RetryPolicy
+    from distributed_tensorflow_tpu.resilience import fleet as fl
+
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke_akill_") as d:
+        fleet_dir = os.path.join(d, "fleet")
+        os.makedirs(fleet_dir)
+        ckpt_dirs = [os.path.join(d, f"ckpt{i}") for i in range(2)]
+
+        def launch(i, incarnation):
+            args = [sys.executable, WORKER, ckpt_dirs[i], "--fleet",
+                    "--fleet-dir", fleet_dir, "--worker-index", str(i),
+                    "--steps", "8", "--async-save", "--strict-restore",
+                    "--step-sleep", "0.2", "--flightrec-dir", fleet_dir]
+            if i == 1:
+                args += ["--async-kill-at", "4"]  # gated to incarnation 1
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            # reviewed: a worker's stdout log stream, not durable state
+            log = open(os.path.join(  # dtflint: disable=atomic-durable-write
+                fleet_dir, f"worker{i}-inc{incarnation}.log"), "w")
+            try:
+                return subprocess.Popen(args, stdout=log,
+                                        stderr=subprocess.STDOUT, env=env)
+            finally:
+                log.close()
+
+        rec = FlightRecorder()
+        reg = Registry()
+        fleet = fl.FleetSupervisor(
+            launch, 2, fleet_dir,
+            fl.FleetConfig(max_restarts=2,
+                           backoff=RetryPolicy(base_s=0.0, jitter=0.0),
+                           poll_s=0.2, heartbeat_timeout_s=20.0,
+                           stall_timeout_s=600.0, launch_grace_s=180.0,
+                           term_grace_s=5.0, snapshot_poll_s=0.4),
+            ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec)
+        out = fleet.run()
+        assert out == {"restarts": 1, "incarnation": 2, "resizes": 0}, out
+        # the torn step-4 write must have been invisible to the ceiling:
+        # the newest step BOTH workers can verify is the previous save
+        assert fl.read_restore_step(fleet_dir) == 2, \
+            fl.read_restore_step(fleet_dir)
+        for i, ck in enumerate(ckpt_dirs):
+            # strict restore never quarantined anything, and no staging
+            # residue survived the relaunch
+            assert not os.path.isdir(os.path.join(ck, ".corrupt")), \
+                f"worker {i} quarantined a step under strict restore"
+            pending = os.path.join(ck, ".pending")
+            assert not os.path.isdir(pending) or not os.listdir(pending), \
+                f"worker {i} left staging residue: {os.listdir(pending)}"
+            assert fl.newest_valid_step(ck) is not None
+        rec.dump(os.path.join(fleet_dir, "fleet.jsonl"),
+                 reason="chaos_smoke_asynckill")
+        _stage_fleet_dumps(
+            fleet_dir, ASYNCKILL_DUMPS_DIR, ASYNCKILL_MERGED_ARTIFACT,
+            ASYNCKILL_MERGED_EXPECTS,
+            expected_workers=("w0i1", "w1i1", "w0i2", "w1i2"))
+    print("chaos_smoke: SIGKILL mid-async-commit -> torn step invisible "
+          "-> gang restored previous step (fallback=False, zero "
+          "quarantines) -> done OK (merged timeline at "
+          f"{ASYNCKILL_MERGED_ARTIFACT})")
 
 
 #: staging/merge artifacts for the serve-fleet round's cross-process gate
@@ -628,7 +868,9 @@ def main() -> int:
     supervised_recovery_round()
     nan_blame_round()
     baseline_rr = fleet_round()
-    elastic_round(baseline_rr)
+    replay_wall = elastic_round(baseline_rr)
+    p2p_catchup_round(replay_wall)
+    async_kill_round()
     serve_fleet_round()
     return 0
 

@@ -46,7 +46,16 @@
 #      waste beats the gang-restart baseline by >= 10x (ISSUE 12) +
 #      one serve-fleet failover round: a serve replica SIGKILLed
 #      mid-stream, in-flight requests requeued and re-prefilled on the
-#      survivor, every stream finished, survivors leak-free (ISSUE 16)
+#      survivor, every stream finished, survivors leak-free (ISSUE 16) +
+#      one P2P CATCH-UP round (ISSUE 18): the same elastic death, but the
+#      replacement pulls the newest common valid checkpoint from a live
+#      survivor over the file control plane instead of replaying — rejoin
+#      wall must beat the replay baseline measured in the same run, and
+#      every worker's final params must be bit-identical to an
+#      uninterrupted same-seed run + one ASYNC-KILL round (ISSUE 18): a
+#      worker SIGKILLed INSIDE the async checkpoint commit window — the
+#      torn step must be invisible (no .corrupt quarantine, no .pending
+#      residue) and the gang must strict-restore the previous step
 #   6. tools/postmortem.py     — flight-recorder gates: the supervised
 #      round's postmortem dump must pass schema validation AND contain
 #      fault → preemption save → restart → quarantine → fallback-restore
@@ -79,6 +88,19 @@
 #      aligns replica clocks on the serve_route dispatch/ACK handshake
 #      and asserts replica-dead -> lane-head requeue -> survivor
 #      re-admission -> fleet_done
+#   6c. tools/postmortem.py --merge — async-durability gates (ISSUE 18):
+#      the async-kill round's merged timeline must show the torn-write
+#      invisibility story — ckpt_async_begin → fault_fired
+#      [fault=async_commit_kill] → ckpt_restore[fallback=False] (the
+#      restore is STRICT: nothing to fall back from, the torn step never
+#      became visible) — and the p2p round's timeline the catch-up story:
+#      worker dead → survivor catchup_offer → joiner catchup_restore →
+#      fleet_rejoin, with no catchup_fallback
+#   4b. tools/bench_trend.py — perf-regression sentinel (ISSUE 18): when
+#      a previous run left artifacts/scaling_dryrun_prev.json, compare
+#      the fresh sweep's dp8-cell steps/sec against it (provenance-
+#      checked: same platform/device_kind, both git_sha-pinned) and fail
+#      on a drop past the budget; first run on a clean tree skips
 #   7c. tools/trace_view.py — request-ledger gate (ISSUE 17): merge the
 #      same round's per-process request traces (router + both replica
 #      incarnations, including the SIGKILLed victim's surviving
@@ -100,10 +122,23 @@ env JAX_PLATFORMS=cpu python tools/dtf_lint.py --strict \
   distributed_tensorflow_tpu tools bench.py
 env JAX_PLATFORMS=cpu python tools/dtf_lint.py --strict \
   --rules wall-clock-in-seam tests
+# keep the previous sweep report around as the bench_trend baseline:
+# the freshest pair of runs IS the trend (ISSUE 18)
+if [ -f artifacts/scaling_dryrun.json ]; then
+  cp artifacts/scaling_dryrun.json artifacts/scaling_dryrun_prev.json
+fi
 env JAX_PLATFORMS=cpu \
   XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
   python tools/sweep.py --dryrun --expect-platform cpu \
   --out artifacts/scaling_dryrun.json >/dev/null
+# perf-regression sentinel (ISSUE 18): dryrun throughput on shared CI
+# hosts is noisy, so the budget is generous — this catches collapses
+# (a serialization bug halving step rate), not percent-level drift
+if [ -f artifacts/scaling_dryrun_prev.json ]; then
+  env JAX_PLATFORMS=cpu python tools/bench_trend.py \
+    artifacts/scaling_dryrun_prev.json artifacts/scaling_dryrun.json \
+    --metric cells.0.steps_per_sec --max-regress-pct 60
+fi
 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 env JAX_PLATFORMS=cpu python tools/postmortem.py \
   "${DTF_CHAOS_POSTMORTEM:-artifacts/chaos_postmortem.jsonl}" --quiet \
@@ -135,6 +170,28 @@ env JAX_PLATFORMS=cpu python tools/postmortem.py --merge \
   --expect 'fleet_worker_dead,fleet_hold,elastic_hold[src=w0i1],fleet_shrink,elastic_release[src=w0i1],fleet_rejoin,fleet_done' \
   --expect 'fleet_worker_dead,fleet_hold,elastic_hold[src=w2i1],fleet_shrink,elastic_release[src=w2i1],fleet_rejoin,fleet_done' \
   --expect 'fleet_shrink,elastic_release[src=w1i1],fleet_rejoin,fleet_done'
+# async durability (ISSUE 18): the async-kill round's merged timeline
+# must show the torn step was INVISIBLE — the victim began an async
+# commit, died inside it, and the whole gang strict-restored the
+# previous step (fallback=False: the torn step never existed to fall
+# back from)
+env JAX_PLATFORMS=cpu python tools/postmortem.py --merge \
+  "${DTF_ASYNCKILL_DUMPS:-artifacts/asynckill_dumps}"/fleet.jsonl \
+  "${DTF_ASYNCKILL_DUMPS:-artifacts/asynckill_dumps}"/flightrec-w*.jsonl \
+  --out "${DTF_ASYNCKILL_MERGED:-artifacts/asynckill_merged_postmortem.jsonl}" --quiet \
+  --expect 'ckpt_async_begin,fault_fired[fault=async_commit_kill],ckpt_restore[fallback=False]' \
+  --expect 'fleet_worker_dead,fleet_gang_stop,fleet_restart,fleet_done'
+# p2p catch-up (ISSUE 18): the rejoin story on the merged timeline — a
+# survivor exported an offer and the joiner imported it (each chain
+# anchored on fleet-clock events; offer->import causality is enforced
+# by the file protocol itself, rename-published offers cannot be
+# imported before they exist)
+env JAX_PLATFORMS=cpu python tools/postmortem.py --merge \
+  "${DTF_P2P_DUMPS:-artifacts/p2p_dumps}"/fleet.jsonl \
+  "${DTF_P2P_DUMPS:-artifacts/p2p_dumps}"/flightrec-w*.jsonl \
+  --out "${DTF_P2P_MERGED:-artifacts/p2p_merged_postmortem.jsonl}" --quiet \
+  --expect 'fleet_worker_dead,catchup_offer,fleet_done' \
+  --expect 'fleet_worker_dead,catchup_restore[src=w1i1],fleet_rejoin,fleet_done'
 env JAX_PLATFORMS=cpu python tools/fleet_top.py --once \
   --fleet-dir "${DTF_FLEET_DUMPS:-artifacts/fleet_dumps}" >/dev/null
 env JAX_PLATFORMS=cpu python tools/bench_serve.py --preset chaos \
