@@ -412,8 +412,20 @@ def _offset_bounds(src: str, header: Mapping, events: Sequence[Mapping],
                    failures: list[str]) -> tuple[float, float]:
     """Clock-offset bounds (lo, hi) mapping this worker dump onto the
     fleet clock. Appends to ``failures`` when the required anchor is
-    missing or the bounds are inconsistent."""
+    missing or the bounds are inconsistent.
+
+    Hierarchical dumps (resilience/podfleet.py): the fleet dump carries
+    coordinator events (no ``pod`` attr) interleaved with every pod
+    supervisor's events (tagged ``pod=<p>``), and worker headers carry
+    their pod. Anchors then pair WITHIN the pod — worker indices and
+    per-pod incarnation counters repeat across pods, so a pod-blind
+    match would align worker 0 of pod B against pod A's launch of its
+    own worker 0. The coordinator's global ``fleet_done`` (no pod) is
+    the one cross-pod anchor: it fires after every pod's exit, so it
+    bounds every worker from above. Flat dumps have no ``pod`` anywhere
+    and behave exactly as before (None == None)."""
     w, k = header["worker"], header["incarnation"]
+    pod = header.get("pod")
     pid = header.get("pid")
     first_t, last_t = events[0]["t"], events[-1]["t"]
     lows: list[float] = []
@@ -423,7 +435,8 @@ def _offset_bounds(src: str, header: Mapping, events: Sequence[Mapping],
     # of its events. Disambiguate multiple launches of the same slot
     # (elastic replacement relaunch) by pid.
     launches = [e for e in fleet_events if e.get("kind") == "fleet_launch"
-                and e.get("worker") == w and e.get("incarnation") == k]
+                and e.get("worker") == w and e.get("incarnation") == k
+                and e.get("pod") == pod]
     by_pid = [e for e in launches if pid is not None
               and e.get("pid") == pid]
     if by_pid:
@@ -431,7 +444,8 @@ def _offset_bounds(src: str, header: Mapping, events: Sequence[Mapping],
     if not launches:
         failures.append(
             f"{src}: clock anchor missing — no fleet_launch for worker "
-            f"{w} incarnation {k} (pid {pid}) in the fleet dump")
+            f"{w} incarnation {k} (pod {pod}, pid {pid}) in the fleet "
+            f"dump")
         return 0.0, 0.0
     if len(launches) > 1:
         failures.append(
@@ -443,6 +457,12 @@ def _offset_bounds(src: str, header: Mapping, events: Sequence[Mapping],
 
     for fe in fleet_events:
         kind = fe.get("kind")
+        if fe.get("pod") != pod and not (
+                kind == "fleet_done" and fe.get("pod") is None):
+            # another pod's (or, for a pod-scoped worker, the
+            # coordinator's) events anchor nothing here — except the
+            # global fleet_done, which fires after every pod exits
+            continue
         if kind == "fleet_hold" and fe.get("version") is not None:
             we = _first(events, "elastic_hold", version=fe["version"])
             if we is not None:
@@ -507,9 +527,13 @@ def merge_timelines(
     worker identity, missing/inconsistent clock anchors, worker label
     collisions) and header/events are best-effort only.
 
-    Every merged event carries ``src`` (``fleet`` or ``w<i>i<k>``) and a
+    Every merged event carries ``src`` (``fleet``, ``w<i>i<k>``, or —
+    for workers under a pod coordinator — ``p<p>w<i>i<k>``) and a
     timestamp shifted by that source's anchored offset; ties sort the
     fleet event first (anchors are happens-before edges FROM the fleet).
+    Hierarchical runs hand in ONE fleet dump (coordinator + all pod
+    supervisors share a process and a pod-tagging recorder), and worker
+    identity becomes the triple ``(pod, worker, incarnation)``.
     """
     failures: list[str] = []
     try:
@@ -526,7 +550,7 @@ def merge_timelines(
         rec["src"] = "fleet"
         keyed.append((float(e["t"]), 0, 0, j, rec))
 
-    seen: set[tuple[int, int]] = set()
+    seen: set[tuple[int | None, int, int]] = set()
     for si, path in enumerate(worker_paths, start=1):
         try:
             header, events = load_dump(path)
@@ -534,27 +558,30 @@ def merge_timelines(
             failures.append(f"unreadable worker dump {path}: {e}")
             continue
         w, k = header.get("worker"), header.get("incarnation")
+        p = header.get("pod")
         if not isinstance(w, int) or not isinstance(k, int):
             failures.append(
                 f"{path}: dump header lacks worker/incarnation identity "
                 f"(dump with extra={{'worker': i, 'incarnation': k}})")
             continue
-        src = f"w{w}i{k}"
-        if (w, k) in seen:
+        src = f"p{p}w{w}i{k}" if p is not None else f"w{w}i{k}"
+        if (p, w, k) in seen:
             failures.append(
-                f"worker label collision: two dumps claim worker {w} "
-                f"incarnation {k}")
+                f"worker label collision: two dumps claim "
+                f"{'pod ' + str(p) + ' ' if p is not None else ''}worker "
+                f"{w} incarnation {k}")
             continue
-        seen.add((w, k))
+        seen.add((p, w, k))
+        ident = {"pid": header.get("pid"), "worker": w, "incarnation": k}
+        if p is not None:
+            ident["pod"] = p
         if not events:
             sources.append({"src": src, "offset": 0.0, "events": 0,
-                            "pid": header.get("pid"), "worker": w,
-                            "incarnation": k})
+                            **ident})
             continue
         lo, hi = _offset_bounds(src, header, events, fleet_events, failures)
         sources.append({
-            "src": src, "offset": lo, "events": len(events),
-            "pid": header.get("pid"), "worker": w, "incarnation": k,
+            "src": src, "offset": lo, "events": len(events), **ident,
             "offset_bounds": [lo, hi if hi != float("inf") else None],
         })
         for j, e in enumerate(events):
@@ -611,7 +638,7 @@ def validate_merged_dump(path: str) -> list[str]:
     if not isinstance(sources, list) or not sources:
         failures.append("header has no sources list")
     else:
-        ids: set[tuple[int, int]] = set()
+        ids: set[tuple[int | None, int, int]] = set()
         for s in sources:
             if not isinstance(s, Mapping) or not isinstance(
                     s.get("src"), str):
@@ -620,12 +647,13 @@ def validate_merged_dump(path: str) -> list[str]:
             if s["src"] in srcs:
                 failures.append(f"duplicate source {s['src']!r}")
             srcs.add(s["src"])
-            wk = (s.get("worker"), s.get("incarnation"))
-            if isinstance(wk[0], int) and isinstance(wk[1], int):
+            wk = (s.get("pod"), s.get("worker"), s.get("incarnation"))
+            if isinstance(wk[1], int) and isinstance(wk[2], int):
                 if wk in ids:
                     failures.append(
-                        f"worker label collision in sources: worker "
-                        f"{wk[0]} incarnation {wk[1]} appears twice")
+                        f"worker label collision in sources: "
+                        f"{'pod ' + str(wk[0]) + ' ' if wk[0] is not None else ''}"
+                        f"worker {wk[1]} incarnation {wk[2]} appears twice")
                 ids.add(wk)
             if not isinstance(s.get("offset"), (int, float)):
                 failures.append(f"source {s['src']!r} has no numeric offset")

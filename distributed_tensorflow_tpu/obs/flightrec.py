@@ -109,6 +109,20 @@ EVENT_KINDS = (
     "catchup_offer",        # survivor exported a verified step {step, peer, worker}
     "catchup_restore",      # joiner imported a peer's step {step, peer, seconds}
     "catchup_fallback",     # no usable offer within budget {worker, budget_s}
+    # hierarchical fault domains (resilience/podfleet.py): the global
+    # coordinator + per-pod supervisors' pod-level record — every event
+    # a pod supervisor emits (including the fleet_* kinds above) also
+    # carries a ``pod`` attr, so one timeline spans coordinator → pod
+    # supervisors → workers
+    "pod_outage",           # a pod's gang failed as a unit {pod, cause}
+    "pod_restart",          # pod relaunched at its own quorum ceiling
+    #                                       {pod, restart, cause, ceiling}
+    "pod_rejoin",           # restarted pod's gang confirmed live {pod, restart}
+    "pod_fence",            # pod control plane stale but processes alive:
+    #                         fenced, no restart, no stale-plan action {pod}
+    "pod_unfence",          # fenced pod's control plane came back {pod, fenced_s}
+    "pod_hold",             # cross-pod hold plan written   {version, hold}
+    "pod_release",          # cross-pod barrier released    {version, world, barrier}
     # fleet telemetry snapshots (obs/fleetview.py)
     "fleetsnap_export",     # worker exported a snapshot    {seq, worker}
     "fleetsnap_merge",      # fleet folded a new snapshot   {worker, seq, pid, incarnation}

@@ -14,6 +14,7 @@ from .mesh import (  # noqa: F401
     PIPE,
     SEQ,
     MeshSpec,
+    PodTopology,
     build_mesh,
     describe,
     factor_mesh_axis,

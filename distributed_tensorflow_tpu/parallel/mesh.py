@@ -124,6 +124,81 @@ class MeshSpec:
         return cls(**dict(d))
 
 
+@dataclasses.dataclass(frozen=True)
+class PodTopology:
+    """Two-level fault-domain topology: ``num_pods`` identical pods.
+
+    ``pod_spec`` is the ICI mesh of ONE pod — its ``dcn_*`` factors
+    must be 1, because the only inter-pod dimension is the one this
+    descriptor adds. The flat mesh the trainer builds is
+    ``to_mesh_spec()``: the data axis grows ``num_pods``-fold and its
+    new outer hop is declared DCN (``dcn_data = num_pods``), so the
+    gradient psum reduces intra-pod first and crosses pod boundaries
+    exactly once — the same hybrid-mesh recipe as multislice, with the
+    slice boundary reinterpreted as the FAULT boundary
+    (resilience/podfleet.py supervises one fault domain per pod; a
+    pod's outage shrinks or holds this axis, never the intra-pod ones).
+
+    Only ``data`` may span pods: ``model`` / ``pipe`` / ``seq`` /
+    ``expert`` collectives are latency-critical per layer and a pod
+    restart must never re-partition parameter state — the same rule
+    ``rescale_for_world`` enforces one level down.
+    """
+
+    num_pods: int
+    pod_spec: MeshSpec = MeshSpec()
+
+    def __post_init__(self):
+        if self.num_pods < 1:
+            raise ValueError(f"num_pods must be >= 1, got {self.num_pods}")
+        if self.pod_spec.num_slices != 1:
+            raise ValueError(
+                "pod_spec describes ONE pod's ICI mesh: its dcn_* factors "
+                f"must be 1 (got dcn_data={self.pod_spec.dcn_data}, "
+                f"dcn_pipe={self.pod_spec.dcn_pipe}); cross-pod DCN comes "
+                "from num_pods")
+
+    def to_mesh_spec(self) -> MeshSpec:
+        """The flat (total-extent) MeshSpec for the whole fleet: pod
+        data extent × num_pods on the data axis, pod boundary = DCN."""
+        data = self.pod_spec.data
+        total = data if data == -1 else data * self.num_pods
+        return dataclasses.replace(
+            self.pod_spec, data=total, dcn_data=self.num_pods)
+
+    def resolve(self, n_devices: int) -> "PodTopology":
+        """Fill the pod_spec wildcard from the PER-POD device count."""
+        if n_devices % self.num_pods != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible into {self.num_pods} "
+                "pods")
+        return dataclasses.replace(
+            self, pod_spec=self.pod_spec.resolve(n_devices // self.num_pods))
+
+    @property
+    def devices_per_pod(self) -> int:
+        """Device count of one pod (pod_spec must be resolved)."""
+        sizes = self.pod_spec.sizes()
+        if -1 in sizes.values():
+            raise ValueError("pod_spec has an unresolved -1 axis; call "
+                             "resolve(n_devices) first")
+        return math.prod(sizes.values())
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PodTopology":
+        """``{"num_pods": n, "pod": {<MeshSpec axes>}}``."""
+        unknown = set(d) - {"num_pods", "pod"}
+        if unknown:
+            raise ValueError(
+                f"Unknown PodTopology keys {unknown}; valid: num_pods, pod")
+        return cls(num_pods=int(d.get("num_pods", 1)),
+                   pod_spec=MeshSpec.from_dict(d.get("pod", {})))
+
+    def describe(self) -> str:
+        sizes = " ".join(f"{a}={v}" for a, v in self.pod_spec.sizes().items())
+        return f"{self.num_pods} pod(s) × [{sizes}]"
+
+
 def build_mesh(
     spec: MeshSpec | Mapping[str, int] | None = None,
     devices: Sequence[jax.Device] | None = None,

@@ -21,6 +21,12 @@ both halves of that story:
   fleet control plane that supervises worker PROCESSES and turns any
   classified failure into a coordinated gang restart from the latest
   common valid checkpoint (fleet.py);
+- the hierarchical fault-domain layer over THAT (podfleet.py): one
+  fleet.py-derived pod supervisor per pod plus a global coordinator
+  over the same file+signal control plane — two-level
+  ``(global_epoch, pod_incarnation)`` fencing, per-pod quorum restore
+  under a cross-pod barrier, and partition fencing so a stale control
+  plane is never mistaken for a dead pod;
 - the numeric-anomaly defense (anomaly.py): host policy over the
   in-graph no-update-on-nonfinite guard — bounded batch skipping,
   deterministic bad-batch blame (live flag or restart-time bisection),
@@ -42,6 +48,7 @@ from .anomaly import (  # noqa: F401
 from .faults import (  # noqa: F401
     AsyncCommitKill,
     ClockStall,
+    ControlPlanePartition,
     CorruptCheckpoint,
     DataError,
     FaultCallback,
@@ -50,7 +57,9 @@ from .faults import (  # noqa: F401
     FaultyIterator,
     Hang,
     NaNBatch,
+    PodOutage,
     Sigterm,
+    SlowControlPlane,
     SlowWriter,
     TransientIOError,
     corrupt_shard,
@@ -84,6 +93,22 @@ from .fleet import (  # noqa: F401
     valid_steps,
     write_incarnation,
     write_restore_step,
+)
+from .podfleet import (  # noqa: F401
+    PodFleetConfig,
+    PodFleetSupervisor,
+    PodPlan,
+    PodSupervisor,
+    clear_pod_plan,
+    hierarchical_common_step,
+    pod_dir,
+    pod_quorum_step,
+    pod_valid_step_sets,
+    podbeat_path,
+    read_global_epoch,
+    read_pod_plan,
+    write_global_epoch,
+    write_pod_plan,
 )
 from .retry import (  # noqa: F401
     AttemptTimeout,

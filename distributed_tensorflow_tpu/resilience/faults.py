@@ -42,6 +42,23 @@ it drives):
   start of step N's commit (same seam): drives the bounded
   wait()/close() join, the save-phase heartbeat window, and the
   retention-vs-slow-writer ordering tests.
+- ``PodOutage(step)`` — SIGKILLs the process after step N; every worker
+  of the victim pod carries the same fault, so the pod dies as a UNIT —
+  the whole-fault-domain loss only a hierarchy can express
+  (resilience/podfleet.py restarts the pod at its own quorum ceiling
+  while the other pods keep stepping).
+- ``ControlPlanePartition(step, steps)`` — redirects heartbeat writes
+  into a shadow file for a bounded window while training continues
+  (``FaultPlan.callback(writer=...)`` seam → ``HeartbeatWriter.
+  redirect``): the worker's control-plane record goes stale with the
+  process demonstrably alive — the partition the pod supervisor must
+  FENCE on, never restart on (a relaunch would double-train the batch
+  ranges the partitioned original is still training).
+- ``SlowControlPlane(step, delay_s, steps)`` — delays every heartbeat
+  write by a bounded amount for a window (``FaultPlan.beat_pace`` seam
+  → ``HeartbeatCallback(pace=...)``): the gray failure — beats slow
+  but regular, steps advancing — that neither the liveness budget nor
+  the stall detector may convert into a death.
 
 Checkpoint corruption is a disk-level fault, not a run-level one, so it
 is a pair of standalone helpers (``truncate_shard`` / ``corrupt_shard``)
@@ -231,9 +248,54 @@ class SlowWriter:
     delay_s: float = 1.0
 
 
+@dataclasses.dataclass(frozen=True)
+class PodOutage:
+    """SIGKILL this process once step >= ``step`` (``FaultCallback``
+    seam).  Every worker of the victim pod carries the same fault, so
+    the pod dies as a UNIT — the whole-fault-domain loss only a
+    hierarchy can express: resilience/podfleet.py's pod supervisor
+    restarts the pod at its own per-pod quorum ceiling while the other
+    pods keep stepping.  An injected ``flush`` runs first so the
+    flight recording survives the kill."""
+
+    step: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ControlPlanePartition:
+    """Redirect heartbeat writes into a shadow file for ``steps`` steps
+    once step >= ``step`` (``FaultPlan.callback(writer=...)`` seam →
+    ``HeartbeatWriter.redirect``), then restore and beat immediately.
+    The worker keeps training while its control-plane record goes
+    stale with the process demonstrably alive — the partition a pod
+    supervisor must FENCE on, never restart on: a relaunch would
+    double-train the batch ranges the partitioned original is still
+    training."""
+
+    step: int
+    steps: int = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class SlowControlPlane:
+    """Delay every heartbeat write by ``delay_s`` for ``steps`` steps
+    once step >= ``step`` (``FaultPlan.beat_pace`` seam →
+    ``train.callbacks.HeartbeatCallback(pace=...)``): the bounded gray
+    failure — beats slow but regular, steps advancing — that neither
+    the liveness budget nor the stall detector may convert into a
+    death.  ``delay_s`` must stay well under ``heartbeat_timeout_s``
+    for the judgment to hold; the fault models slow control-plane IO,
+    not a partition."""
+
+    step: int
+    delay_s: float = 0.2
+    steps: int = 3
+
+
 Fault = (Sigterm | DataError | NaNBatch | ClockStall | Hang
          | TransientIOError | CorruptCheckpoint | AsyncCommitKill
-         | SlowWriter)
+         | SlowWriter | PodOutage | ControlPlanePartition
+         | SlowControlPlane)
 
 
 # ---------------------------------------------------------------------------
@@ -262,6 +324,11 @@ class FaultPlan:
     #: fault index → remaining fires, for TransientIOError decay
     _transient_left: dict = dataclasses.field(
         default_factory=dict, init=False, compare=False, repr=False)
+    #: indices of ControlPlanePartition faults whose window already
+    #: closed (redirect undone) — plan-level like _fired, so a rebuilt
+    #: callback list mid-window still restores the real heartbeat path
+    _partition_done: set = dataclasses.field(
+        default_factory=set, init=False, compare=False, repr=False)
 
     @classmethod
     def seeded(cls, seed: int, num_steps: int,
@@ -294,12 +361,57 @@ class FaultPlan:
                 faults.append(AsyncCommitKill(at))
             elif kind == "slow_writer":
                 faults.append(SlowWriter(at, delay_s=rng.uniform(0.5, 5.0)))
+            elif kind == "pod_outage":
+                faults.append(PodOutage(at))
+            elif kind == "control_plane_partition":
+                faults.append(
+                    ControlPlanePartition(at, steps=rng.randint(2, 4)))
+            elif kind == "slow_control_plane":
+                faults.append(SlowControlPlane(
+                    at, delay_s=rng.uniform(0.05, 0.5),
+                    steps=rng.randint(2, 4)))
             else:
                 raise ValueError(f"unknown fault kind {kind!r}")
         return cls(tuple(faults))
 
-    def callback(self, clock: FaultClock | None = None) -> "FaultCallback":
-        return FaultCallback(self, clock=clock)
+    def callback(self, clock: FaultClock | None = None,
+                 writer=None, flush=None) -> "FaultCallback":
+        """``writer``: the worker's live ``HeartbeatWriter``, required
+        by ControlPlanePartition (its redirect seam). ``flush``: called
+        before PodOutage's SIGKILL lands so the flight recording
+        reaches disk."""
+        return FaultCallback(self, clock=clock, writer=writer, flush=flush)
+
+    def beat_pace(self, sleep=None):
+        """A ``train.callbacks.HeartbeatCallback(pace=...)`` hook firing
+        this plan's SlowControlPlane faults: a bounded delay injected on
+        the beat path itself — training untouched, every heartbeat
+        write inside the window ``delay_s`` late.  The fault RECORD
+        fires once (plan-shared ``_fired``); the delay applies to every
+        step of the window.  ``sleep`` is injectable for tests."""
+
+        def pace(step: int) -> None:
+            for i, fault in enumerate(self.faults):
+                if not isinstance(fault, SlowControlPlane):
+                    continue
+                if fault.step <= step < fault.step + fault.steps:
+                    if i not in self._fired:
+                        self._fired.add(i)
+                        _record_fault("slow_control_plane", step=step,
+                                      delay_s=fault.delay_s,
+                                      steps=fault.steps)
+                        logger.warning(
+                            "fault: slowing heartbeat writes %.2fs/step "
+                            "for %d steps from step %d",
+                            fault.delay_s, fault.steps, step)
+                    if sleep is not None:
+                        sleep(fault.delay_s)
+                    else:
+                        import time as time_lib
+
+                        time_lib.sleep(fault.delay_s)
+
+        return pace
 
     def wrap(self, iterator, start: int = 0) -> "FaultyIterator":
         """``start``: batches already consumed upstream (a resumed run's
@@ -396,13 +508,29 @@ class FaultCallback(Callback):
     where a GCE maintenance event would: between steps, with the
     PreemptionWatcher already installed."""
 
-    def __init__(self, plan: FaultPlan, clock: FaultClock | None = None):
+    def __init__(self, plan: FaultPlan, clock: FaultClock | None = None,
+                 writer=None, flush=None):
         self.plan = plan
         self.clock = clock
+        self.writer = writer
+        self.flush = flush
 
     def on_step_end(self, trainer, step, metrics):
         fired = self.plan._fired  # plan-shared: at most once per PLAN
         for i, fault in enumerate(self.plan.faults):
+            if (isinstance(fault, ControlPlanePartition) and i in fired
+                    and i not in self.plan._partition_done
+                    and self.writer is not None
+                    and step >= fault.step + fault.steps):
+                # window end: restore the real heartbeat path and beat
+                # at once, so recovery is observable the same instant
+                self.plan._partition_done.add(i)
+                self.writer.redirect(None)
+                self.writer.beat(step=step)
+                logger.warning(
+                    "fault: control-plane partition healed at step %d",
+                    step)
+                continue
             if i in fired:
                 continue
             if isinstance(fault, Sigterm) and step >= fault.step:
@@ -417,6 +545,29 @@ class FaultCallback(Callback):
                     )
                 _record_fault("clock_stall", step=step, dt=fault.dt)
                 self.clock.advance(fault.dt)
+            elif isinstance(fault, PodOutage) and step >= fault.step:
+                fired.add(i)
+                _record_fault("pod_outage", step=step)
+                logger.warning(
+                    "fault: pod outage — SIGKILL at step %d", step)
+                if self.flush is not None:
+                    self.flush()
+                os.kill(os.getpid(), signal_lib.SIGKILL)
+            elif (isinstance(fault, ControlPlanePartition)
+                    and step >= fault.step):
+                fired.add(i)
+                if self.writer is None:
+                    raise ValueError(
+                        "ControlPlanePartition needs "
+                        "FaultPlan.callback(writer=...)")
+                _record_fault("control_plane_partition", step=step,
+                              steps=fault.steps)
+                logger.warning(
+                    "fault: partitioning the control plane for %d steps "
+                    "from step %d (beats go to a shadow file)",
+                    fault.steps, step)
+                self.writer.redirect(
+                    self.writer.path + ".partitioned")
             elif isinstance(fault, Hang) and step >= fault.step:
                 fired.add(i)
                 _record_fault("hang", step=step, advance=fault.advance)

@@ -171,6 +171,7 @@ class HeartbeatWriter:
         self._restore: tuple[int, bool] | None = None
         self._plan: tuple[int, int] | None = None  # (version, world)
         self._stop = threading.Event()
+        self._redirect: str | None = None
         self._pulse: threading.Thread | None = None
         if pulse_interval_s is not None:
             if pulse_interval_s <= 0:
@@ -203,7 +204,18 @@ class HeartbeatWriter:
                 rec["plan_version"], rec["world"] = self._plan
             # write INSIDE the lock: beats from the pulse thread and the
             # work loop serialize, so seq order on disk == write order
-            atomic_write(self.path, json.dumps(rec))
+            atomic_write(self._redirect or self.path, json.dumps(rec))
+
+    def redirect(self, path: str | None) -> None:
+        """Point subsequent beats at ``path`` instead of the real
+        heartbeat file — the control-plane partition seam
+        (resilience/faults.ControlPlanePartition): a writer whose beats
+        land in a shadow file is indistinguishable, to its monitor,
+        from one behind an unreachable directory, while the process
+        keeps working. ``None`` restores the real path; callers beat
+        right after so recovery is observable immediately."""
+        with self._lock:
+            self._redirect = path
 
     def note_restore(self, step: int, fallback: bool) -> None:
         """Record which checkpoint this incarnation restored from — the

@@ -1,0 +1,969 @@
+"""Hierarchical fault domains — a fleet of fleets (docs/resilience.md
+"Hierarchical fault domains").
+
+Everything below resilience/fleet.py assumes ONE flat fault domain: a
+single FleetSupervisor, one ``newest_common_valid_step`` intersection,
+one gang — so any failure the elastic path cannot absorb stops the
+whole world. Real pods are not flat: intra-pod ICI and cross-pod DCN
+fail differently (the MLPerf TPU-pod scaling work treats them as
+different animals), and a whole pod's outage — or a partitioned control
+plane — should degrade, never gang-stop, the planet. This module is the
+two-level layer, built in the exact shape the single-level machinery
+already proved out:
+
+- **One pod supervisor per pod.** ``PodSupervisor`` IS a
+  ``FleetSupervisor`` over the pod's own subdirectory
+  (``<workdir>/pod-<p>/`` — a complete, self-contained fleet dir:
+  heartbeats, INCARNATION, RESTORE_STEP, SHARD_PLAN, catchup/). Worker
+  deaths, stalls, per-pod elastic shrinks, and pod-local gang restarts
+  are handled entirely inside the pod.
+- **A global coordinator over the same file+signal control plane.**
+  Each pod supervisor heartbeats pod-level liveness into
+  ``podbeat-<p>.json`` under the GLOBAL dir with the SAME
+  writer/monitor protocol workers use one level down; the coordinator
+  talks back through one atomic ``POD_PLAN`` file (the PR 12
+  hold→release handshake, one level up). No direct calls cross the
+  boundary in either direction, so a partitioned control plane is a
+  real, injectable failure mode.
+- **Two-level incarnation fencing** ``(global_epoch, pod_incarnation)``.
+  The coordinator bumps ``GLOBAL_EPOCH`` once per run; podbeats and
+  POD_PLANs are stamped with it and records from any other epoch read
+  as *absent*. Inside a pod, the pod's own INCARNATION fences worker
+  beats exactly as before — a worker's identity is the pair.
+- **Hierarchical restore ceilings.** A pod that gang-restarts resumes
+  at its OWN per-pod quorum (``newest_common_valid_step`` over its own
+  ckpt dirs) — healthy pods are never rolled back by a neighbour's
+  outage. The cross-pod ceiling (``hierarchical_common_step``) is the
+  intersection of the LIVE pods' verified-step sets: set-intersection
+  is associative, so with every pod healthy the two-level ceiling
+  equals the flat one, and a dead pod's stale dirs can never veto a
+  healthy pod's quorum because they are excluded from the live set.
+- **Partition fencing, not split-brain.** A pod whose worker
+  heartbeats ALL go stale while the processes are demonstrably alive
+  (``poll()`` still None — with pulsed writers a live process always
+  ticks ``seq``, so frozen-file + live-handle means the control plane,
+  not the worker, failed) is FENCED: the supervisor emits
+  ``pod_fence``, takes no restore/relaunch action, and waits. Acting
+  on the stale record — relaunching workers whose originals are still
+  training — would double-train the same batch ranges: the split-brain
+  this rule exists to prevent. The fence lifts the moment fresh beats
+  land (``pod_unfence``); only past ``fence_timeout_s`` does the pod
+  take the ordinary outage path (where the gang stop first kills every
+  still-alive handle, so even the escalation cannot split-brain).
+- **Bounded cross-pod skew.** While a pod restarts, healthy pods keep
+  stepping until they lead the restarting pod's ceiling by
+  ``max_pod_skew_steps``; then the coordinator writes a POD_PLAN hold,
+  each held pod supervisor parks its OWN workers at a worker-level
+  barrier (the PR 12 machinery unchanged), and the release follows the
+  recovered pod's first live beat. With ``elastic_pods=True`` the
+  coordinator instead shrinks the cross-pod data axis immediately
+  (hold → release at world = live pods) and grows it back on rejoin —
+  the same shrink/rejoin dance ``FleetSupervisor`` does per worker.
+
+Clocks and sleeps are injectable; nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Mapping, Sequence
+
+from ..obs import flightrec as flightrec_lib
+from ..obs.flightrec import FlightRecorder
+from ..obs.registry import Registry, default_registry
+from .fleet import (
+    FleetConfig,
+    FleetExhausted,
+    FleetSupervisor,
+    PLAN_HOLD,
+    PLAN_STEADY,
+    newest_common_valid_step,
+    read_restore_step,
+    valid_steps,
+)
+from .liveness import (
+    DEAD,
+    HeartbeatMonitor,
+    HeartbeatWriter,
+    atomic_write as _atomic_write,
+)
+from .supervisor import FATAL
+
+logger = logging.getLogger(__name__)
+
+GLOBAL_EPOCH_FILE = "GLOBAL_EPOCH"
+_POD_PLAN_FILE = "POD_PLAN"
+
+#: metric names (documented in docs/observability.md)
+POD_RESTARTS_TOTAL = "pod_restarts_total"
+FLEET_PODS_LIVE = "fleet_pods_live"
+POD_BARRIER_SECONDS = "pod_barrier_seconds"
+
+#: podbeat phases a pod supervisor moves through ("barrier" is in
+#: liveness.HOLD_PHASES, so a coordinator monitor never calls a held
+#: pod stalled; "fenced" changes the progress tuple, so neither does a
+#: fence)
+POD_TRAIN = "train"
+POD_RESTARTING = "restarting"
+POD_FENCED = "fenced"
+POD_BARRIER = "barrier"
+
+
+def pod_dir(workdir: str, pod: int) -> str:
+    """Pod ``pod``'s own fleet dir — a complete single-level control
+    plane (heartbeats, INCARNATION, RESTORE_STEP, SHARD_PLAN) nested
+    under the global one."""
+    return os.path.join(
+        os.path.abspath(os.path.expanduser(workdir)), f"pod-{pod}")
+
+
+def podbeat_path(workdir: str, pod: int) -> str:
+    """Pod ``pod``'s pod-level heartbeat under the GLOBAL dir — written
+    by its pod supervisor with the same protocol workers use one level
+    down (incarnation field = the global epoch)."""
+    return os.path.join(
+        os.path.abspath(os.path.expanduser(workdir)), f"podbeat-{pod}.json")
+
+
+def read_global_epoch(workdir: str) -> int:
+    """Current global epoch (0 when no pod fleet has ever run here)."""
+    path = os.path.join(
+        os.path.abspath(os.path.expanduser(workdir)), GLOBAL_EPOCH_FILE)
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except FileNotFoundError:
+        return 0
+    except (OSError, ValueError) as e:
+        logger.warning("unreadable global-epoch file %s (%s); assuming 0",
+                       path, e)
+        return 0
+
+
+def write_global_epoch(workdir: str, epoch: int) -> None:
+    d = os.path.abspath(os.path.expanduser(workdir))
+    os.makedirs(d, exist_ok=True)
+    _atomic_write(os.path.join(d, GLOBAL_EPOCH_FILE), f"{int(epoch)}\n")
+
+
+# ---------------------------------------------------------------------------
+# Pod plan (cross-pod hold/release control file — the ShardPlan shape,
+# one level up: ranks map PODS onto the cross-pod data axis)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PodPlan:
+    """One cross-pod sharding epoch. ``ranks`` maps pod → rank over
+    ``world`` (the cross-pod data axis); ``phase == PLAN_HOLD`` names
+    the pods whose supervisors must park their workers at a worker-level
+    barrier until a newer steady release. ``epoch``-fenced: a plan from
+    any other global epoch reads as absent."""
+
+    version: int
+    phase: str
+    world: int
+    ranks: dict[int, int]
+    barrier_step: int
+    epoch: int = 0
+    hold: tuple[int, ...] = ()
+    #: the NOMINAL pod count the run was configured for
+    num_pods: int = 0
+
+    def __post_init__(self):
+        if self.phase not in (PLAN_STEADY, PLAN_HOLD):
+            raise ValueError(f"unknown pod-plan phase {self.phase!r}")
+        if self.world < 1 or self.version < 1:
+            raise ValueError("pod-plan world and version must be >= 1")
+        if sorted(self.ranks.values()) != list(range(len(self.ranks))):
+            raise ValueError(
+                f"pod-plan ranks must be a bijection onto "
+                f"0..{len(self.ranks) - 1}, got {self.ranks}")
+        if self.world != len(self.ranks):
+            raise ValueError(
+                f"pod-plan world={self.world} != {len(self.ranks)} ranks")
+
+
+def _pod_plan_path(workdir: str) -> str:
+    return os.path.join(
+        os.path.abspath(os.path.expanduser(workdir)), _POD_PLAN_FILE)
+
+
+def read_pod_plan(workdir: str, epoch: int | None = None) -> PodPlan | None:
+    """Current pod plan; None when absent, unreadable, or (with
+    ``epoch`` given) stamped with a different global epoch — a stale
+    plan file must never be actionable, that is the fencing rule."""
+    try:
+        with open(_pod_plan_path(workdir)) as f:
+            d = json.load(f)
+        plan = PodPlan(
+            version=int(d["version"]), phase=str(d["phase"]),
+            world=int(d["world"]),
+            ranks={int(k): int(v) for k, v in d["ranks"].items()},
+            barrier_step=int(d["barrier_step"]),
+            epoch=int(d.get("epoch", 0)),
+            hold=tuple(int(i) for i in d.get("hold", ())),
+            num_pods=int(d.get("num_pods", 0)),
+        )
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        logger.warning("unreadable pod plan in %s (%s); treating as absent",
+                       workdir, e)
+        return None
+    if epoch is not None and plan.epoch != int(epoch):
+        return None
+    return plan
+
+
+def write_pod_plan(workdir: str, plan: PodPlan) -> None:
+    d = os.path.abspath(os.path.expanduser(workdir))
+    os.makedirs(d, exist_ok=True)
+    _atomic_write(os.path.join(d, _POD_PLAN_FILE), json.dumps({
+        "version": plan.version, "phase": plan.phase, "world": plan.world,
+        "ranks": {str(k): v for k, v in plan.ranks.items()},
+        "barrier_step": plan.barrier_step, "epoch": plan.epoch,
+        "hold": list(plan.hold), "num_pods": plan.num_pods,
+    }))
+
+
+def clear_pod_plan(workdir: str) -> None:
+    path = _pod_plan_path(workdir)
+    if os.path.exists(path):
+        os.remove(path)
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical restore ceilings
+# ---------------------------------------------------------------------------
+
+
+def pod_quorum_step(ckpt_dirs: Sequence[str]) -> int | None:
+    """A pod's OWN restart point: the newest step every worker of the
+    pod retains and can verify — ``newest_common_valid_step`` scoped to
+    one fault domain. This is the ceiling a pod-local gang restart
+    resumes at; no other pod's retention appears in it."""
+    return newest_common_valid_step(ckpt_dirs)
+
+
+def pod_valid_step_sets(
+    pod_ckpt_dirs: Mapping[int, Sequence[str]],
+) -> dict[int, set[int]]:
+    """Per-pod quorum SETS: pod → the steps every one of its workers
+    can verify (the intersection within the pod)."""
+    out: dict[int, set[int]] = {}
+    for p, dirs in pod_ckpt_dirs.items():
+        if not dirs:
+            out[p] = set()
+            continue
+        common = set(valid_steps(dirs[0]))
+        for d in dirs[1:]:
+            common &= set(valid_steps(d))
+        out[p] = common
+    return out
+
+
+def hierarchical_common_step(
+    pod_ckpt_dirs: Mapping[int, Sequence[str]],
+    live_pods: Sequence[int] | None = None,
+) -> int | None:
+    """The cross-pod restart point: per-pod quorum first, then the
+    intersection across the LIVE pods. Set-intersection is associative,
+    so with ``live_pods`` covering every pod this equals the flat
+    ``newest_common_valid_step`` over all dirs — and excluding a dead
+    pod from ``live_pods`` is exactly what keeps its stale dirs from
+    vetoing a healthy pod's quorum. Empty intersection pins to 0 (the
+    live pods fresh-start together); None when no live pod has dirs."""
+    live = set(live_pods) if live_pods is not None else None
+    quorums = pod_valid_step_sets(pod_ckpt_dirs)
+    pods = [p for p in sorted(pod_ckpt_dirs)
+            if (live is None or p in live) and pod_ckpt_dirs[p]]
+    if not pods:
+        return None
+    common = set(quorums[pods[0]])
+    for p in pods[1:]:
+        common &= quorums[p]
+    return max(common) if common else 0
+
+
+# ---------------------------------------------------------------------------
+# Pod-tagged flight recording
+# ---------------------------------------------------------------------------
+
+
+class _PodTaggedRecorder:
+    """Duck-typed FlightRecorder proxy that stamps ``pod`` onto every
+    event — a pod supervisor's whole record (fleet_launch,
+    fleet_gang_stop, …) lands in the shared ring tagged with its fault
+    domain, which is what lets ONE merged timeline span coordinator →
+    pod supervisors → workers (obs/fleetview.py matches anchors per
+    pod). Everything but ``emit`` forwards to the real ring."""
+
+    def __init__(self, rec: FlightRecorder, pod: int):
+        self._rec = rec
+        self.pod = int(pod)
+
+    def emit(self, kind: str, step: int | None = None, **attrs: Any) -> None:
+        attrs.setdefault("pod", self.pod)
+        self._rec.emit(kind, step=step, **attrs)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._rec, name)
+
+
+# ---------------------------------------------------------------------------
+# Pod-level supervision config
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PodFleetConfig:
+    #: coordinator poll cadence
+    poll_s: float = 0.25
+    #: fence instead of restarting when a worker's heartbeat goes stale
+    #: while its process is still alive (requires pulsed writers for the
+    #: judgment to be sound — a live pulsed process always ticks seq)
+    fence_on_partition: bool = True
+    #: a fence older than this escalates to the ordinary outage path
+    #: (the gang stop kills the still-alive handles first, so even the
+    #: escalation cannot split-brain)
+    fence_timeout_s: float = 60.0
+    #: healthy pods may lead a restarting pod's ceiling by this many
+    #: steps before the coordinator holds them at a cross-pod barrier
+    max_pod_skew_steps: int = 64
+    #: a cross-pod hold is released after this budget even if the
+    #: restarting pod is still down — unbounded skew (deterministic
+    #: replay covers it) beats cascading worker hold-timeouts
+    pod_hold_timeout_s: float = 45.0
+    #: shrink the cross-pod data axis on a pod outage instead of
+    #: holding at a skew barrier; grow it back when the pod rejoins
+    elastic_pods: bool = False
+    #: no podbeat within this budget after the first one → the pod's
+    #: control plane is stale (fence if its thread is alive). Sized
+    #: above the longest gang-stop + restart backoff a pod supervisor
+    #: sits through without polling.
+    podbeat_timeout_s: float = 45.0
+    #: podbeats ticking but pod-level progress frozen this long → stalled
+    pod_stall_timeout_s: float = 600.0
+    #: budget for a pod supervisor's FIRST podbeat
+    pod_launch_grace_s: float = 120.0
+
+    def __post_init__(self):
+        if self.poll_s <= 0:
+            raise ValueError("poll_s must be positive")
+        if self.fence_timeout_s <= 0 or self.pod_hold_timeout_s <= 0:
+            raise ValueError("fence/hold budgets must be positive")
+        if self.max_pod_skew_steps < 1:
+            raise ValueError("max_pod_skew_steps must be >= 1")
+
+
+# ---------------------------------------------------------------------------
+# Pod supervisor: a FleetSupervisor that is also a citizen of a pod fleet
+# ---------------------------------------------------------------------------
+
+
+class PodSupervisor(FleetSupervisor):
+    """One pod's FleetSupervisor, extended with the pod-fleet protocol:
+
+    - every flight-recorder event it (or its aggregator) emits carries
+      ``pod`` — the merged postmortem's fault-domain label;
+    - it heartbeats pod-level liveness into ``podbeat-<p>.json`` under
+      the global dir (incarnation field = global epoch) every poll
+      round, carrying min member step, restart count, and phase;
+    - a gang failure emits ``pod_outage`` before the stop,
+      ``pod_restart`` (with the per-pod quorum ceiling) at the
+      relaunch, and ``pod_rejoin`` when the new gang confirms live —
+      the pod-level causal chain the two-pod chaos round asserts;
+    - worker heartbeats that ALL go stale while their processes are
+      alive FENCE the pod (``pod_fence``) instead of restarting it —
+      the control plane, not the worker, failed (see the module
+      docstring's split-brain rule);
+    - it obeys the coordinator's POD_PLAN: a hold naming this pod parks
+      the pod's own workers at a worker-level barrier (elastic mode's
+      PLAN_HOLD, unchanged), and the release un-parks them.
+    """
+
+    def __init__(self, pod: int, global_dir: str, epoch: int,
+                 *args: Any, pod_cfg: PodFleetConfig = PodFleetConfig(),
+                 **kwargs: Any):
+        self.pod = int(pod)
+        self.global_dir = os.path.abspath(os.path.expanduser(global_dir))
+        self.epoch = int(epoch)
+        self.pod_cfg = pod_cfg
+        rec = kwargs.pop("flightrec", None)
+        if rec is None:
+            rec = flightrec_lib.default_recorder()
+        kwargs["flightrec"] = _PodTaggedRecorder(rec, pod)
+        super().__init__(*args, **kwargs)
+        self._podbeat_writer = HeartbeatWriter(
+            podbeat_path(self.global_dir, self.pod), incarnation=self.epoch,
+            clock=self.clock)
+        #: partition fence state: {"t0": monitor-clock fence start}
+        self._fence: dict | None = None
+        #: cross-pod hold state: {"pod_version", "version", "holders"}
+        self._pod_hold: dict | None = None
+        #: newest POD_PLAN version acted on
+        self._pod_plan_applied = 0
+        self._pod_phase = POD_TRAIN
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self) -> dict:
+        try:
+            out = super().run()
+            self._podbeat_writer.finish("done")
+            return out
+        except FleetExhausted as e:
+            self._podbeat_writer.finish("failed", cause=e.cause)
+            raise
+        except BaseException:
+            self._podbeat_writer.finish("failed", cause=FATAL)
+            raise
+
+    def request_stop(self) -> None:
+        """Coordinator-side global gang stop: make this pod's next poll
+        take the preempted-teardown path (coordinated worker saves)
+        without delivering a real signal. The sentinel 0 keeps the
+        run() epilogue's re-delivery a no-op (``os.kill(pid, 0)`` only
+        checks liveness)."""
+        self._stop_signal.append(0)
+        self.interrupt()
+
+    # -- pod-level causal chain -------------------------------------------
+
+    def _gang_path(self, cause: str, detail: str):
+        self._fence = None
+        self._pod_phase = POD_RESTARTING
+        self.flightrec.emit("pod_outage", cause=cause)
+        self._podbeat_writer.beat(attempt=self.restarts,
+                                  phase=POD_RESTARTING)
+        return super()._gang_path(cause, detail)
+
+    def _gang_restart(self, cause: str):
+        pending = super()._gang_restart(cause)
+        self.registry.counter(
+            POD_RESTARTS_TOTAL, "pod-local gang restarts by failure class",
+            cause=cause,
+        ).inc()
+        self.flightrec.emit("pod_restart", restart=self.restarts,
+                            cause=cause, ceiling=self._ceiling)
+        self._podbeat_writer.beat(attempt=self.restarts,
+                                  phase=POD_RESTARTING)
+        return pending
+
+    # -- poll round: fence, rejoin, pod plan, podbeat ---------------------
+
+    def _poll_round(self, pending_restart, relayed):
+        out = super()._poll_round(pending_restart, relayed)
+        nxt_pending, nxt_relayed, failed = out
+        if failed is not None:
+            failed = self._maybe_fence(failed)
+            out = (nxt_pending, nxt_relayed, failed)
+        elif self._fence is not None:
+            # super() reported NO failure this round: the heartbeat is
+            # fresh again, so the partition healed. (A failure the
+            # fence itself suppressed must NOT land here — unfencing on
+            # it would reset the fence clock every poll and neuter
+            # fence_timeout_s.)
+            self._unfence()
+        if (pending_restart is not None and nxt_pending is None
+                and failed is None):
+            self._pod_phase = POD_TRAIN
+            self.flightrec.emit("pod_rejoin", restart=pending_restart[0])
+        self._pod_plan_tick()
+        self._podbeat()
+        return out
+
+    def _maybe_fence(self, failed):
+        """The partition-fencing judgment. ``failed`` came out of the
+        flat poll round; suppress it (return None) when the evidence
+        says control-plane partition — heartbeat file frozen
+        (monitor-clock DEAD) while the worker process is demonstrably
+        alive — rather than death. Everything else (exit codes, stalls,
+        a worker that never beat) passes through untouched."""
+        worker, cause, detail = failed
+        w = self._workers[worker]
+        if (not self.pod_cfg.fence_on_partition
+                or w.handle.poll() is not None
+                or w.monitor.heartbeat is None
+                or w.monitor.check() != DEAD):
+            return failed
+        now = self.clock()
+        if self._fence is None:
+            self._fence = {"t0": now}
+            self._pod_phase = POD_FENCED
+            self.flightrec.emit("pod_fence", worker=worker)
+            self._podbeat(phase=POD_FENCED)
+            logger.warning(
+                "podfleet: pod %d FENCED — worker %d's heartbeat is stale "
+                "but pid %s is alive; treating as control-plane partition, "
+                "taking no action on the stale record", self.pod, worker,
+                getattr(w.handle, "pid", None))
+        if now - self._fence["t0"] > self.pod_cfg.fence_timeout_s:
+            logger.error(
+                "podfleet: pod %d fence outlived %.1fs; escalating to the "
+                "outage path", self.pod, self.pod_cfg.fence_timeout_s)
+            return (worker, cause, f"fence timeout: {detail}")
+        return None
+
+    def _unfence(self) -> None:
+        fenced_s = max(self.clock() - self._fence["t0"], 0.0)
+        self._fence = None
+        self._pod_phase = POD_TRAIN
+        self.flightrec.emit("pod_unfence", fenced_s=round(fenced_s, 6))
+        self._podbeat(phase=POD_TRAIN)
+        logger.warning("podfleet: pod %d unfenced after %.2fs — control "
+                       "plane is back, nothing was restarted", self.pod,
+                       fenced_s)
+
+    def _pod_plan_tick(self) -> None:
+        """Obey the coordinator's POD_PLAN (epoch-fenced read). A hold
+        naming this pod is propagated DOWN as a worker-level PLAN_HOLD
+        over the pod's own members; the steady release un-parks them at
+        an unchanged sharding. Pods whose workers do not speak the plan
+        channel (cfg.elastic=False) cannot be paused and simply ack."""
+        plan = read_pod_plan(self.global_dir, epoch=self.epoch)
+        if plan is None or plan.version <= self._pod_plan_applied:
+            if self._pod_hold is not None and plan is not None \
+                    and plan.version == self._pod_plan_applied:
+                self._check_pod_hold_acked(plan)
+            return
+        if plan.phase == PLAN_HOLD and self.pod in plan.hold:
+            self._begin_pod_hold(plan)
+        elif plan.phase == PLAN_STEADY:
+            self._release_pod_hold(plan)
+        else:
+            # a hold not naming us: nothing to do until the release
+            self._pod_plan_applied = plan.version
+            self._podbeat_writer.note_plan(plan.version, plan.world)
+
+    def _begin_pod_hold(self, plan: PodPlan) -> None:
+        if self._resize is not None:
+            return  # an own-gang resize is in flight; retry next round
+        if not self.cfg.elastic:
+            # no worker-level plan channel: the pod cannot pause, so it
+            # acks immediately and keeps stepping (documented unbounded-
+            # skew fallback)
+            self._pod_plan_applied = plan.version
+            self._podbeat_writer.note_plan(plan.version, plan.world)
+            return
+        holders = tuple(sorted(
+            w.index for w in self._workers if w.member and not w.done))
+        self._pod_plan_applied = plan.version
+        if not holders:
+            self._podbeat_writer.note_plan(plan.version, plan.world)
+            self._podbeat(phase=POD_BARRIER)
+            return
+        v = self._plan.version + 1
+        wplan = dataclasses.replace(
+            self._plan, version=v, phase=PLAN_HOLD, hold=holders)
+        # anchor BEFORE the plan write (same discipline as _begin_shrink)
+        self.flightrec.emit("fleet_hold", version=v, hold=list(holders),
+                            resize="podhold")
+        self._write_plan(wplan)
+        self._pod_hold = {"pod_version": plan.version, "version": v,
+                          "holders": holders, "world": plan.world}
+        logger.warning("podfleet: pod %d holding %s for the cross-pod "
+                       "barrier (pod plan v%d)", self.pod, list(holders),
+                       plan.version)
+
+    def _check_pod_hold_acked(self, plan: PodPlan) -> None:
+        """Podbeat phase flips to ``barrier`` (the coordinator's ack
+        signal) only once every held worker parked."""
+        st = self._pod_hold
+        for i in st["holders"]:
+            w = self._workers[i]
+            if w.done:
+                continue
+            hb = w.monitor.heartbeat
+            if (hb is None or hb.plan_version != st["version"]
+                    or hb.phase != "barrier"):
+                return
+        if self._pod_phase != POD_BARRIER:
+            self._pod_phase = POD_BARRIER
+            self._podbeat_writer.note_plan(st["pod_version"], st["world"])
+            self._podbeat(phase=POD_BARRIER)
+
+    def _release_pod_hold(self, plan: PodPlan) -> None:
+        self._pod_plan_applied = plan.version
+        self._podbeat_writer.note_plan(plan.version, plan.world)
+        st, self._pod_hold = self._pod_hold, None
+        if st is None:
+            return
+        steps = [hb.step for i in st["holders"]
+                 if (hb := self._workers[i].monitor.heartbeat) is not None]
+        barrier = max([plan.barrier_step] + steps)
+        v = self._plan.version + 1
+        # release anchor BEFORE the plan write, mirroring _release(): a
+        # worker's elastic_release can only follow its read of the
+        # steady plan, so this pod_release strictly precedes it
+        self.flightrec.emit("pod_release", version=v,
+                            world=self._plan.world, barrier=barrier)
+        self._write_plan(dataclasses.replace(
+            self._plan, version=v, phase=PLAN_STEADY, hold=(),
+            barrier_step=barrier))
+        self._pod_phase = POD_TRAIN
+        self._podbeat(phase=POD_TRAIN)
+        logger.warning("podfleet: pod %d released from the cross-pod "
+                       "barrier at step %d (plan v%d)", self.pod, barrier, v)
+
+    def _podbeat(self, phase: str | None = None) -> None:
+        steps = [hb.step for w in self._workers
+                 if w.member and (hb := w.monitor.heartbeat) is not None]
+        self._podbeat_writer.beat(
+            step=min(steps) if steps else 0, attempt=self.restarts,
+            phase=phase if phase is not None else self._pod_phase)
+
+
+# ---------------------------------------------------------------------------
+# Global coordinator
+# ---------------------------------------------------------------------------
+
+
+class PodFleetSupervisor:
+    """Supervise a fleet of pod fleets.
+
+    ``launch(pod, worker, incarnation)`` starts one worker of one pod
+    and returns a Popen-shaped handle — the same seam FleetSupervisor
+    takes, plus the fault-domain coordinate. ``ckpt_dirs`` (optional)
+    is one sequence of per-worker checkpoint dirs PER POD; each pod's
+    restore ceiling is computed only over its own — the per-pod quorum.
+
+    The coordinator runs every pod's ``PodSupervisor`` in a thread
+    (signal handling stays on the coordinator's main thread) but talks
+    to them only through the file control plane: podbeats up, POD_PLAN
+    down. ``run()`` returns ``{"epoch", "restarts", "pod_restarts",
+    "resizes"}``; a pod that exhausts its restart budget (or fails a
+    non-restartable class) stops the planet — every other pod is
+    gang-stopped through its coordinated-save path and
+    ``FleetExhausted`` propagates with the postmortem dumped."""
+
+    def __init__(
+        self,
+        launch: Callable[[int, int, int], Any],
+        num_pods: int,
+        workers_per_pod: int,
+        workdir: str,
+        cfg: FleetConfig = FleetConfig(),
+        pod_cfg: PodFleetConfig = PodFleetConfig(),
+        ckpt_dirs: Sequence[Sequence[str]] | None = None,
+        registry: Registry | None = None,
+        flightrec: FlightRecorder | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] | None = None,
+        postmortem_dir: str | None = None,
+    ):
+        if num_pods < 1:
+            raise ValueError("num_pods must be >= 1")
+        if workers_per_pod < 1:
+            raise ValueError("workers_per_pod must be >= 1")
+        if ckpt_dirs is not None and len(ckpt_dirs) != num_pods:
+            raise ValueError("ckpt_dirs must have one entry per pod")
+        self.launch = launch
+        self.num_pods = num_pods
+        self.workers_per_pod = workers_per_pod
+        self.workdir = os.path.abspath(os.path.expanduser(workdir))
+        self.cfg = cfg
+        self.pod_cfg = pod_cfg
+        self.ckpt_dirs = (
+            [list(d) for d in ckpt_dirs] if ckpt_dirs is not None else None)
+        self.registry = registry if registry is not None else default_registry()
+        self.flightrec = (flightrec if flightrec is not None
+                          else flightrec_lib.default_recorder())
+        self.clock = clock
+        self.sleep = sleep
+        self.postmortem_dir = postmortem_dir or self.workdir
+        self.epoch = 0
+        self.pods: list[PodSupervisor] = []
+        self._results: dict[int, dict] = {}
+        self._errors: dict[int, BaseException] = {}
+        self._threads: list[threading.Thread] = []
+        self._monitors: list[HeartbeatMonitor] = []
+        self._plan: PodPlan | None = None
+        #: cross-pod barrier state: {"version", "t0", "hold", "reason"}
+        self._hold: dict | None = None
+        #: coordinator-level fence flags (stale podbeat, live thread)
+        self._pod_fenced: set[int] = set()
+        self._m_live = self.registry.gauge(
+            FLEET_PODS_LIVE, "pods currently making training progress "
+            "(live podbeat in a train/barrier phase)")
+        self._h_barrier = self.registry.histogram(
+            POD_BARRIER_SECONDS,
+            "cross-pod barrier wall seconds, hold write to release write")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _wait(self, delay: float) -> None:
+        if self.sleep is not None:
+            self.sleep(delay)
+        else:
+            time.sleep(delay)
+
+    def _run_pod(self, p: int) -> None:
+        try:
+            self._results[p] = self.pods[p].run()
+        except BaseException as e:  # held for the coordinator to classify
+            self._errors[p] = e
+
+    def run(self) -> dict:
+        os.makedirs(self.workdir, exist_ok=True)
+        self.epoch = read_global_epoch(self.workdir) + 1
+        write_global_epoch(self.workdir, self.epoch)
+        clear_pod_plan(self.workdir)
+        self._plan = PodPlan(
+            version=1, phase=PLAN_STEADY, world=self.num_pods,
+            ranks={p: p for p in range(self.num_pods)}, barrier_step=0,
+            epoch=self.epoch, num_pods=self.num_pods)
+        write_pod_plan(self.workdir, self._plan)
+        self._hold = None
+        self._pod_fenced = set()
+        self._results = {}
+        self._errors = {}
+        self.flightrec.emit("fleet_start",
+                            workers=self.num_pods * self.workers_per_pod,
+                            incarnation=self.epoch, pods=self.num_pods)
+        self.pods = [
+            PodSupervisor(
+                p, self.workdir, self.epoch,
+                # FleetSupervisor args: launch, num_workers, workdir, ...
+                (lambda i, inc, _p=p: self.launch(_p, i, inc)),
+                self.workers_per_pod, pod_dir(self.workdir, p),
+                cfg=self.cfg, pod_cfg=self.pod_cfg,
+                ckpt_dirs=(self.ckpt_dirs[p]
+                           if self.ckpt_dirs is not None else None),
+                registry=self.registry, flightrec=self.flightrec,
+                clock=self.clock, sleep=self.sleep,
+                postmortem_dir=pod_dir(self.workdir, p),
+            )
+            for p in range(self.num_pods)
+        ]
+        self._monitors = [
+            HeartbeatMonitor(
+                podbeat_path(self.workdir, p), self.epoch, clock=self.clock,
+                heartbeat_timeout_s=self.pod_cfg.podbeat_timeout_s,
+                stall_timeout_s=self.pod_cfg.pod_stall_timeout_s,
+                launch_grace_s=self.pod_cfg.pod_launch_grace_s)
+            for p in range(self.num_pods)
+        ]
+        self._threads = [
+            threading.Thread(target=self._run_pod, args=(p,),
+                             name=f"podfleet-p{p}", daemon=True)
+            for p in range(self.num_pods)
+        ]
+        self._m_live.set(self.num_pods)
+        for t in self._threads:
+            t.start()
+        try:
+            while any(t.is_alive() for t in self._threads):
+                self._wait(self.pod_cfg.poll_s)
+                self._coordinate()
+                if self._errors:
+                    self._global_gang_stop()
+                    break
+            for t in self._threads:
+                t.join()
+        finally:
+            for p, sup in enumerate(self.pods):
+                for w in sup._workers:
+                    if w.handle.poll() is None:
+                        logger.error("podfleet: killing pod %d worker %d "
+                                     "still alive at coordinator exit", p,
+                                     w.index)
+                        w.handle.kill()
+        if self._errors:
+            cause, detail = self._classify_errors()
+            self.flightrec.emit(
+                "fleet_exhausted", cause=cause,
+                restarts=sum(s.restarts for s in self.pods),
+                pods=sorted(self._errors))
+            flightrec_lib.dump_postmortem(
+                self.flightrec, self.postmortem_dir,
+                reason=f"podfleet_exhausted:{cause}")
+            raise FleetExhausted(cause,
+                                 sum(s.restarts for s in self.pods), detail)
+        self._m_live.set(0)
+        self.flightrec.emit("fleet_done", incarnation=self.epoch,
+                            pods=self.num_pods)
+        logger.info("podfleet: all %d pods done (epoch %d)", self.num_pods,
+                    self.epoch)
+        return {
+            "epoch": self.epoch,
+            "restarts": sum(s.restarts for s in self.pods),
+            "pod_restarts": {p: s.restarts
+                             for p, s in enumerate(self.pods)},
+            "resizes": sum(s.resizes for s in self.pods),
+        }
+
+    def _classify_errors(self) -> tuple[str, str]:
+        p = sorted(self._errors)[0]
+        e = self._errors[p]
+        if isinstance(e, FleetExhausted):
+            return e.cause, f"pod {p}: {e}"
+        return FATAL, f"pod {p}: {e!r}"
+
+    def _global_gang_stop(self) -> None:
+        """A pod is irrecoverably down: pod-local restart lost, global
+        gang-stop wins. Every still-running pod supervisor takes its
+        preempted-teardown path (coordinated worker saves)."""
+        failed = sorted(self._errors)
+        logger.error("podfleet: pod(s) %s exhausted; stopping the planet",
+                     failed)
+        for p, t in enumerate(self._threads):
+            if t.is_alive():
+                self.pods[p].request_stop()
+        for t in self._threads:
+            t.join()
+
+    # -- one coordinator tick ---------------------------------------------
+
+    def _pod_states(self) -> list[tuple[str, str | None]]:
+        """(liveness status, last podbeat phase) per pod, from the
+        podbeat files alone — the coordinator never reaches into a pod
+        supervisor's memory for its judgment."""
+        out = []
+        for m in self._monitors:
+            status = m.check()
+            hb = m.heartbeat
+            out.append((status, hb.phase if hb is not None else None))
+        return out
+
+    def _coordinate(self) -> None:
+        states = self._pod_states()
+        live = 0
+        restarting: list[int] = []
+        for p, (status, phase) in enumerate(states):
+            alive = self._threads[p].is_alive()
+            if phase in (POD_TRAIN, POD_BARRIER) and status != DEAD and alive:
+                live += 1
+            if phase == POD_RESTARTING and alive:
+                restarting.append(p)
+            # coordinator-side fencing: a pod whose podbeat went stale
+            # while its supervisor is demonstrably alive is FENCED — its
+            # stale record is never acted on (not counted live, never a
+            # reason to hold or reshard the others)
+            if (status == DEAD and alive
+                    and self._monitors[p].heartbeat is not None
+                    and phase not in (POD_RESTARTING, "done", "failed")):
+                if p not in self._pod_fenced:
+                    self._pod_fenced.add(p)
+                    self.flightrec.emit(
+                        "pod_fence", pod=p,
+                        stale_s=round(self.pod_cfg.podbeat_timeout_s, 6))
+                    logger.warning("podfleet: coordinator fenced pod %d — "
+                                   "podbeat stale, supervisor alive", p)
+            elif p in self._pod_fenced and status != DEAD:
+                self._pod_fenced.discard(p)
+                self.flightrec.emit("pod_unfence", pod=p, fenced_s=None)
+        self._m_live.set(live)
+        self._barrier_tick(states, restarting)
+
+    def _barrier_tick(self, states, restarting: list[int]) -> None:
+        """The cross-pod skew barrier (or, with elastic_pods, the
+        cross-pod shrink/rejoin) — all of it through POD_PLAN writes."""
+        now = self.clock()
+        if self._hold is not None:
+            self._hold_tick(states, restarting, now)
+            return
+        if not restarting:
+            return
+        healthy = [p for p in range(self.num_pods)
+                   if p not in restarting and p not in self._pod_fenced
+                   and self._threads[p].is_alive()
+                   and states[p][1] not in ("done", "failed")]
+        if not healthy:
+            return
+        if self.pod_cfg.elastic_pods:
+            self._write_hold(healthy, now, reason="shrink")
+            return
+        # bounded skew: hold only once a healthy pod leads the
+        # restarting pod's own quorum ceiling by max_pod_skew_steps
+        floor = min((read_restore_step(pod_dir(self.workdir, p)) or 0)
+                    for p in restarting)
+        lead = max((self._monitors[p].heartbeat.step
+                    if self._monitors[p].heartbeat is not None else 0)
+                   for p in healthy)
+        if lead - floor > self.pod_cfg.max_pod_skew_steps:
+            self._write_hold(healthy, now, reason="skew")
+
+    def _write_hold(self, healthy: list[int], now: float,
+                    reason: str) -> None:
+        v = self._plan.version + 1
+        # anchor BEFORE the plan write: a pod supervisor's fleet_hold
+        # (resize=podhold) can only follow its read of this plan
+        self.flightrec.emit("pod_hold", version=v, hold=list(healthy),
+                            reason=reason)
+        self._plan = dataclasses.replace(
+            self._plan, version=v, phase=PLAN_HOLD, hold=tuple(healthy))
+        write_pod_plan(self.workdir, self._plan)
+        self._hold = {"version": v, "t0": now, "hold": tuple(healthy),
+                      "reason": reason, "stage": "hold"}
+        logger.warning("podfleet: cross-pod hold v%d over pods %s (%s)",
+                       v, healthy, reason)
+
+    def _hold_tick(self, states, restarting: list[int], now: float) -> None:
+        st = self._hold
+        overrun = now - st["t0"] > self.pod_cfg.pod_hold_timeout_s
+        if st["stage"] == "hold":
+            acked = all(
+                (hb := self._monitors[p].heartbeat) is not None
+                and hb.plan_version == st["version"]
+                for p in st["hold"]
+                if self._threads[p].is_alive())
+            if not acked and not overrun:
+                return
+            if self.pod_cfg.elastic_pods and st["reason"] == "shrink":
+                if restarting and not overrun:
+                    # shrink now: the survivors train at world=len(hold)
+                    self._write_release(st, world=len(st["hold"]),
+                                        pods=list(st["hold"]), now=now)
+                    return
+                # the pod came back before the shrink landed (or the
+                # hold overran): release at full world
+                self._write_release(st, world=self.num_pods,
+                                    pods=list(range(self.num_pods)),
+                                    now=now)
+                return
+            if restarting and not overrun:
+                return  # held until the pod recovers (or the budget)
+            self._write_release(st, world=self.num_pods,
+                                pods=list(range(self.num_pods)), now=now)
+        else:  # released (elastic shrink): wait for the pod to rejoin
+            if restarting and not overrun:
+                return
+            self._hold = None
+            if self.pod_cfg.elastic_pods and self._plan.world < self.num_pods:
+                # grow back: hold the current members, then release at
+                # full width next ticks
+                healthy = [p for p in range(self.num_pods)
+                           if self._threads[p].is_alive()]
+                self._write_hold([p for p in healthy
+                                  if p in self._plan.ranks], now,
+                                 reason="rejoin")
+
+    def _write_release(self, st: dict, world: int, pods: list[int],
+                       now: float) -> None:
+        steps = [hb.step for p in st["hold"]
+                 if (hb := self._monitors[p].heartbeat) is not None]
+        barrier = max(steps) if steps else 0
+        v = self._plan.version + 1
+        self.flightrec.emit("pod_release", version=v, world=world,
+                            barrier=barrier)
+        self._plan = PodPlan(
+            version=v, phase=PLAN_STEADY, world=world,
+            ranks={p: r for r, p in enumerate(sorted(pods))},
+            barrier_step=barrier, epoch=self.epoch, hold=(),
+            num_pods=self.num_pods)
+        write_pod_plan(self.workdir, self._plan)
+        self._h_barrier.observe(max(now - st["t0"], 0.0))
+        if world < self.num_pods or st["reason"] == "rejoin":
+            self._hold = dict(st, stage="released", version=v) \
+                if world < self.num_pods else None
+        else:
+            self._hold = None
+        logger.warning("podfleet: cross-pod release v%d (world %d, barrier "
+                       "step %d)", v, world, barrier)

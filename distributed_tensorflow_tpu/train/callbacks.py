@@ -58,11 +58,18 @@ class HeartbeatCallback(Callback):
     ``on_train_start`` too, so the (possibly long) first-step compile
     window starts with proof of life."""
 
-    def __init__(self, writer, every_n: int = 1):
+    def __init__(self, writer, every_n: int = 1, pace=None):
+        """``pace``: optional ``pace(step)`` hook run before each
+        step-seam beat — the control-plane IO-delay seam
+        (``resilience.faults.FaultPlan.beat_pace``): a bounded sleep
+        here models slow heartbeat IO, so gray-failure rounds exercise
+        the monitor's LIVE-vs-DEAD judgment under late-but-regular
+        beats."""
         if every_n < 1:
             raise ValueError("every_n must be >= 1")
         self.writer = writer
         self.every_n = every_n
+        self.pace = pace
 
     def on_train_start(self, trainer):
         self.writer.beat(phase="train")
@@ -78,6 +85,8 @@ class HeartbeatCallback(Callback):
 
     def on_step_end(self, trainer, step, metrics):
         if step % self.every_n == 0:
+            if self.pace is not None:
+                self.pace(step)
             self.writer.beat(step=step)
 
 

@@ -301,9 +301,10 @@ def _fleet(args, mesh, model, tx) -> int:
 
     from distributed_tensorflow_tpu.models import common
     from distributed_tensorflow_tpu.resilience import (
-        AsyncCommitKill, FaultPlan, Hang, RetryPolicy, Sigterm, SlowWriter,
+        AsyncCommitKill, ControlPlanePartition, FaultPlan, Hang, PodOutage,
+        RetryPolicy, Sigterm, SlowControlPlane, SlowWriter,
         Supervisor, SupervisorConfig, SupervisorExhausted,
-        fleet as fleet_lib,
+        fleet as fleet_lib, podfleet as podfleet_lib,
     )
     from distributed_tensorflow_tpu.resilience.supervisor import (
         POISONED, STALLED, TRANSIENT,
@@ -345,9 +346,25 @@ def _fleet(args, mesh, model, tx) -> int:
     from distributed_tensorflow_tpu.obs import fleetview, flightrec as fr
 
     incarnation = fleet_lib.read_incarnation(args.fleet_dir)
+    # pod mode (resilience/podfleet.py): --fleet-dir is one pod's
+    # subdirectory and the GLOBAL_EPOCH file lives one level up; the
+    # (global_epoch, pod_incarnation) pair is the two-level fence, so
+    # fault flags are additionally gated on --fault-epoch — a pod
+    # relaunched under a NEW epoch never re-injures itself even though
+    # its per-pod incarnation counter restarted
+    epoch = None
+    if args.pod is not None:
+        epoch = podfleet_lib.read_global_epoch(
+            os.path.dirname(os.path.abspath(args.fleet_dir)))
     writer = fleet_lib.HeartbeatWriter(
         fleet_lib.heartbeat_path(args.fleet_dir, args.worker_index),
         incarnation=incarnation,
+        # pod mode pulses: the partition-fencing judgment (pod
+        # supervisor: frozen heartbeat + live pid = fenced, not dead)
+        # is only sound when silence really means partition — a pulsed
+        # writer beats through compile/restore windows, so the ONLY
+        # thing that freezes the file is the control plane itself
+        pulse_interval_s=0.5 if args.pod is not None else None,
     )
     # fleet observatory (obs/fleetview.py): periodic telemetry snapshots
     # next to the heartbeat, and a flight-recorder dump on every exit
@@ -361,9 +378,10 @@ def _fleet(args, mesh, model, tx) -> int:
         if not args.flightrec_dir:
             return
         os.makedirs(args.flightrec_dir, exist_ok=True)
-        base = os.path.join(
-            args.flightrec_dir,
-            f"flightrec-w{args.worker_index}i{incarnation}")
+        stem = (f"flightrec-p{args.pod}w{args.worker_index}i{incarnation}"
+                if args.pod is not None
+                else f"flightrec-w{args.worker_index}i{incarnation}")
+        base = os.path.join(args.flightrec_dir, stem)
         # never clobber: an elastic replacement reuses (worker,
         # incarnation), and overwriting would destroy the dead
         # process's dump — the one artifact the merge exists to
@@ -373,9 +391,11 @@ def _fleet(args, mesh, model, tx) -> int:
         while os.path.exists(path):
             n += 1
             path = f"{base}-{n}.jsonl"
+        extra = {"worker": args.worker_index, "incarnation": incarnation}
+        if args.pod is not None:
+            extra["pod"] = args.pod
         fr.default_recorder().dump(
-            path, reason="fleet_worker_exit",
-            extra={"worker": args.worker_index, "incarnation": incarnation})
+            path, reason="fleet_worker_exit", extra=extra)
     ceiling = fleet_lib.read_restore_step(args.fleet_dir)
     elastic_client = None
     if args.elastic:
@@ -421,9 +441,14 @@ def _fleet(args, mesh, model, tx) -> int:
             # inside resize-barrier holds (p2p rounds only)
             ckpt_dir=args.workdir if args.p2p_catchup else None)
     faults = []
-    if incarnation == args.fault_incarnation:
-        # the incarnation counter is the cross-process fired-state: a
-        # gang relaunched after this fault must not re-fire it
+    # the incarnation counter is the cross-process fired-state: a gang
+    # relaunched after this fault must not re-fire it; under a pod
+    # coordinator the gate is TWO-level — (--fault-epoch,
+    # --fault-incarnation) — because a pod restart resets neither alone
+    gate = incarnation == args.fault_incarnation
+    if args.fault_epoch is not None:
+        gate = gate and epoch == args.fault_epoch
+    if gate:
         if args.hang_at is not None:
             faults.append(Hang(args.hang_at))
         if args.sigterm_at is not None:
@@ -433,6 +458,15 @@ def _fleet(args, mesh, model, tx) -> int:
         if args.slow_writer_at is not None:
             faults.append(SlowWriter(args.slow_writer_at,
                                      delay_s=args.slow_writer_delay))
+        if args.pod_outage_at is not None:
+            faults.append(PodOutage(args.pod_outage_at))
+        if args.partition_at is not None:
+            faults.append(ControlPlanePartition(
+                args.partition_at, steps=args.partition_steps))
+        if args.slow_beat_at is not None:
+            faults.append(SlowControlPlane(
+                args.slow_beat_at, delay_s=args.slow_beat_delay,
+                steps=args.slow_beat_steps))
     plan = FaultPlan(tuple(faults))
     loss_fn = common.classification_loss_fn(model)
 
@@ -480,12 +514,20 @@ def _fleet(args, mesh, model, tx) -> int:
         # telemetry BEFORE the snapshot export so each snapshot already
         # carries the step it was cut at; heartbeat stays first (it must
         # record the step even when a later callback raises)
-        callbacks = [cb.HeartbeatCallback(writer),
+        callbacks = [cb.HeartbeatCallback(
+                         writer,
+                         # slow-control-plane seam: bounded delay on the
+                         # beat path only when the round scripts it
+                         pace=(plan.beat_pace()
+                               if args.slow_beat_at is not None else None)),
                      cb.TelemetryCallback(every_n=10 ** 6),
                      cb.FleetSnapshotCallback(exporter)]
         if elastic_client is not None:
             callbacks.append(cb.ElasticCallback(elastic_client))
-        callbacks += [cb.CheckpointCallback(ckpt), plan.callback()]
+        # writer: the ControlPlanePartition redirect seam; flush: the
+        # flight recording must reach disk before PodOutage's SIGKILL
+        callbacks += [cb.CheckpointCallback(ckpt),
+                      plan.callback(writer=writer, flush=dump_flightrec)]
         if args.die_at is not None:
             callbacks.append(_DieAt(args.die_at))
         if args.step_sleep > 0:
@@ -631,6 +673,39 @@ def main(argv=None) -> int:
                          "ceiling step must verify and restore directly "
                          "(the async-kill round's proof that the torn "
                          "step is invisible, not quarantined)")
+    ap.add_argument("--pod", type=int, default=None,
+                    help="fleet mode: this worker's POD index under a "
+                         "resilience/podfleet.py coordinator — --fleet-dir "
+                         "is the pod's subdirectory, the GLOBAL_EPOCH file "
+                         "lives one level up, and flight-recorder dumps "
+                         "are named flightrec-p<pod>w<i>i<k>.jsonl")
+    ap.add_argument("--fault-epoch", type=int, default=None,
+                    help="pod mode: inject faults only when the global "
+                         "epoch ALSO equals this — the second half of the "
+                         "two-level (epoch, incarnation) fire-once fence")
+    ap.add_argument("--pod-outage-at", type=int, default=None,
+                    help="fleet mode: SIGKILL at this GLOBAL step (flight "
+                         "recorder flushed first); give the same flag to "
+                         "every worker of one pod and the pod dies as a "
+                         "unit — the PodOutage round's scripted fault")
+    ap.add_argument("--partition-at", type=int, default=None,
+                    help="fleet mode: redirect heartbeat writes to a "
+                         "shadow file starting at this GLOBAL step — the "
+                         "control-plane partition: the process keeps "
+                         "training while its liveness record goes stale")
+    ap.add_argument("--partition-steps", type=int, default=3,
+                    help="steps the --partition-at window lasts before the "
+                         "real heartbeat path is restored (plus an "
+                         "immediate beat)")
+    ap.add_argument("--slow-beat-at", type=int, default=None,
+                    help="fleet mode: delay every heartbeat write by "
+                         "--slow-beat-delay for --slow-beat-steps steps "
+                         "from this GLOBAL step (SlowControlPlane gray "
+                         "failure — beats late but regular)")
+    ap.add_argument("--slow-beat-delay", type=float, default=0.2,
+                    help="seconds each slowed heartbeat write is delayed")
+    ap.add_argument("--slow-beat-steps", type=int, default=3,
+                    help="steps the --slow-beat-at window lasts")
     ap.add_argument("--p2p-catchup", action="store_true",
                     help="elastic mode: a rejoining replacement requests "
                          "the newest valid step from a live survivor "

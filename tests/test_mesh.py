@@ -62,6 +62,55 @@ def test_from_dict_rejects_unknown():
         MeshSpec.from_dict({"tensor": 2})
 
 
+def test_pod_topology_two_level_spec():
+    """Fault-domain descriptor → flat mesh: the data axis grows
+    num_pods-fold and the pod boundary is declared DCN, everything
+    intra-pod rides along untouched."""
+    from distributed_tensorflow_tpu.parallel import PodTopology
+
+    topo = PodTopology(num_pods=2, pod_spec=MeshSpec(data=2, model=2))
+    flat = topo.to_mesh_spec()
+    assert (flat.data, flat.model) == (4, 2)
+    assert flat.dcn_data == 2 and flat.num_slices == 2
+    resolved = topo.resolve(8)
+    assert resolved.devices_per_pod == 4
+    # a pod_spec wildcard resolves against the PER-POD device count
+    wild = PodTopology(num_pods=2, pod_spec=MeshSpec(data=-1)).resolve(8)
+    assert wild.pod_spec.data == 4
+    assert wild.to_mesh_spec().data == 8
+    assert "2 pod(s)" in wild.describe()
+    rt = PodTopology.from_dict({"num_pods": 2, "pod": {"data": 2}})
+    assert rt.num_pods == 2 and rt.pod_spec.data == 2
+
+
+def test_pod_topology_validation():
+    from distributed_tensorflow_tpu.parallel import PodTopology
+
+    with pytest.raises(ValueError, match="num_pods"):
+        PodTopology(num_pods=0)
+    # the pod_spec is ONE pod's ICI mesh — its own dcn factors are
+    # meaningless (the only inter-pod dimension is num_pods)
+    with pytest.raises(ValueError, match="dcn"):
+        PodTopology(num_pods=2, pod_spec=MeshSpec(data=2, dcn_data=2))
+    with pytest.raises(ValueError, match="divisible"):
+        PodTopology(num_pods=3, pod_spec=MeshSpec(data=2)).resolve(8)
+    with pytest.raises(ValueError, match="resolve"):
+        _ = PodTopology(num_pods=2, pod_spec=MeshSpec()).devices_per_pod
+    with pytest.raises(ValueError, match="Unknown"):
+        PodTopology.from_dict({"num_pods": 2, "pods": {}})
+
+
+def test_pod_topology_mesh_builds(devices):
+    """The two-level descriptor builds a real hybrid mesh: cross-pod
+    hops only on the outermost data sub-dimension."""
+    from distributed_tensorflow_tpu.parallel import PodTopology
+
+    topo = PodTopology(num_pods=2, pod_spec=MeshSpec(data=2, model=2))
+    mesh = build_mesh(topo.to_mesh_spec(), devices[:8])
+    assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+    assert mesh.size == 8
+
+
 def test_build_mesh_shape(mesh_dp4_tp2):
     assert mesh_dp4_tp2.shape["data"] == 4
     assert mesh_dp4_tp2.shape["model"] == 2

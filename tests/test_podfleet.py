@@ -1,0 +1,412 @@
+"""Hierarchical fault domains (resilience/podfleet.py): two-level
+``(global_epoch, pod_incarnation)`` fencing on the POD_PLAN channel,
+the hierarchical restore-ceiling math (property-style: two-level ==
+flat intersection when every pod is live; a dead pod's stale dirs can
+never veto a healthy pod's quorum — the PR 12 subset invariants, one
+level up), the partition-fencing judgment driven by scripted fake
+workers on an injected clock (fence on stale-file + live-process,
+unfence on heal, escalate past the fence budget), pod-local restart at
+the pod's OWN quorum, and a 2-pod coordinator run to one global
+``fleet_done``."""
+
+import os
+import random
+
+from distributed_tensorflow_tpu import resilience as rz
+from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+from distributed_tensorflow_tpu.obs.registry import Registry
+from distributed_tensorflow_tpu.resilience import fleet as fl
+from distributed_tensorflow_tpu.resilience import podfleet as pf
+from distributed_tensorflow_tpu.runtime import io as io_lib
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# Control-plane files: global epoch, POD_PLAN epoch fencing
+# ---------------------------------------------------------------------------
+
+
+def test_global_epoch_file(tmp_path):
+    d = str(tmp_path)
+    assert pf.read_global_epoch(d) == 0          # absent reads as 0
+    pf.write_global_epoch(d, 3)
+    assert pf.read_global_epoch(d) == 3
+    (tmp_path / pf.GLOBAL_EPOCH_FILE).write_text("{garbage")
+    assert pf.read_global_epoch(d) == 0          # unreadable == absent
+
+
+def test_pod_plan_roundtrip_and_epoch_fencing(tmp_path):
+    d = str(tmp_path)
+    plan = pf.PodPlan(version=2, phase=fl.PLAN_STEADY, world=2,
+                      ranks={0: 0, 1: 1}, barrier_step=4, epoch=5,
+                      num_pods=2)
+    pf.write_pod_plan(d, plan)
+    assert pf.read_pod_plan(d, epoch=5) == plan
+    # THE two-level fencing rule: a plan stamped with another global
+    # epoch reads as ABSENT — a survivor of epoch 6 can never act on
+    # epoch 5's leftover hold, and a partitioned pod's stale plan can
+    # never be mistaken for current
+    assert pf.read_pod_plan(d, epoch=6) is None
+    assert pf.read_pod_plan(d, epoch=4) is None
+    assert pf.read_pod_plan(d) == plan           # unfenced read for tools
+    pf.clear_pod_plan(d)
+    assert pf.read_pod_plan(d) is None
+
+
+def test_pod_plan_validation(tmp_path):
+    with pytest.raises(ValueError):
+        pf.PodPlan(version=0, phase=fl.PLAN_STEADY, world=1, ranks={0: 0},
+                   barrier_step=0)
+    with pytest.raises(ValueError):  # ranks must be a bijection
+        pf.PodPlan(version=1, phase=fl.PLAN_STEADY, world=2,
+                   ranks={0: 0, 1: 0}, barrier_step=0)
+    (tmp_path / pf._POD_PLAN_FILE).write_text("{not json")
+    assert pf.read_pod_plan(str(tmp_path)) is None  # unreadable == absent
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical ceiling math (property-style)
+# ---------------------------------------------------------------------------
+
+
+def _fake_ckpt_step(ckpt_dir, step, nbytes=64):
+    d = os.path.join(ckpt_dir, str(step))
+    os.makedirs(d, exist_ok=True)
+    shard = os.path.join(d, "shard.bin")
+    with open(shard, "wb") as f:
+        # seeded: fabricated evidence must be reproducible under replay
+        f.write(random.Random(1000 + step).randbytes(nbytes))
+    payload = (
+        '{"step": %d, "files": [{"path": "shard.bin", "bytes": %d}]}'
+        % (step, nbytes)
+    ).encode()
+    io_lib.write_payload(os.path.join(d, "MANIFEST.dtf"), payload)
+    return shard
+
+
+def test_two_level_ceiling_equals_flat_when_all_live(tmp_path):
+    """All pods healthy ⇒ the hierarchical ceiling (cross-pod
+    intersection of per-pod quorum sets) IS the flat all-worker
+    intersection: set intersection is associative, so grouping by pod
+    must change nothing. Property-checked over seeded random step
+    layouts."""
+    rng = random.Random(19)
+    for trial in range(20):
+        base = tmp_path / f"t{trial}"
+        num_pods = rng.randint(1, 3)
+        pod_dirs: dict[int, list[str]] = {}
+        flat: list[str] = []
+        for p in range(num_pods):
+            dirs = []
+            for w in range(rng.randint(1, 3)):
+                ck = str(base / f"p{p}w{w}")
+                for step in rng.sample(range(1, 9), rng.randint(0, 5)):
+                    _fake_ckpt_step(ck, step)
+                os.makedirs(ck, exist_ok=True)
+                dirs.append(ck)
+            pod_dirs[p] = dirs
+            flat.extend(dirs)
+        assert pf.hierarchical_common_step(pod_dirs) \
+            == fl.newest_common_valid_step(flat), (trial, pod_dirs)
+
+
+def test_per_pod_quorum_is_flat_intersection_within_pod(tmp_path):
+    w0, w1 = str(tmp_path / "w0"), str(tmp_path / "w1")
+    for s in (2, 4, 6):
+        _fake_ckpt_step(w0, s)
+    for s in (2, 4):
+        _fake_ckpt_step(w1, s)
+    assert pf.pod_quorum_step([w0, w1]) == 4
+    assert pf.pod_valid_step_sets({0: [w0, w1]}) == {0: {2, 4}}
+
+
+def test_dead_pod_cannot_veto_live_quorum(tmp_path):
+    """The one-level-up mirror of the PR 12 subset invariant: a dead
+    pod's stale checkpoint dirs are EXCLUDED from the cross-pod
+    intersection, so they can never drag a healthy pod's restore
+    ceiling down (or pin it to a fresh start)."""
+    a0, a1 = str(tmp_path / "a0"), str(tmp_path / "a1")
+    b0, b1 = str(tmp_path / "b0"), str(tmp_path / "b1")
+    for s in (2, 4, 6):
+        _fake_ckpt_step(a0, s)
+        _fake_ckpt_step(a1, s)
+    _fake_ckpt_step(b0, 2)  # pod 1 died long ago, holding only step 2
+    os.makedirs(b1, exist_ok=True)  # ...and one member with NOTHING
+    dirs = {0: [a0, a1], 1: [b0, b1]}
+    # flat view: pod 1's empty member pins everyone to a fresh start
+    assert pf.hierarchical_common_step(dirs) == 0
+    # hierarchical view: pod 1 is dead — pod 0 restores ITS OWN quorum
+    assert pf.hierarchical_common_step(dirs, live_pods={0}) == 6
+    # and a dead pod that never wrote anything is just as harmless
+    assert pf.hierarchical_common_step({0: [a0, a1], 1: []},
+                                       live_pods={0}) == 6
+    # degenerate inputs keep the flat contract: no pods -> None
+    assert pf.hierarchical_common_step({}) is None
+    assert pf.hierarchical_common_step(dirs, live_pods=set()) is None
+
+
+# ---------------------------------------------------------------------------
+# PodSupervisor: scripted fake workers on an injected clock
+# ---------------------------------------------------------------------------
+
+
+class FakeProc:
+    """The Popen control surface the fleet drives, fully scripted."""
+
+    _next_pid = 2000
+
+    def __init__(self):
+        FakeProc._next_pid += 1
+        self.pid = FakeProc._next_pid
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        if self.rc is None:
+            self.rc = fl.EXIT_PREEMPTED
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+class Scenario:
+    """Deterministic world driver: the fleet's injected ``sleep``
+    advances the FaultClock and fires scheduled actions."""
+
+    def __init__(self, clk):
+        self.clk = clk
+        self._events = []
+
+    def at(self, t, fn):
+        self._events.append([float(t), fn, False])
+
+    def sleep(self, s):
+        self.clk.advance(s)
+        for ev in sorted(self._events, key=lambda e: e[0]):
+            if not ev[2] and self.clk.t >= ev[0]:
+                ev[2] = True
+                ev[1]()
+
+
+def _beat(workdir, worker, incarnation, clk, *, step=None, phase="train",
+          restore=None, cause=None):
+    w = fl.HeartbeatWriter(fl.heartbeat_path(workdir, worker),
+                           incarnation=incarnation, clock=clk)
+    if restore is not None:
+        w.note_restore(restore, fallback=False)
+    if cause is not None:
+        w.finish(phase, cause=cause)
+    else:
+        w.beat(step=step, phase=phase)
+
+
+def _mk_pod(tmp_path, launch, clk, scenario, *, pod=1, max_restarts=2,
+            ckpt_dirs=None, pod_cfg=None, **cfg_kw):
+    rec = FlightRecorder(clock=clk)
+    reg = Registry()
+    global_dir = str(tmp_path)
+    pf.write_global_epoch(global_dir, 1)
+    cfg = fl.FleetConfig(
+        max_restarts=max_restarts,
+        backoff=rz.RetryPolicy(base_s=0.0, jitter=0.0),
+        poll_s=1.0, heartbeat_timeout_s=5.0, stall_timeout_s=100.0,
+        launch_grace_s=20.0, term_grace_s=4.0, **cfg_kw)
+    sup = pf.PodSupervisor(
+        pod, global_dir, 1, launch, 1, pf.pod_dir(global_dir, pod), cfg,
+        pod_cfg=pod_cfg or pf.PodFleetConfig(),
+        ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec, clock=clk,
+        sleep=scenario.sleep)
+    return sup, rec, reg
+
+
+def test_pod_supervisor_fences_on_partition_and_unfences(tmp_path):
+    """Heartbeat file frozen + process alive + beats seen before ⇒
+    FENCE, not restart: the supervisor must take no action on the
+    stale record. When the file thaws, it unfences — one fence, one
+    unfence, ZERO restarts, and the fence window measured from its
+    true start (no per-poll flapping)."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    workdir = str(tmp_path / "pod-1")
+    procs = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        procs.append(p)
+        _beat(workdir, i, incarnation, clk, step=3, phase="train")
+        return p
+
+    sup, rec, reg = _mk_pod(tmp_path, launch, clk, sc)
+    # the file stays frozen past heartbeat_timeout_s (DEAD at t≈6)...
+    # then thaws at t=12 and the worker finishes cleanly at t=14
+    sc.at(12, lambda: _beat(workdir, 0, 1, clk, step=9, phase="train"))
+
+    def finish():
+        _beat(workdir, 0, 1, clk, step=10, phase="done")
+        procs[0].rc = 0
+
+    sc.at(14, finish)
+    out = sup.run()
+    assert out["restarts"] == 0, out
+    kinds = [e["kind"] for e in rec.events()]
+    assert kinds.count("pod_fence") == 1, kinds
+    assert kinds.count("pod_unfence") == 1, kinds
+    assert "pod_outage" not in kinds and "fleet_gang_stop" not in kinds
+    unfence = next(e for e in rec.events() if e["kind"] == "pod_unfence")
+    assert unfence["fenced_s"] > 1.0, unfence  # t0 survived the window
+    # every pod-supervisor event carries the fault-domain tag
+    assert all(e.get("pod") == 1 for e in rec.events()), rec.events()
+
+
+def test_pod_supervisor_fence_timeout_escalates_to_outage(tmp_path):
+    """A fence is a JUDGMENT WINDOW, not amnesty: past fence_timeout_s
+    the stale-but-alive worker is treated as the outage it probably
+    is. The gang path kills the live handle BEFORE relaunching — the
+    no-split-brain guarantee even when the judgment was wrong."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    workdir = str(tmp_path / "pod-1")
+    procs = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        procs.append(p)
+        if incarnation == 1:
+            _beat(workdir, i, incarnation, clk, step=3, phase="train")
+            # ...then the heartbeat freezes forever
+        else:
+            _beat(workdir, i, incarnation, clk, step=3, phase="done")
+            p.rc = 0
+        return p
+
+    sup, rec, reg = _mk_pod(
+        tmp_path, launch, clk, sc,
+        pod_cfg=pf.PodFleetConfig(fence_timeout_s=8.0))
+    out = sup.run()
+    assert out["restarts"] == 1, out
+    kinds = [e["kind"] for e in rec.events()]
+    assert "pod_fence" in kinds and "pod_outage" in kinds
+    assert kinds.index("pod_fence") < kinds.index("pod_outage")
+    # the fenced original was killed before its replacement launched:
+    # both ran, but never concurrently
+    assert procs[0].rc is not None and len(procs) == 2
+    outage = next(e for e in rec.events() if e["kind"] == "pod_outage")
+    assert "fence timeout" in str(outage.get("detail", "")) \
+        or outage.get("cause"), outage
+
+
+def test_pod_supervisor_fence_disabled_passes_through(tmp_path):
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    workdir = str(tmp_path / "pod-1")
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        if incarnation == 1:
+            _beat(workdir, i, incarnation, clk, step=3, phase="train")
+        else:
+            _beat(workdir, i, incarnation, clk, step=3, phase="done")
+            p.rc = 0
+        return p
+
+    sup, rec, reg = _mk_pod(
+        tmp_path, launch, clk, sc,
+        pod_cfg=pf.PodFleetConfig(fence_on_partition=False))
+    out = sup.run()
+    assert out["restarts"] == 1, out
+    kinds = [e["kind"] for e in rec.events()]
+    assert "pod_fence" not in kinds and "pod_outage" in kinds
+
+
+def test_pod_outage_restarts_at_own_quorum(tmp_path):
+    """A pod restart resumes at the POD's newest common valid step —
+    the per-pod quorum, nobody else's intersection — and books the
+    restart under its failure class."""
+    clk = rz.FaultClock()
+    sc = Scenario(clk)
+    workdir = str(tmp_path / "pod-0")
+    ck = str(tmp_path / "ckpt0")
+    for s in (2, 4):
+        _fake_ckpt_step(ck, s)
+    procs = []
+
+    def launch(i, incarnation):
+        p = FakeProc()
+        procs.append(p)
+        if incarnation == 1:
+            _beat(workdir, i, incarnation, clk, step=3, phase="train")
+            sc.at(clk.t + 2, p.kill)  # SIGKILL mid-run
+        else:
+            # the restarted worker honours the ceiling and says so
+            _beat(workdir, i, incarnation, clk, step=6, phase="done",
+                  restore=4)
+            p.rc = 0
+        return p
+
+    sup, rec, reg = _mk_pod(tmp_path, launch, clk, sc, pod=0,
+                            ckpt_dirs=[ck])
+    out = sup.run()
+    assert out["restarts"] == 1, out
+    assert fl.read_restore_step(workdir) == 4
+    restart = next(e for e in rec.events() if e["kind"] == "pod_restart")
+    assert restart["ceiling"] == 4 and restart["cause"] == rz.TRANSIENT
+    assert restart["pod"] == 0
+    counter = reg.get(pf.POD_RESTARTS_TOTAL, cause=rz.TRANSIENT)
+    assert counter is not None and counter.value == 1
+
+
+# ---------------------------------------------------------------------------
+# PodFleetSupervisor: 2 pods to one global fleet_done
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_two_pods_run_to_global_done(tmp_path):
+    """Two pods of already-done workers: one global epoch is minted
+    and fenced into the plan, each pod supervisor runs under its pod
+    tag, and the coordinator closes ONE untagged fleet_done — the
+    merged timeline's single cross-pod upper anchor."""
+    workdir = str(tmp_path / "fleet")
+
+    def launch(p, i, incarnation):
+        proc = FakeProc()
+        w = fl.HeartbeatWriter(
+            fl.heartbeat_path(pf.pod_dir(workdir, p), i),
+            incarnation=incarnation)
+        w.beat(step=5, phase="done")
+        proc.rc = 0
+        return proc
+
+    rec = FlightRecorder()
+    reg = Registry()
+    cfg = fl.FleetConfig(
+        max_restarts=1, backoff=rz.RetryPolicy(base_s=0.0, jitter=0.0),
+        poll_s=0.02, heartbeat_timeout_s=20.0, stall_timeout_s=600.0,
+        launch_grace_s=60.0, term_grace_s=1.0)
+    sup = pf.PodFleetSupervisor(
+        launch, 2, 1, workdir, cfg=cfg,
+        pod_cfg=pf.PodFleetConfig(poll_s=0.02),
+        registry=reg, flightrec=rec)
+    out = sup.run()
+    assert out["epoch"] == 1 and out["restarts"] == 0, out
+    assert out["pod_restarts"] == {0: 0, 1: 0}, out
+    assert pf.read_global_epoch(workdir) == 1
+    events = rec.events()
+    done = [e for e in events if e["kind"] == "fleet_done"]
+    # one untagged global fleet_done; each pod's own is pod-tagged
+    assert sorted(e.get("pod") for e in done
+                  if e.get("pod") is not None) == [0, 1]
+    assert sum(1 for e in done if e.get("pod") is None) == 1
+    assert reg.get(pf.FLEET_PODS_LIVE).value == 0
+    # a second run mints the NEXT epoch — stale plans read as absent
+    out2 = pf.PodFleetSupervisor(
+        launch, 2, 1, workdir, cfg=cfg,
+        pod_cfg=pf.PodFleetConfig(poll_s=0.02),
+        registry=reg, flightrec=FlightRecorder()).run()
+    assert out2["epoch"] == 2
+    assert pf.read_pod_plan(workdir, epoch=1) is None

@@ -176,7 +176,8 @@ def test_sweep_dryrun_report_and_gate(tmp_path, capsys, devices):
     assert rc == 0
     assert scaling.validate_scaling_report(out) == []
     report = json.load(open(out))
-    assert [c["cell"] for c in report["cells"]] == ["1dev", "dp8"]
+    assert [c["cell"] for c in report["cells"]] == \
+        ["1dev", "dp8", "pod2_dp2"]
     for cell in report["cells"]:
         assert cell["provenance"]["platform"] == "cpu"
         assert cell["provenance"]["git_sha"] == \
@@ -184,12 +185,15 @@ def test_sweep_dryrun_report_and_gate(tmp_path, capsys, devices):
         assert cell["steps_per_sec"] > 0
         assert cell["eval_batches"] == 2  # distributed eval ran per cell
         assert "mfu" in cell  # flowed through goodput.train_mfu
+    # the two-level cell is stamped with its fault-domain shape
+    pod_cell = report["cells"][2]
+    assert pod_cell["pods"] == 2 and pod_cell["devices_per_pod"] == 2
     assert report["gates"] and report["gates"][0]["axis"] == "dp"
     assert report["gates"][0]["passed"]
 
     d = reg.delta(before)
-    assert d[scaling.SWEEP_CELLS]["value"] == 2
-    assert d["eval_steps_total"]["value"] == 4
+    assert d[scaling.SWEEP_CELLS]["value"] == 3
+    assert d["eval_steps_total"]["value"] == 6
 
 
 def test_sweep_dryrun_rejects_explicit_matrix(capsys):
@@ -215,8 +219,9 @@ def test_sweep_expect_platform_mismatch_fails(tmp_path, capsys, devices):
 
 
 def test_sweep_full_mesh_matrix(tmp_path, capsys, devices):
-    """The full 6-mesh matrix (the MULTICHIP dryrun shapes) over the
-    mlp workload: ≥ 6 provenance-stamped cells in one report."""
+    """The full 8-mesh matrix (the MULTICHIP dryrun shapes plus the
+    two-level pod cells) over the mlp workload: ≥ 8 provenance-stamped
+    cells in one report."""
     from tools import sweep
 
     out = str(tmp_path / "full.json")
@@ -226,8 +231,8 @@ def test_sweep_full_mesh_matrix(tmp_path, capsys, devices):
     assert rc == 0
     report = json.load(open(out))
     assert scaling.validate_scaling_report(report) == []
-    assert len(report["cells"]) == 6
+    assert len(report["cells"]) == 8
     axes = {c["axis"] for c in report["cells"]}
-    assert {"dp", "tp", "fsdp", "hybrid"} <= axes
+    assert {"dp", "tp", "fsdp", "hybrid", "pod"} <= axes
     assert {e["cell"] for e in report["efficiency"]} >= \
         {"dp2", "dp8", "dp4_tp2", "dp2_fsdp2_tp2", "dp8_hybrid2"}

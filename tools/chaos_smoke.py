@@ -66,15 +66,31 @@ machinery is wired at all):
    re-prefill on the survivor — every stream finishes, the survivor's
    drain audit is leak-free, and the corpse (by design) never writes
    one (ISSUE 16 acceptance).
+10. **One two-pod outage round** (resilience/podfleet.py over 2 pods
+    × 2 chaos_worker --pod subprocesses): pod B SIGKILLs itself
+    mid-run (PodOutage), its pod supervisor gang-restarts ONLY pod B
+    from pod B's own per-pod quorum ceiling with fallback=False,
+    while pod A keeps recording strictly-increasing ``step_end``
+    events right through the outage window — and every worker's final
+    params are bit-identical to an uninterrupted same-seed straight
+    run (ISSUE 19 acceptance).
+11. **One control-plane partition round** (2 pods × 1 worker): pod
+    B's worker heartbeat writes are redirected into a shadow file for
+    a window longer than the heartbeat timeout (the process itself
+    keeps training) while pod A's beats are merely SLOW — the pod
+    supervisor must fence (pod_fence → pod_unfence, zero restarts,
+    no split-brain relaunch double-training the batch range) and the
+    slow pod must be judged LIVE (ISSUE 19 acceptance).
 
-The fleet, elastic, p2p and async-kill rounds additionally stage every
-process's flight-recorder dump (plus telemetry snapshots and
-heartbeats) under ``artifacts/{fleet,elastic,p2p,asynckill}_dumps/``,
+The fleet, elastic, p2p, async-kill, pod and partition rounds
+additionally stage every process's flight-recorder dump (plus
+telemetry snapshots and heartbeats) under
+``artifacts/{fleet,elastic,p2p,asynckill,pod,partition}_dumps/``,
 merge them into ONE causally consistent cross-worker timeline
 (obs/fleetview.merge_timelines) at
-``artifacts/{fleet,elastic,p2p,asynckill}_merged_postmortem.jsonl``,
+``artifacts/{...}_merged_postmortem.jsonl``,
 and assert the cross-process causal chains ci_fast re-gates with
-``postmortem.py --merge --expect`` (ISSUE 15, ISSUE 18).
+``postmortem.py --merge --expect`` (ISSUE 15, ISSUE 18, ISSUE 19).
 
 Usage: JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 """
@@ -862,6 +878,295 @@ def serve_fleet_round() -> None:
           f"(merged timeline at {SERVE_FLEET_MERGED_ARTIFACT})")
 
 
+#: staging/merge artifacts for the two-pod outage round's gate
+POD_DUMPS_DIR = os.environ.get(
+    "DTF_POD_DUMPS", os.path.join(_REPO, "artifacts", "pod_dumps"))
+POD_MERGED_ARTIFACT = os.environ.get(
+    "DTF_POD_MERGED",
+    os.path.join(_REPO, "artifacts", "pod_merged_postmortem.jsonl"))
+
+#: the hierarchical-fault-domain story the merged two-pod timeline
+#: must tell (shared with ci_fast.sh's --merge gate): pod B's outage
+#: is detected and restarted POD-LOCALLY (pod_outage → pod_restart →
+#: pod_rejoin, all tagged pod=1), each relaunched pod-B worker
+#: strict-restores the pod's OWN quorum ceiling (fallback=False —
+#: nothing to fall back from: the per-pod intersection is exact)
+#: before the pod is declared live again, and the planet still
+#: reaches ONE global fleet_done. src pins ``p<pod>w<worker>i<inc>``.
+POD_MERGED_EXPECTS = (
+    "pod_outage[pod=1],pod_restart[pod=1],pod_rejoin[pod=1],fleet_done",
+    "pod_outage[pod=1],ckpt_restore[src=p1w0i2,fallback=False],"
+    "pod_rejoin[pod=1],fleet_done",
+    "pod_outage[pod=1],ckpt_restore[src=p1w1i2,fallback=False],"
+    "pod_rejoin[pod=1],fleet_done",
+)
+
+#: pacing for the two-pod rounds: long enough that the healthy pod is
+#: still stepping across pod B's whole outage window (kill → detect →
+#: relaunch → restore → live), so the forward-progress assertion has
+#: steps to count
+POD_STEPS = 14
+POD_STEP_SLEEP = 0.6
+
+
+def pod_outage_round() -> None:
+    """Pod B (2 of 2 workers) SIGKILLs itself at step 4 (PodOutage,
+    gated to epoch 1 / incarnation 1) → pod B's OWN supervisor
+    gang-restarts just that pod from pod B's per-pod quorum ceiling
+    (the step-4 save lands before the kill, so the ceiling is exactly
+    4 and the strict restore needs no fallback), while pod A never
+    stops stepping — the ISSUE 19 acceptance: one pod's outage
+    degrades, never gang-stops, the planet. Final params of all four
+    workers must be bit-identical to an uninterrupted same-seed
+    straight run."""
+    import json as _json
+
+    import numpy as np
+
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.obs.registry import Registry
+    from distributed_tensorflow_tpu.resilience import RetryPolicy
+    from distributed_tensorflow_tpu.resilience import fleet as fl
+    from distributed_tensorflow_tpu.resilience import podfleet as pf
+
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke_pod_") as d:
+        fleet_dir = os.path.join(d, "fleet")
+        os.makedirs(fleet_dir)
+        ckpt_dirs = [[os.path.join(d, f"ckpt_p{p}w{i}") for i in range(2)]
+                     for p in range(2)]
+
+        def launch(p, i, incarnation):
+            args = [sys.executable, WORKER, ckpt_dirs[p][i], "--fleet",
+                    "--fleet-dir", pf.pod_dir(fleet_dir, p),
+                    "--pod", str(p), "--worker-index", str(i),
+                    "--steps", str(POD_STEPS), "--strict-restore",
+                    "--step-sleep", str(POD_STEP_SLEEP),
+                    "--out", os.path.join(d, f"params_p{p}w{i}.npz"),
+                    "--flightrec-dir", fleet_dir]
+            if p == 1:
+                # gated to (epoch 1, incarnation 1): fire-once across
+                # the TWO-LEVEL fence — the relaunched pod-B workers
+                # (incarnation 2) and any later epoch never re-die
+                args += ["--pod-outage-at", "4", "--fault-epoch", "1"]
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            # reviewed: a worker's stdout log stream, not durable state
+            log = open(os.path.join(  # dtflint: disable=atomic-durable-write
+                fleet_dir, f"pod{p}w{i}-inc{incarnation}.log"), "w")
+            try:
+                return subprocess.Popen(args, stdout=log,
+                                        stderr=subprocess.STDOUT, env=env)
+            finally:
+                log.close()
+
+        rec = FlightRecorder()
+        reg = Registry()
+        fleet = pf.PodFleetSupervisor(
+            launch, 2, 2, fleet_dir,
+            cfg=fl.FleetConfig(max_restarts=2,
+                               backoff=RetryPolicy(base_s=0.0, jitter=0.0),
+                               poll_s=0.2, heartbeat_timeout_s=20.0,
+                               stall_timeout_s=600.0, launch_grace_s=180.0,
+                               term_grace_s=5.0, snapshot_poll_s=0.4),
+            ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec)
+        out = fleet.run()
+        assert out["epoch"] == 1 and out["restarts"] == 1, out
+        assert out["pod_restarts"] == {0: 0, 1: 1}, out
+        # hierarchical restore ceilings: the restarted pod resumed at
+        # ITS OWN per-pod quorum; the healthy pod never restarted, so
+        # its dir holds no ceiling at all — pod B's outage could not
+        # drag pod A's restore point anywhere
+        assert fl.read_restore_step(pf.pod_dir(fleet_dir, 1)) == 4, \
+            fl.read_restore_step(pf.pod_dir(fleet_dir, 1))
+        assert fl.read_restore_step(pf.pod_dir(fleet_dir, 0)) is None
+        # SIGKILL classifies transient: exactly one pod-local restart
+        restarted = reg.get(pf.POD_RESTARTS_TOTAL, cause="transient")
+        assert restarted is not None and restarted.value == 1, restarted
+        rec.dump(os.path.join(fleet_dir, "fleet.jsonl"),
+                 reason="chaos_smoke_pod")
+        _stage_fleet_dumps(
+            fleet_dir, POD_DUMPS_DIR, POD_MERGED_ARTIFACT,
+            POD_MERGED_EXPECTS,
+            expected_workers=("p0w0i1", "p0w1i1", "p1w0i1", "p1w1i1",
+                              "p1w0i2", "p1w1i2"))
+        # forward progress THROUGH the outage: inside the
+        # pod_outage → pod_rejoin window, at least one pod-A worker
+        # must have recorded >= 2 strictly-increasing step_end events
+        # — pod A never held for pod B. The merged timeline proves the
+        # CAUSAL chain (the expects above); window MEMBERSHIP is
+        # checked on the staged raw dumps, because every chaos_smoke
+        # process shares this host's monotonic clock, while the merged
+        # view places each dump at its earliest causally-consistent
+        # offset — a sound lower bound, but biased early by the
+        # worker's whole import/compile window
+        def _raw(path):
+            with open(path) as f:
+                return [e for e in (_json.loads(line) for line in f
+                                    if line.strip()) if e.get("kind")]
+
+        fleet_evs = _raw(os.path.join(POD_DUMPS_DIR, "fleet.jsonl"))
+        t_out = next(e["t"] for e in fleet_evs
+                     if e["kind"] == "pod_outage"
+                     and str(e.get("pod")) == "1")
+        t_rejoin = next(e["t"] for e in fleet_evs
+                        if e["kind"] == "pod_rejoin"
+                        and str(e.get("pod")) == "1")
+        in_window: dict[str, list[int]] = {}
+        for w in range(2):
+            evs = _raw(os.path.join(POD_DUMPS_DIR,
+                                    f"flightrec-p0w{w}i1.jsonl"))
+            in_window[f"p0w{w}i1"] = [
+                int(e["step"]) for e in evs
+                if e["kind"] == "step_end" and t_out <= e["t"] <= t_rejoin]
+        progressed = [s for s in in_window.values()
+                      if len(s) >= 2 and s == sorted(set(s))]
+        assert progressed, ("no pod-A worker stepped inside pod B's "
+                            "outage window", in_window, t_out, t_rejoin)
+
+        # bit-identity: an uninterrupted straight run (same seed, same
+        # target step, one process, no pods) must agree with EVERY
+        # worker's final params — the outage, the pod-local restart
+        # and the strict quorum restore all left the trajectory alone
+        straight = os.path.join(d, "straight.npz")
+        stdout = _run_worker(os.path.join(d, "straight_ckpt"),
+                             "--steps", str(POD_STEPS), "--out", straight)
+        assert f"CHAOS-DONE step={POD_STEPS}" in stdout, stdout
+        ref = dict(np.load(straight))
+        for p in range(2):
+            for i in range(2):
+                got = dict(np.load(
+                    os.path.join(d, f"params_p{p}w{i}.npz")))
+                assert set(got) == set(ref), (p, i, set(got), set(ref))
+                for k in ref:
+                    assert np.array_equal(ref[k], got[k]), \
+                        f"pod {p} worker {i} params[{k}] diverged"
+    print("chaos_smoke: pod B outage -> pod-local gang restart at pod "
+          "quorum (ceiling 4, fallback=False) -> pod A stepped through "
+          "the window -> params bit-identical to the straight run OK "
+          f"(merged timeline at {POD_MERGED_ARTIFACT})")
+
+
+#: staging/merge artifacts for the control-plane partition round's gate
+PARTITION_DUMPS_DIR = os.environ.get(
+    "DTF_PARTITION_DUMPS",
+    os.path.join(_REPO, "artifacts", "partition_dumps"))
+PARTITION_MERGED_ARTIFACT = os.environ.get(
+    "DTF_PARTITION_MERGED",
+    os.path.join(_REPO, "artifacts", "partition_merged_postmortem.jsonl"))
+
+#: the partition-fencing story (shared with ci_fast.sh's --merge
+#: gate): the partition fault fires in pod B's worker, the pod
+#: supervisor FENCES (heartbeat file stale + process alive + beats
+#: seen before = control plane partitioned, not a dead worker) and
+#: unfences when the writes come back — while pod A's merely-SLOW
+#: beats never trip a fence at all. No restart events may appear:
+#: fencing exists precisely so a stale file never triggers the
+#: relaunch that would double-train the live worker's batch range.
+PARTITION_MERGED_EXPECTS = (
+    "fault_fired[fault=control_plane_partition],pod_fence[pod=1],"
+    "pod_unfence[pod=1],fleet_done",
+    "fault_fired[fault=slow_control_plane],fleet_done",
+)
+
+
+def partition_round() -> None:
+    """Pod B's worker redirects its heartbeat writes into a shadow
+    file for 5 paced steps (~5s, past the 3s heartbeat timeout) while
+    it KEEPS TRAINING; pod A's worker merely delays each beat by 0.3s
+    (well inside the timeout — the pulse thread keeps its file fresh
+    regardless). The pod supervisor must judge partition, not death:
+    pod_fence, zero restarts, no split-brain relaunch — then
+    pod_unfence when the window heals, and both pods finish. The
+    gray-failure contrast (slow != dead) is the round's second
+    assertion."""
+    import json as _json
+
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.obs.registry import Registry
+    from distributed_tensorflow_tpu.resilience import RetryPolicy
+    from distributed_tensorflow_tpu.resilience import fleet as fl
+    from distributed_tensorflow_tpu.resilience import podfleet as pf
+
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke_part_") as d:
+        fleet_dir = os.path.join(d, "fleet")
+        os.makedirs(fleet_dir)
+        ckpt_dirs = [[os.path.join(d, f"ckpt_p{p}")] for p in range(2)]
+
+        def launch(p, i, incarnation):
+            args = [sys.executable, WORKER, ckpt_dirs[p][i], "--fleet",
+                    "--fleet-dir", pf.pod_dir(fleet_dir, p),
+                    "--pod", str(p), "--worker-index", str(i),
+                    "--steps", "10", "--step-sleep", "1.0",
+                    "--fault-epoch", "1",
+                    "--flightrec-dir", fleet_dir]
+            if p == 1:
+                args += ["--partition-at", "3", "--partition-steps", "5"]
+            else:
+                args += ["--slow-beat-at", "3", "--slow-beat-delay",
+                         "0.3", "--slow-beat-steps", "3"]
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            # reviewed: a worker's stdout log stream, not durable state
+            log = open(os.path.join(  # dtflint: disable=atomic-durable-write
+                fleet_dir, f"pod{p}w{i}-inc{incarnation}.log"), "w")
+            try:
+                return subprocess.Popen(args, stdout=log,
+                                        stderr=subprocess.STDOUT, env=env)
+            finally:
+                log.close()
+
+        rec = FlightRecorder()
+        reg = Registry()
+        fleet = pf.PodFleetSupervisor(
+            launch, 2, 1, fleet_dir,
+            cfg=fl.FleetConfig(max_restarts=2,
+                               backoff=RetryPolicy(base_s=0.0, jitter=0.0),
+                               poll_s=0.2, heartbeat_timeout_s=3.0,
+                               stall_timeout_s=600.0, launch_grace_s=180.0,
+                               term_grace_s=5.0, snapshot_poll_s=0.4),
+            ckpt_dirs=ckpt_dirs, registry=reg, flightrec=rec)
+        out = fleet.run()
+        assert out["restarts"] == 0 and out["pod_restarts"] == {0: 0, 1: 0}, \
+            out
+        # the shadow file is where the partitioned writes actually
+        # went — proof the heartbeat path itself was severed, not the
+        # worker paused
+        shadow = fl.heartbeat_path(pf.pod_dir(fleet_dir, 1), 0) \
+            + ".partitioned"
+        assert os.path.exists(shadow), shadow
+        rec.dump(os.path.join(fleet_dir, "fleet.jsonl"),
+                 reason="chaos_smoke_partition")
+        _stage_fleet_dumps(
+            fleet_dir, PARTITION_DUMPS_DIR, PARTITION_MERGED_ARTIFACT,
+            PARTITION_MERGED_EXPECTS,
+            expected_workers=("p0w0i1", "p1w0i1"))
+        with open(PARTITION_MERGED_ARTIFACT) as f:
+            merged = [_json.loads(line) for line in f if line.strip()]
+        # no split-brain: the stale heartbeat file never became a
+        # restart — no outage/restart/gang events anywhere, and
+        # exactly one launch per worker (nobody double-trained pod
+        # B's batch range while its original was still alive)
+        banned = {"pod_outage", "pod_restart", "fleet_gang_stop",
+                  "fleet_restart", "fleet_worker_dead"}
+        hit = [e for e in merged if e.get("kind") in banned]
+        assert not hit, hit
+        launches = [e for e in merged if e.get("kind") == "fleet_launch"]
+        assert len(launches) == 2, launches
+        # ONE fence for the whole window (the fence clock must not
+        # flap per poll round — fence_timeout_s escalation depends on
+        # t0 surviving the suppressed rounds), healed by ONE unfence
+        fences = [e for e in merged if e.get("kind") == "pod_fence"]
+        unfences = [e for e in merged if e.get("kind") == "pod_unfence"]
+        assert len(fences) == 1 and len(unfences) == 1, (fences, unfences)
+        # slow != dead: the paced pod never tripped a fence
+        assert str(fences[0].get("pod")) == "1", fences
+    print("chaos_smoke: control-plane partition -> fenced (no restart, "
+          "no split-brain) -> unfenced on heal; slow beats judged LIVE "
+          f"OK (merged timeline at {PARTITION_MERGED_ARTIFACT})")
+
+
 def main() -> int:
     scheduler_invariants()
     sigterm_resume_round()
@@ -872,6 +1177,8 @@ def main() -> int:
     p2p_catchup_round(replay_wall)
     async_kill_round()
     serve_fleet_round()
+    pod_outage_round()
+    partition_round()
     return 0
 
 

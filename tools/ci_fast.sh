@@ -25,8 +25,10 @@
 #      entropy either (relaxed set: pure test scaffolding is exempt
 #      from everything but determinism)
 #   4. tools/sweep.py --dryrun — scaling-observatory smoke (ISSUE 11):
-#      a 2-cell mesh×workload sweep (mlp × {1dev, dp8} on 8 fake CPU
-#      devices) that must emit a schema-valid dtf-scaling-1 report,
+#      a 3-cell mesh×workload sweep (mlp × {1dev, dp8, pod2_dp2} on 8
+#      fake CPU devices — pod2_dp2 exercises the two-level PodTopology
+#      descriptor, ISSUE 19) that must emit a schema-valid
+#      dtf-scaling-1 report,
 #      every cell provenance-stamped (--expect-platform cpu is the
 #      masquerade tripwire: the report must SAY cpu when it ran on
 #      cpu), with the 8-dev dp scaling-efficiency gate enforced
@@ -101,6 +103,15 @@
 #      the fresh sweep's dp8-cell steps/sec against it (provenance-
 #      checked: same platform/device_kind, both git_sha-pinned) and fail
 #      on a drop past the budget; first run on a clean tree skips
+#   6d. tools/postmortem.py --merge — hierarchical fault-domain gates
+#      (ISSUE 19): chaos_smoke's two-pod outage round SIGKILLs all of
+#      pod B mid-run while pod A keeps stepping — the merged timeline
+#      must show pod_outage → pod-local restart (each pod-B worker
+#      strict-restoring at pod B's OWN quorum, fallback=False) →
+#      pod_rejoin, with no global gang stop; the partition round freezes
+#      pod B's heartbeat file while the process stays alive — the
+#      supervisor must FENCE (no restart, no split-brain), unfence on
+#      heal, and judge the slow-beat pod LIVE throughout
 #   7c. tools/trace_view.py — request-ledger gate (ISSUE 17): merge the
 #      same round's per-process request traces (router + both replica
 #      incarnations, including the SIGKILLed victim's surviving
@@ -192,6 +203,28 @@ env JAX_PLATFORMS=cpu python tools/postmortem.py --merge \
   --out "${DTF_P2P_MERGED:-artifacts/p2p_merged_postmortem.jsonl}" --quiet \
   --expect 'fleet_worker_dead,catchup_offer,fleet_done' \
   --expect 'fleet_worker_dead,catchup_restore[src=w1i1],fleet_rejoin,fleet_done'
+# hierarchical fault domains (ISSUE 19): pod B's outage must read as a
+# POD-local story on the merged timeline — outage, per-pod-quorum
+# strict restore on BOTH pod-B workers, rejoin — while pod A never
+# stops (the round itself asserts pod A's forward progress on the raw
+# staged dumps; the absence of fleet_gang_stop here is the merged-view
+# half of the same invariant)
+env JAX_PLATFORMS=cpu python tools/postmortem.py --merge \
+  "${DTF_POD_DUMPS:-artifacts/pod_dumps}"/fleet.jsonl \
+  "${DTF_POD_DUMPS:-artifacts/pod_dumps}"/flightrec-p*.jsonl \
+  --out "${DTF_POD_MERGED:-artifacts/pod_merged_postmortem.jsonl}" --quiet \
+  --expect 'pod_outage[pod=1],pod_restart[pod=1],pod_rejoin[pod=1],fleet_done' \
+  --expect 'pod_outage[pod=1],ckpt_restore[src=p1w0i2,fallback=False],pod_rejoin[pod=1],fleet_done' \
+  --expect 'pod_outage[pod=1],ckpt_restore[src=p1w1i2,fallback=False],pod_rejoin[pod=1],fleet_done'
+# partition tolerance (ISSUE 19): a severed control plane is FENCED,
+# never restarted — one fence, one unfence, and the slow-beat pod is
+# judged live (gray failure ≠ partition)
+env JAX_PLATFORMS=cpu python tools/postmortem.py --merge \
+  "${DTF_PARTITION_DUMPS:-artifacts/partition_dumps}"/fleet.jsonl \
+  "${DTF_PARTITION_DUMPS:-artifacts/partition_dumps}"/flightrec-p*.jsonl \
+  --out "${DTF_PARTITION_MERGED:-artifacts/partition_merged_postmortem.jsonl}" --quiet \
+  --expect 'fault_fired[fault=control_plane_partition],pod_fence[pod=1],pod_unfence[pod=1],fleet_done' \
+  --expect 'fault_fired[fault=slow_control_plane],fleet_done'
 env JAX_PLATFORMS=cpu python tools/fleet_top.py --once \
   --fleet-dir "${DTF_FLEET_DUMPS:-artifacts/fleet_dumps}" >/dev/null
 env JAX_PLATFORMS=cpu python tools/bench_serve.py --preset chaos \

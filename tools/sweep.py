@@ -56,6 +56,13 @@ MESH_CELLS = {
     "dp4_tp2":       (8, dict(data=4, model=2), "tp"),
     "dp2_fsdp2_tp2": (8, dict(data=2, fsdp=2, model=2), "fsdp"),
     "dp8_hybrid2":   (8, dict(data=8, dcn_data=2), "hybrid"),
+    # two-level fault-domain cells (parallel/mesh.PodTopology): the
+    # pod boundary IS the DCN boundary, so the simulated two-pod mesh
+    # is the hybrid recipe with the slice reinterpreted as the fault
+    # domain resilience/podfleet.py supervises (ISSUE 19)
+    "pod2_dp2":      (4, dict(num_pods=2, pod=dict(data=2)), "pod"),
+    "pod2_dp2_tp2":  (8, dict(num_pods=2, pod=dict(data=2, model=2)),
+                      "pod"),
 }
 
 #: sweep workloads: name -> (registry workload, default per-shard batch)
@@ -64,7 +71,7 @@ SWEEP_WORKLOADS = {
     "gpt": ("gpt_lm", 16),
 }
 
-DRYRUN_CELLS = ("1dev", "dp8")
+DRYRUN_CELLS = ("1dev", "dp8", "pod2_dp2")
 
 
 def log(*a):
@@ -112,7 +119,15 @@ def run_cell(sweep_name: str, cell_name: str, steps: int,
 
     n_devices, spec_kw, axis = MESH_CELLS[cell_name]
     devices = jax.devices()[:n_devices]
-    spec = MeshSpec(**spec_kw).resolve(n_devices)
+    topo = None
+    if "num_pods" in spec_kw:
+        from distributed_tensorflow_tpu.parallel import PodTopology
+
+        topo = PodTopology.from_dict(spec_kw).resolve(n_devices)
+        spec = topo.to_mesh_spec().resolve(n_devices)
+        log(f"cell {sweep_name}×{cell_name}: two-level {topo.describe()}")
+    else:
+        spec = MeshSpec(**spec_kw).resolve(n_devices)
     shards = spec.data * spec.fsdp
     global_batch = per_shard_batch * shards
     cfg, mod = _tiny_config(sweep_name, global_batch)
@@ -161,6 +176,9 @@ def run_cell(sweep_name: str, cell_name: str, steps: int,
         if productive + wasted > 0 else None,
         "provenance": scaling.provenance(mesh),
     }
+    if topo is not None:
+        cell["pods"] = topo.num_pods
+        cell["devices_per_pod"] = topo.devices_per_pod
     if parts.flops_per_step:
         # fwd-only count; the shared site applies the fwd+bwd multiplier
         cell["mfu"] = round(goodput.train_mfu(
