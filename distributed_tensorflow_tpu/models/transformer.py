@@ -55,6 +55,11 @@ class TransformerConfig:
     # "auto": flash kernel on TPU, dense reference elsewhere.
     # "dense" | "blockwise" | "flash" force an implementation.
     attention_impl: str = "auto"
+    # Paged-decode attention dispatch (ops.attention.paged_attention):
+    # "auto" = block-table Pallas kernel on TPU, gather-free fused einsum
+    # elsewhere; "gather" forces the PR-13 gather-then-attend path (the
+    # exact-parity escape hatch); "fused" | "pallas" force those.
+    paged_attention_impl: str = "auto"
     # None = no sequence parallelism; "ring"|"ulysses"|"allgather" engage
     # when the model is built with a mesh whose seq axis > 1.
     seq_impl: str | None = None
@@ -358,22 +363,21 @@ class SelfAttention(nn.Module):
         new_cache = None
         seq_shards = self.mesh.shape[mesh_lib.SEQ] if self.mesh is not None else 1
         if cache is not None and "bt" in cache:
-            from ..ops.attention import (
-                cached_attention, paged_append_kv, paged_gather_kv,
-            )
+            from ..ops.attention import paged_append_kv, paged_attention
 
             # paged path: per-layer pool [NB,H,bs,D] + block table [B,MB].
             # New K/V scatter through the table at the tokens' absolute
             # positions (sentinel ids drop padded/idle writes); attention
-            # runs over the gathered contiguous logical view, the same
-            # masked dense form as the slot-dense path below.
+            # reads the pool through the table via the impl-selected
+            # dispatch — fused/Pallas by default, or the gather-then-
+            # attend masked dense form as the exact-parity escape hatch.
             bt = cache["bt"]
             ck = paged_append_kv(cache["k"], k, bt, decode_pos)
             cv = paged_append_kv(cache["v"], v, bt, decode_pos)
             new_cache = {"k": ck, "v": cv, "bt": bt}
-            out = cached_attention(
-                q, paged_gather_kv(ck, bt), paged_gather_kv(cv, bt),
-                q_pos=decode_pos,
+            out = paged_attention(
+                q, ck, cv, bt, q_pos=decode_pos,
+                impl=cfg.paged_attention_impl,
             )
         elif cache is not None:
             from ..ops.attention import append_kv, cached_attention

@@ -135,6 +135,8 @@ EVENT_KINDS = (
     #                         exhaustion (paged cache)      {uid, slot}
     "serve_prefill_chunk",  # one chunk of a chunked prefill
     #                                               {uid, slot, start, n}
+    "serve_spec_step",      # one speculative verify step for one slot
+    #                                   {uid, slot, proposed, accepted}
     # serve fleet (serve/router.py, serve/fleet.py, serve/replica.py) —
     # serve_route is BOTH halves of the dispatch handshake: the router
     # emits it when it places a request on a replica, and the replica

@@ -12,6 +12,26 @@ def on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def pad_to_sublane(n: int, sublane: int = 8) -> int:
+    """Round a row count up to the f32 sublane width — the paged decode
+    kernel pads its tiny query tile (S = 1, or k+1 under speculation) so
+    the VMEM scratch is tile-aligned on real TPU; the padded rows carry
+    ``q_pos = -1`` (attend nothing) and are sliced off."""
+    return -(-n // sublane) * sublane
+
+
+def paged_attn_vmem_ok(S: int, block_size: int, D: int,
+                       *, lanes: int = 128) -> bool:
+    """True when the paged-attention kernel's per-instance VMEM footprint
+    (resident q/o/acc [S, D] tiles, m/l row stats [S, lanes], one
+    double-buffered [block_size, D] k/v block pair) fits the shared
+    budget. Decode shapes are tiny (S ≤ 8, D ≤ 256), so this is a
+    tripwire against pathological configs, not a tile picker."""
+    resident = 3 * S * D * 4 + 2 * S * lanes * 4
+    stream = 2 * 2 * block_size * D * 4
+    return resident + stream <= VMEM_BUDGET
+
+
 def pick_block_m(M: int, k: int, n: int, *, name: str) -> int:
     """Largest 8-aligned divisor of M whose [bm, k]/[bm, n] streaming
     tiles fit the budget; a single whole-M block for tiny/odd M. A

@@ -160,6 +160,82 @@ def cached_attention(
     ).astype(q.dtype)
 
 
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_table: jax.Array,
+    *,
+    q_pos: jax.Array,
+    sm_scale: float | None = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Attention over the paged KV pool, selected by ``impl`` — the serve
+    decode hot path's dispatch point (docs/serving.md "Fused paged
+    attention").
+
+    ``q`` [B,H,S,D] at absolute positions ``q_pos`` [B,S];
+    ``k_pool``/``v_pool`` [num_blocks, H, block_size, D];
+    ``block_table`` [B, max_blocks]. Key position ``j`` participates iff
+    ``j <= q_pos`` — the same single-predicate masking as
+    ``cached_attention`` (sentinel table entries clamp onto garbage the
+    mask excludes, so no zeroing, no validity bitmap).
+
+    - ``"gather"`` — the PR-13 path, ``paged_gather_kv`` then
+      ``cached_attention``: materializes the [B,H,MB*bs,D] logical view
+      TWICE per layer per step (k and v, each a pool gather plus a
+      transpose copy). Exact-parity escape hatch.
+    - ``"fused"`` — one pool gather per buffer, consumed in BLOCK layout
+      [B,MB,H,bs,D] by the attention einsums directly: the transpose +
+      reshape copies of the gather path never materialize. Pure jittable
+      XLA; any backend.
+    - ``"pallas"`` — the block-table-aware Pallas kernel
+      (ops/flash_attention.paged_flash_attention): block ids are
+      scalar-prefetched and each grid step DMAs one physical block from
+      the pool in place — the logical view never exists in HBM at all.
+      Compiled on TPU, interpreter elsewhere (tests only).
+    - ``"auto"`` — ``"pallas"`` on TPU, ``"fused"`` elsewhere.
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "fused"
+    if impl == "gather":
+        return cached_attention(
+            q,
+            paged_gather_kv(k_pool, block_table),
+            paged_gather_kv(v_pool, block_table),
+            q_pos=q_pos,
+            sm_scale=sm_scale,
+        )
+    if impl == "pallas":
+        from .flash_attention import paged_flash_attention
+
+        return paged_flash_attention(
+            q, k_pool, v_pool, block_table, q_pos=q_pos, sm_scale=sm_scale
+        )
+    if impl != "fused":
+        raise ValueError(
+            f"paged attention impl must be 'auto', 'gather', 'fused' or "
+            f"'pallas', got {impl!r}"
+        )
+    NB, H, bs, D = k_pool.shape
+    B, MB = block_table.shape
+    S = q.shape[2]
+    ids = jnp.clip(block_table, 0, NB - 1)
+    kg = jnp.take(k_pool, ids.reshape(-1), axis=0).reshape(B, MB, H, bs, D)
+    vg = jnp.take(v_pool, ids.reshape(-1), axis=0).reshape(B, MB, H, bs, D)
+    logits = jnp.einsum(
+        "bhsd,bmhkd->bhsmk", q, kg, preferred_element_type=jnp.float32
+    ) * _scale(q, sm_scale)
+    kpos = jnp.arange(MB * bs).reshape(MB, bs)
+    mask = kpos[None, None, None] <= q_pos[:, None, :, None, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(
+        logits.reshape(B, H, S, MB * bs), axis=-1
+    ).reshape(logits.shape)
+    out = jnp.einsum("bhsmk,bmhkd->bhsd", probs.astype(vg.dtype), vg)
+    return out.astype(q.dtype)
+
+
 def blockwise_attention(
     q: jax.Array,
     k: jax.Array,

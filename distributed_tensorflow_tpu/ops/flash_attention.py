@@ -365,6 +365,144 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, q_offset,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Paged decode kernel: attention straight off the block pool
+# ---------------------------------------------------------------------------
+
+
+def _paged_fwd_kernel(
+    bt_ref,  # scalar-prefetched block table [B, MB] (unused in the body —
+    #          it drives the k/v index_maps; Pallas still passes it in)
+    q_ref, qpos_ref, k_ref, v_ref,
+    o_ref,
+    acc_ref, m_ref, l_ref,
+    *, sm_scale, block_size,
+):
+    del bt_ref
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+    S = q_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [S, D]
+    k = k_ref[0, 0].astype(jnp.float32)  # [bs, D] — one physical block
+    v = v_ref[0, 0].astype(jnp.float32)
+    logits = _dot(q, k, ((1,), (1,))) * sm_scale  # [S, bs]
+    kpos = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (S, block_size), 1
+    )
+    qp = qpos_ref[0]  # [S] absolute query positions (-1 = padded row)
+    mask = kpos <= qp[:, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    m_prev = m_ref[...]
+    l_prev = l_ref[...]
+    m_cur = logits.max(axis=1)[:, None]
+    m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+    # explicit zero under the mask (see _fwd_kernel): fully-masked rows
+    # keep m == NEG_INF and must not poison l with exp(0) == 1
+    p = jnp.where(mask, jnp.exp(logits - m_new[:, :1]), 0.0)
+    correction = jnp.exp(m_prev - m_new)
+    l_ref[...] = l_prev * correction + jnp.broadcast_to(
+        p.sum(axis=1)[:, None], l_prev.shape
+    )
+    acc_ref[...] = acc_ref[...] * correction[:, :1] + _dot(p, v, ((1,), (0,)))
+    m_ref[...] = m_new
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = l_ref[...][:, :1]
+        # all-masked rows (idle slots never reach here with l == 0 — their
+        # sentinel q_pos attends everything — but padded rows do)
+        o_ref[0, 0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def paged_flash_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_table: jax.Array,
+    *,
+    q_pos: jax.Array,
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Block-table-aware attention for paged decode — KV blocks are read
+    IN PLACE from the pool (``k_pool``/``v_pool``
+    [num_blocks, H, block_size, D]); the contiguous logical view that
+    ``paged_gather_kv`` materializes never exists.
+
+    The block table is SCALAR-PREFETCHED (pltpu.PrefetchScalarGridSpec):
+    the grid iterates (batch, head, logical-block) and the k/v index_maps
+    read ``table[b, j]`` to aim each step's DMA at the right physical
+    block — table indirection costs an index computation, not a gather.
+    Masking is the paged contract: key position ``j <= q_pos`` attends;
+    sentinel table entries (``>= num_blocks``) clamp onto garbage the
+    mask excludes. Forward-only (decode never differentiates).
+
+    ``interpret=None`` auto-selects: compiled on TPU, Pallas interpreter
+    elsewhere (slow; tests pin numerics against the gather path). On TPU
+    the query tile pads to the f32 sublane width (padded rows get
+    ``q_pos = -1`` — attend nothing — and are sliced off)."""
+    from ._tiling import pad_to_sublane, paged_attn_vmem_ok
+
+    B, H, S, D = q.shape
+    NB, _, bs, _ = k_pool.shape
+    MB = block_table.shape[1]
+    if interpret is None:
+        interpret = not _on_tpu()
+    if not paged_attn_vmem_ok(S, bs, D):
+        raise ValueError(
+            f"paged attention tile (S={S}, block_size={bs}, D={D}) "
+            f"exceeds the VMEM budget; shrink block_size or head_dim"
+        )
+    Sp = S if interpret else pad_to_sublane(S)
+    qp = q_pos.astype(jnp.int32)
+    if Sp != S:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
+        qp = jnp.pad(qp, ((0, 0), (0, Sp - S)), constant_values=-1)
+    scale = sm_scale if sm_scale is not None else D**-0.5
+
+    qspec = pl.BlockSpec((1, 1, Sp, D), lambda b, h, j, bt: (b, h, 0, 0))
+    kvspec = pl.BlockSpec(
+        (1, 1, bs, D),
+        lambda b, h, j, bt: (jnp.minimum(bt[b, j], NB - 1), h, 0, 0),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, H, MB),
+        in_specs=[
+            qspec,
+            pl.BlockSpec((1, Sp), lambda b, h, j, bt: (b, 0)),
+            kvspec,
+            kvspec,
+        ],
+        out_specs=qspec,
+        scratch_shapes=[
+            pltpu.VMEM((Sp, D), jnp.float32),
+            pltpu.VMEM((Sp, LANES), jnp.float32),
+            pltpu.VMEM((Sp, LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_fwd_kernel, sm_scale=scale, block_size=bs
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Sp, D), q.dtype),
+        interpret=interpret,
+        name="paged_attention_fwd",
+    )(block_table.astype(jnp.int32), q, qp, k_pool, v_pool)
+    return out[:, :, :S]
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,

@@ -122,8 +122,11 @@ def paged_prefill_chunk(
     next-token logits at the chunk's last REAL position — only the
     final chunk's caller reads them — and the updated pool.
 
-    One compiled program covers EVERY prompt length: chunks are a fixed
-    shape, unlike the dense path's per-bucket prefill programs."""
+    Chunks are a fixed shape, unlike the dense path's per-bucket
+    prefill programs — one compiled program per TABLE-width bucket
+    covers every prompt length (the engine trims ``table_row`` to the
+    power-of-two width covering the slot's live blocks, so short
+    prompts attend far fewer positions than ``max_blocks``)."""
     C = tokens.shape[0]
     sentinel = table_row.shape[0] * cache.block_size
     idx = jnp.arange(C, dtype=jnp.int32)
@@ -155,6 +158,35 @@ def paged_decode_step(
     return logits[:, 0], cache
 
 
+def paged_verify_step(
+    model: Transformer,
+    params,
+    cache: PagedKVCache,
+    block_tables: jax.Array,
+    tokens: jax.Array,
+    positions: jax.Array,
+) -> tuple[jax.Array, PagedKVCache]:
+    """Speculative verify: one chunked-prefill-shaped step over EVERY
+    slot at once. ``tokens`` [num_slots, K+1] — each slot's newest
+    sampled token followed by its K drafted tokens; ``positions``
+    [num_slots, K+1] their absolute cache positions (row ``i`` of a
+    slot's logits conditions, causally, on everything at or before
+    ``positions[slot, i]`` — identical math to running K+1 sequential
+    decode steps). Idle slots, mid-prefill slots, and unused draft rows
+    carry the past-the-table sentinel so their K/V writes are dropped.
+
+    Returns logits [num_slots, K+1, vocab] — the accept/reject rule
+    (sampling.spec_verify_*) reads them on the host; rejected suffixes
+    roll back via the block table (a refcount/length edit, not a
+    device copy). One compiled program per K, shared by every prompt
+    and every acceptance pattern."""
+    logits, cache = model.apply(
+        {"params": params}, tokens, kv_cache=cache,
+        decode_pos=positions, block_table=block_tables,
+    )
+    return logits, cache
+
+
 def copy_block(
     cache: PagedKVCache, src: jax.Array, dst: jax.Array
 ) -> PagedKVCache:
@@ -178,6 +210,12 @@ def jit_paged_prefill_chunk(model: Transformer):
 def jit_paged_decode_step(model: Transformer):
     """Compiled paged decode step; the pool is donated."""
     return jax.jit(partial(paged_decode_step, model), donate_argnums=(1,))
+
+
+def jit_paged_verify_step(model: Transformer):
+    """Compiled speculative verify step; the pool is donated. One
+    compile per draft length K (tokens [num_slots, K+1])."""
+    return jax.jit(partial(paged_verify_step, model), donate_argnums=(1,))
 
 
 def jit_copy_block():

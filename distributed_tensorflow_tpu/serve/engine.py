@@ -107,6 +107,9 @@ class ServeEngine:
         num_blocks: int | None = None,
         prefill_chunk: int = 32,
         prefix_reuse: bool = True,
+        spec_k: int = 0,
+        spec_ngram: int = 4,
+        paged_impl: str | None = None,
         temperature: float = 0.0,
         top_k: int = 0,
         seed: int = 0,
@@ -117,6 +120,23 @@ class ServeEngine:
     ):
         if not cfg.causal:
             raise ValueError("ServeEngine requires a causal (decoder) model")
+        if paged_impl is not None:
+            # per-engine override of the paged-attention dispatch
+            # (ops.attention.paged_attention impl=): the bench and the
+            # parity gates pin "gather" / "fused" / "pallas" without
+            # touching the model config they were handed
+            cfg = dataclasses.replace(cfg, paged_attention_impl=paged_impl)
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if spec_k > 0 and not paged:
+            raise ValueError(
+                "speculative decoding (spec_k > 0) requires the paged "
+                "engine: rollback is a block-table edit"
+            )
+        if spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got {spec_ngram}")
+        self.spec_k = spec_k
+        self.spec_ngram = spec_ngram
         self.cfg = cfg
         self.params = params
         self.model = Transformer(cfg)
@@ -198,6 +218,12 @@ class ServeEngine:
                 self.model)
             self._decode = decode_lib.jit_paged_decode_step(self.model)
             self._copy_block = decode_lib.jit_copy_block()
+            if spec_k > 0:
+                self._verify = decode_lib.jit_paged_verify_step(self.model)
+                #: host-side accept-rule randomness (temperature spec);
+                #: numpy on purpose — the accept decision is host
+                #: bookkeeping, a device categorical buys nothing
+                self._spec_gen = np.random.default_rng(seed)
         else:
             self._prefill = decode_lib.jit_prefill(self.model)
             self._decode = decode_lib.jit_decode_step(self.model)
@@ -248,6 +274,21 @@ class ServeEngine:
             "admission instead of being prefilled")
         self._m_chunks = r.counter(
             "prefill_chunks_total", "prefill chunks run (chunked prefill)")
+        # speculative-decoding surface (docs/observability.md
+        # "Speculative decoding") — unconditional, same zeros-not-holes
+        # contract as the paged gauges above
+        self._m_spec_prop = r.counter(
+            "spec_tokens_proposed_total",
+            "draft tokens proposed to the speculative verify step")
+        self._m_spec_acc = r.counter(
+            "spec_tokens_accepted_total",
+            "draft tokens the speculative verify step accepted")
+        self._m_spec_rate = r.gauge(
+            "spec_acceptance_rate",
+            "accepted / proposed draft tokens over the engine lifetime")
+        #: engine-lifetime accept accounting behind the gauge
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         if paged:
             self._sync_block_metrics()
 
@@ -445,6 +486,19 @@ class ServeEngine:
             self._m_block_evic.inc(d)
             self._evictions_seen = self.alloc.evictions
 
+    def _mb_bucket(self, hi_blocks: int) -> int:
+        """Table width (in blocks) to hand the jit'd step: the smallest
+        power of two covering the widest live slot, capped at the full
+        table. Dense attention pays ``max_len`` positions every step;
+        the block table knows how few are actually mapped, so the fused
+        kernels attend (and gather) only that — at the cost of one
+        compiled program per bucket, ≤ log2(max_blocks)+1 in total, all
+        hot after the first long request."""
+        mbu = 1
+        while mbu < hi_blocks:
+            mbu *= 2
+        return min(mbu, self._mb)
+
     def _admission_gate(self, req: Request) -> bool:
         """Admission is gated on KV capacity, not slot count: the
         request needs blocks for every position it will write through
@@ -583,8 +637,9 @@ class ServeEngine:
         self._ensure_blocks(slot, start, end)
         buf = np.zeros(self.prefill_chunk, np.int32)
         buf[: end - start] = toks[start:end]
+        mbu = self._mb_bucket(len(self._blocks[slot]))
         logits, self.cache = self._prefill_chunk_fn(
-            self.params, self.cache, jnp.asarray(self._table[slot]),
+            self.params, self.cache, jnp.asarray(self._table[slot, :mbu]),
             jnp.asarray(buf), start, end - start,
         )
         stats.prefill_chunks += 1
@@ -698,7 +753,111 @@ class ServeEngine:
             self.reqtrace.transition(req.rid, "decode_gap", uid=req.uid)
         self._deliver(slot, tok, stats)
 
+    def _draft(self, slot: int, k: int) -> list[int]:
+        """N-gram prompt-lookup drafter (zero extra weights): find the
+        longest suffix of the slot's known tokens (n = spec_ngram down
+        to 1) that recurs earlier in prompt+generated, and propose the
+        ``k`` tokens that followed its most recent earlier occurrence
+        (short continuations repeat their last token out to ``k`` — a
+        cheap bet that loops keep looping). No recurrence at all →
+        propose the last token repeated, which costs nothing when
+        rejected: a verify step always emits at least one token."""
+        req = self.sched.slots[slot]
+        ctx = list(req.prompt) + list(req.generated)
+        for n in range(min(self.spec_ngram, len(ctx) - 1), 0, -1):
+            pat = ctx[-n:]
+            for i in range(len(ctx) - n - 1, -1, -1):
+                if ctx[i: i + n] == pat:
+                    cont = ctx[i + n: i + n + k]
+                    while len(cont) < k:
+                        cont.append(cont[-1])
+                    return cont
+        return [ctx[-1]] * k
+
+    def _do_verify_decode(self, active: list[int], stats: StepStats) -> None:
+        """Speculative decode step: draft ``spec_k`` tokens per slot,
+        verify every slot's drafts in ONE chunked-prefill-shaped step,
+        emit each slot's accepted prefix plus its correction/bonus
+        token, and roll rejected suffixes back through the block table
+        (kv_cache.BlockAllocator.release_tail — a refcount edit, never
+        a device copy). Greedy emission is bit-identical to the
+        non-speculative path (sampling.spec_verify_greedy docstring);
+        the per-token ``_deliver`` loop keeps every scheduler/telemetry
+        invariant of single-token decode, including discarding tokens
+        drafted past a mid-burst finish."""
+        bs = self.block_size
+        cap = self._oob  # positions a slot's table can address
+        drafts: dict[int, list[int]] = {}
+        for slot in active:
+            if self.sched.slots[slot] is None:
+                continue  # a peer's _ensure_blocks preempted it
+            w = int(self._written[slot])
+            ks = max(min(self.spec_k, cap - 1 - w), 0)
+            drafts[slot] = self._draft(slot, ks) if ks else []
+            # writable span: the pending token at w plus every draft
+            self._ensure_blocks(slot, w, w + len(drafts[slot]) + 1)
+        active = [s for s in active if self.sched.slots[s] is not None]
+        if not active:
+            return
+        stats.decoded_slots = len(active)
+        S = self.spec_k + 1
+        toks = np.zeros((self.sched.num_slots, S), np.int32)
+        pos = np.full((self.sched.num_slots, S), self._oob, np.int32)
+        for slot in active:
+            d = drafts[slot]
+            w = int(self._written[slot])
+            toks[slot, 0] = self._last[slot]
+            toks[slot, 1: 1 + len(d)] = d
+            pos[slot, : 1 + len(d)] = np.arange(w, w + 1 + len(d))
+        mbu = self._mb_bucket(max(len(self._blocks[s]) for s in active))
+        logits, self.cache = self._verify(
+            self.params, self.cache, jnp.asarray(self._table[:, :mbu]),
+            jnp.asarray(toks), jnp.asarray(pos),
+        )
+        logits = np.asarray(logits)
+        for slot in active:
+            d = drafts[slot]
+            w = int(self._written[slot])
+            rows = logits[slot, : len(d) + 1]
+            if self.temperature <= 0.0:
+                emitted, accepted = sampling.spec_verify_greedy(rows, d)
+            else:
+                emitted, accepted = sampling.spec_verify_sample(
+                    rows, d, self._spec_gen,
+                    temperature=self.temperature, top_k=self.top_k,
+                )
+            # the verify wrote K/V at w..w+len(d); everything past
+            # w+accepted is rejected-draft garbage — retreat the write
+            # index over it (future writes overwrite in place, masked
+            # until then) and give wholly-garbage tail blocks back
+            self._written[slot] = w + accepted + 1
+            keep = -(-int(self._written[slot]) // bs)
+            if len(self._blocks[slot]) > keep:
+                self.alloc.release_tail(self._blocks[slot], keep)
+                self._table[slot, keep:] = self.cache.num_blocks
+            self._spec_proposed += len(d)
+            self._spec_accepted += accepted
+            if d:
+                self._m_spec_prop.inc(len(d))
+            if accepted:
+                self._m_spec_acc.inc(accepted)
+            req = self.sched.slots[slot]
+            req.spec_accepted += accepted
+            self.flightrec.emit("serve_spec_step", uid=req.uid, slot=slot,
+                                proposed=len(d), accepted=accepted)
+            self._last[slot] = emitted[-1]
+            for tok in emitted:
+                self._deliver(slot, tok, stats)
+                if self.sched.slots[slot] is None:
+                    break  # finished mid-burst; trailing tokens discarded
+        if self._spec_proposed:
+            self._m_spec_rate.set(
+                self._spec_accepted / self._spec_proposed)
+
     def _do_decode(self, active: list[int], stats: StepStats) -> None:
+        if self.paged and self.spec_k > 0:
+            self._do_verify_decode(active, stats)
+            return
         if self.paged:
             # make each decoding slot's write position privately owned
             # (fresh block at a boundary, COW off a shared block);
@@ -719,8 +878,10 @@ class ServeEngine:
             lens = np.full(self.sched.num_slots, self._oob, np.int32)
             for slot in active:
                 lens[slot] = self._written[slot]
+            mbu = self._mb_bucket(
+                max(len(self._blocks[s]) for s in active))
             logits, self.cache = self._decode(
-                self.params, self.cache, jnp.asarray(self._table),
+                self.params, self.cache, jnp.asarray(self._table[:, :mbu]),
                 jnp.asarray(self._last), jnp.asarray(lens),
             )
         else:

@@ -344,6 +344,19 @@ class BlockAllocator:
             return True
         return False
 
+    def release_tail(self, blocks: list[int], keep: int) -> None:
+        """Speculation rollback: drop ownership of every block past the
+        first ``keep`` — a refcount/length edit, never a data copy. Pops
+        ``blocks`` in place so the caller's per-slot block list stays
+        the single source of truth; decref's double-free tripwire still
+        guards each drop (a rejected suffix must not free a block the
+        prefix cache or another slot co-owns more times than this slot
+        held it)."""
+        if keep < 0:
+            raise ValueError(f"keep must be >= 0, got {keep}")
+        while len(blocks) > keep:
+            self.decref(blocks.pop())
+
     def _evict_cached(self) -> None:
         """LRU-evict prefix-cache entries whose block only the cache
         holds, until one block is actually freed."""

@@ -1,4 +1,5 @@
-"""Token sampling: greedy / temperature / top-k.
+"""Token sampling: greedy / temperature / top-k, plus the speculative
+accept/reject rule.
 
 One function, batch-shaped: ``sample(logits [..., V], rng)``. Greedy
 (``temperature <= 0``) is pure argmax — deterministic, rng ignored —
@@ -8,12 +9,28 @@ first floors everything below the k-th logit so the tail can never be
 drawn. All in f32 — the head already emits f32 logits (models/
 transformer.py head_dtype docstring), and sampling is far off the FLOPs
 critical path.
+
+The ``spec_verify_*`` pair is the other half of speculative decoding
+(docs/serving.md "Speculative decoding"): given the target model's
+logits at every drafted position (ONE chunked-prefill-shaped verify
+step) and the drafter's proposals, decide the longest accepted prefix
+and the one extra token every verify step is entitled to. Greedy
+acceptance is EXACT (token == argmax, so the emitted stream is
+bit-identical to non-speculative greedy decode); temperature acceptance
+is the standard speculative-sampling rule specialized to a
+DETERMINISTIC drafter (q is a point mass): accept draft ``d`` with
+probability ``p_target(d)``, else resample from the renormalized
+residual ``p_target`` with ``d`` removed — which preserves the target
+distribution exactly (pinned statistically in tests/test_serve.py).
+Host-side numpy on purpose: k is tiny, V is one row, and the decision
+drives host bookkeeping (rollback), so a device round-trip buys nothing.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops.attention import NEG_INF
 
@@ -36,3 +53,77 @@ def sample(
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, NEG_INF, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def spec_verify_greedy(
+    logits: np.ndarray, draft: list[int] | tuple[int, ...]
+) -> tuple[list[int], int]:
+    """Greedy-exact acceptance. ``logits`` [len(draft)+1, V] — the
+    target's logits at each drafted position plus one past the last
+    draft; row ``i`` conditions on the drafts before it, so it is only
+    meaningful while every earlier draft was accepted. Returns
+    ``(emitted, accepted)``: the argmax at each position up to and
+    including the first mismatch (the mismatch row's argmax IS the
+    correction token), plus the bonus token when every draft survives —
+    always ``accepted + 1`` tokens, never zero, which is why a verify
+    step can never be slower than a plain decode step in tokens."""
+    arg = np.argmax(np.asarray(logits), axis=-1)
+    emitted: list[int] = []
+    accepted = 0
+    for i, d in enumerate(draft):
+        tok = int(arg[i])
+        emitted.append(tok)
+        if tok != int(d):
+            return emitted, accepted
+        accepted += 1
+    emitted.append(int(arg[len(draft)]))
+    return emitted, accepted
+
+
+def spec_verify_sample(
+    logits: np.ndarray,
+    draft: list[int] | tuple[int, ...],
+    gen: np.random.Generator,
+    *,
+    temperature: float,
+    top_k: int = 0,
+) -> tuple[list[int], int]:
+    """Distribution-preserving acceptance for a deterministic drafter.
+    Draft ``d_i`` is accepted with probability ``p_i(d_i)`` (``p_i`` the
+    target's temperature/top-k distribution at that position — the
+    draft's distribution is a point mass, so the min(1, p/q) rule
+    reduces to this); on rejection the emitted token is drawn from the
+    renormalized residual (``p_i`` with ``d_i`` zeroed) and verification
+    stops. If every draft survives, the bonus token is drawn from the
+    last row unmodified. The marginal of each emitted token equals
+    straight temperature sampling — pinned statistically in
+    tests/test_serve.py::test_spec_sample_matches_target_distribution."""
+    if temperature <= 0.0:
+        raise ValueError("spec_verify_sample requires temperature > 0; "
+                         "use spec_verify_greedy")
+    scaled = np.asarray(logits, np.float64) / temperature
+    if top_k > 0:
+        kth = -np.sort(-scaled, axis=-1)[:, top_k - 1: top_k]
+        scaled = np.where(scaled < kth, NEG_INF, scaled)
+    scaled = scaled - scaled.max(axis=-1, keepdims=True)
+    p = np.exp(scaled)
+    p /= p.sum(axis=-1, keepdims=True)
+    emitted: list[int] = []
+    accepted = 0
+    for i, d in enumerate(draft):
+        d = int(d)
+        if gen.random() < p[i, d]:
+            emitted.append(d)
+            accepted += 1
+            continue
+        residual = p[i].copy()
+        residual[d] = 0.0
+        total = residual.sum()
+        if total <= 0.0:  # the draft held ALL the mass; nothing to resample
+            emitted.append(d)
+            accepted += 1
+            continue
+        emitted.append(int(gen.choice(residual.shape[0], p=residual / total)))
+        return emitted, accepted
+    emitted.append(int(gen.choice(p.shape[-1], p=p[len(draft)])))
+    return emitted, accepted

@@ -106,6 +106,11 @@ class Request:
     #: process's admission/prefill/preemption spans under the SAME id
     #: the router traces — the key the cross-process merge joins on.
     rid: int | None = None
+    #: draft tokens the verify step accepted over this request's
+    #: lifetime (speculative decoding only; stays 0 otherwise).
+    #: ``spec_accepted / (generated - 1)`` approximates the per-request
+    #: acceptance rate — the fleet-wide rate is the engine gauge.
+    spec_accepted: int = 0
 
     @property
     def done(self) -> bool:

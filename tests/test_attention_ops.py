@@ -147,3 +147,42 @@ def test_flash_env_block_fallback(monkeypatch):
     # an EXPLICIT non-dividing block argument still errors loudly
     with pytest.raises(ValueError, match="multiples of block sizes"):
         flash_attention(q, k, v, block_q=256, block_k=256)
+
+
+def test_paged_attention_impls_match_gather_oracle():
+    """Every paged attention impl answers identically (PR 20): the fused
+    block-layout einsum and the Pallas kernel (interpreter off-TPU) must
+    match the PR-13 gather+cached_attention oracle on random pools with
+    ragged positions, sentinel table entries, an idle all-sentinel row,
+    and verify-shaped (S>1) queries — the shapes the serve engine feeds
+    the dispatch in decode, chunked prefill, and speculative verify."""
+    from distributed_tensorflow_tpu.ops.attention import paged_attention
+
+    key = jax.random.PRNGKey(7)
+    B, H, D, bs, NB, MB = 3, 2, 16, 8, 10, 4
+    kq, kk, kv = jax.random.split(key, 3)
+    k_pool = jax.random.normal(kk, (NB, H, bs, D))
+    v_pool = jax.random.normal(kv, (NB, H, bs, D))
+    table = np.full((B, MB), NB, np.int32)
+    table[0, :3] = [4, 9, 1]      # 3 live blocks, non-contiguous
+    table[1, :1] = [0]            # 1 live block
+    # row 2 stays all-sentinel: an idle slot (its output is garbage the
+    # engine discards, but every impl must compute the SAME garbage)
+    table = jnp.asarray(table)
+    oob = MB * bs
+    for S, q_pos in (
+        (1, jnp.asarray([[17], [0], [oob]], jnp.int32)),
+        (5, jnp.asarray([[17, 18, 19, 20, 21], [0, 1, 2, 3, 4],
+                         [oob] * 5], jnp.int32)),
+    ):
+        q = jax.random.normal(kq, (B, H, S, D))
+        want = paged_attention(
+            q, k_pool, v_pool, table, q_pos=q_pos, impl="gather")
+        for impl in ("fused", "pallas"):
+            got = paged_attention(
+                q, k_pool, v_pool, table, q_pos=q_pos, impl=impl)
+            np.testing.assert_allclose(
+                got, want, atol=2e-5, rtol=2e-5,
+                err_msg=f"impl={impl} S={S}")
+    with pytest.raises(ValueError, match="impl"):
+        paged_attention(q, k_pool, v_pool, table, q_pos=q_pos, impl="nope")

@@ -825,6 +825,166 @@ def test_paged_block_accounting_chaos(decoder):
         eng, sum(_finished_totals(eng.registry).values()))
 
 
+# ---------------------------------------------------------------------------
+# Speculative decoding (PR 20)
+# ---------------------------------------------------------------------------
+
+
+def test_block_allocator_release_tail():
+    """Speculation rollback is a refcount edit, never a copy:
+    ``release_tail`` frees exactly the blocks past ``keep``, trims the
+    owner's list in place, is a no-op when nothing hangs over, and the
+    double-free tripwire still fires on a rolled-back block."""
+    a = serve.BlockAllocator(6, block_size=4)
+    blocks = [a.alloc() for _ in range(4)]
+    dropped = blocks[2:]
+    a.release_tail(blocks, keep=2)
+    assert len(blocks) == 2
+    assert a.blocks_in_use == 2 and a.blocks_free == 4
+    for bid in dropped:
+        assert a.refcount(bid) == 0
+        with pytest.raises(ValueError):
+            a.decref(bid)  # rollback already freed it
+    a.release_tail(blocks, keep=2)  # nothing hangs over: no-op
+    assert a.blocks_in_use == 2
+    with pytest.raises(ValueError):
+        a.release_tail(blocks, keep=-1)
+    # a tail block with a second holder survives the rollback: only THIS
+    # owner's reference is dropped
+    shared = blocks[1]
+    a.incref(shared)
+    a.release_tail(blocks, keep=1)
+    assert blocks == blocks[:1] and a.refcount(shared) == 1
+    assert a.blocks_in_use == 2  # blocks[0] + the still-held tail
+    a.decref(shared), a.decref(blocks[0])
+    assert a.blocks_free == 6
+
+
+def test_spec_engine_validation(decoder):
+    """Speculation requires the paged path (rollback is a block-table
+    edit) and sane knobs — misconfigurations fail at construction."""
+    cfg, _, params = decoder
+    with pytest.raises(ValueError, match="paged"):
+        serve.ServeEngine(cfg, params, num_slots=1, paged=False, spec_k=2)
+    with pytest.raises(ValueError, match="spec_k"):
+        _paged_engine(cfg, params, num_slots=1, spec_k=-1)
+    with pytest.raises(ValueError, match="spec_ngram"):
+        _paged_engine(cfg, params, num_slots=1, spec_k=2, spec_ngram=0)
+
+
+def test_spec_greedy_exact_parity(decoder):
+    """Acceptance gate: greedy streams with speculative decoding on are
+    BIT-IDENTICAL to the non-spec paged engine (itself parity-gated
+    against dense above), short and multi-chunk-long prompts — rejected
+    drafts roll back without a trace, accepted ones are the same tokens
+    the target would have emitted one step at a time."""
+    cfg, _, params = decoder
+    prompts = [
+        [5, 17, 3, 99, 42, 7, 11],
+        [(7 * i + 3) % cfg.vocab_size for i in range(40)],  # 5 chunks
+    ]
+    for prompt in prompts:
+        plain = _paged_engine(cfg, params, num_slots=1)
+        want = list(plain.stream(prompt, max_new_tokens=48))
+        spec = _paged_engine(cfg, params, num_slots=1, spec_k=4)
+        got = list(spec.stream(prompt, max_new_tokens=48))
+        assert got == want
+        spec.drain()
+        assert spec.alloc.blocks_free == spec.cache.num_blocks
+
+
+def test_spec_telemetry_and_flightrec(decoder):
+    """Observability closes over speculation: proposed/accepted counters
+    add up, the acceptance-rate gauge is their ratio, every verify step
+    lands a ``serve_spec_step`` event, per-request ``spec_accepted``
+    sums to the counter — and the PR-2 invariant holds under MULTI-token
+    steps: exactly one TTFT and one TPOT observation per finished
+    request (TPOT normalizes by tokens delivered, not steps)."""
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+
+    cfg, _, params = decoder
+    rec = FlightRecorder(capacity=512)
+    eng = _paged_engine(cfg, params, num_slots=2, spec_k=4, flightrec=rec)
+    # a highly repetitive prompt: the n-gram drafter should land several
+    # multi-token acceptances, exercising multi-token delivery
+    uids = [eng.submit([1, 2, 3, 1, 2, 3, 1, 2], max_new_tokens=16)
+            for _ in range(2)]
+    done = eng.run()
+    reg = eng.registry
+    prop = int(reg.get("spec_tokens_proposed_total").value)
+    acc = int(reg.get("spec_tokens_accepted_total").value)
+    assert prop > 0 and 0 <= acc <= prop
+    assert reg.get("spec_acceptance_rate").value == pytest.approx(acc / prop)
+    evs = [e for e in rec.events() if e["kind"] == "serve_spec_step"]
+    assert evs and all(0 <= e["accepted"] <= e["proposed"] for e in evs)
+    assert sum(e["proposed"] for e in evs) == prop
+    assert sum(e["accepted"] for e in evs) == acc
+    assert sum(done[u].spec_accepted for u in uids) == acc
+    assert all(len(done[u].generated) == 16 for u in uids)
+    _assert_telemetry_invariant(eng, 2)
+    eng.drain()
+    assert eng.alloc.blocks_free == eng.cache.num_blocks
+
+
+def test_spec_preemption_and_rollback_block_accounting(decoder):
+    """The PR-13 accounting invariant extended over speculation: with a
+    tight pool forcing preemption AND rejected drafts forcing rollback,
+    used + free == pool size at EVERY step, the drain leaves the
+    allocator all-free, and the greedy tokens still match the
+    uncontended non-spec run exactly."""
+    cfg, _, params = decoder
+
+    def drive(num_blocks, spec_k):
+        eng = _paged_engine(cfg, params, num_slots=2, max_len=32,
+                            num_blocks=num_blocks, prefix_reuse=False,
+                            spec_k=spec_k)
+        uids = [eng.submit([10 + i] * 10, max_new_tokens=20)
+                for i in range(3)]
+        while eng.sched.has_work:
+            eng.step()
+            a = eng.alloc
+            assert a.blocks_in_use + a.blocks_free == a.num_blocks
+            assert all(a.refcount(i) >= 0 for i in range(a.num_blocks))
+        done = eng.sched.drain_finished()
+        outs = [done[u].generated for u in uids]
+        pre = sum(done[u].preemptions for u in uids)
+        eng.drain()
+        assert eng.alloc.blocks_free == eng.cache.num_blocks
+        assert all(eng.alloc.refcount(i) == 0
+                   for i in range(eng.cache.num_blocks))
+        return outs, pre
+
+    plain, _ = drive(8, spec_k=0)
+    ample, _ = drive(8, spec_k=4)
+    tight, pre_tight = drive(5, spec_k=4)
+    assert ample == plain  # speculation is invisible in greedy tokens
+    assert tight == plain  # ... even under preemption pressure
+    assert pre_tight > 0
+
+
+def test_spec_sample_matches_target_distribution():
+    """The acceptance rule is distribution-preserving: over many trials
+    the first emitted token's empirical distribution matches straight
+    temperature sampling from the target row — whether the deterministic
+    draft is the target's most- or least-likely token. (Accept d with
+    p(d), else resample the renormalized residual: the marginal is p.)"""
+    from distributed_tensorflow_tpu.serve import sampling
+
+    rng = np.random.default_rng(20260807)
+    logits = np.asarray([[2.0, 1.0, 0.0, -1.0]] * 2)
+    temperature = 0.8
+    p = np.exp(logits[0] / temperature)
+    p /= p.sum()
+    n = 20000
+    for draft_tok in (0, 3):
+        counts = np.zeros(4)
+        for _ in range(n):
+            emitted, _ = sampling.spec_verify_sample(
+                logits, [draft_tok], rng, temperature=temperature)
+            counts[emitted[0]] += 1
+        np.testing.assert_allclose(counts / n, p, atol=0.02)
+
+
 def test_paged_cache_specs_follow_sharding_rules():
     """The pool shards heads over `model` like the dense cache; the
     blocks dim is replicated (blocks are shared across requests, so

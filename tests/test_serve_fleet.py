@@ -161,10 +161,10 @@ def fleet_decoder():
     )
 
 
-def _make_engine(cfg):
+def _make_engine(cfg, spec_k=0):
     return serve.ServeEngine.with_random_params(
         cfg, seed=0, num_slots=2, paged=True, block_size=8,
-        prefill_chunk=16)
+        prefill_chunk=16, spec_k=spec_k)
 
 
 def shared_prefix_trace(n=6, groups=2, max_new=6):
@@ -190,7 +190,7 @@ def baseline_streams(cfg, trace):
 
 
 def run_fleet(cfg, trace, *, policy="prefix", num_replicas=2,
-              kill_after_tokens=None):
+              kill_after_tokens=None, spec_k=0):
     """Drive a LocalReplica fleet over the trace on a fake clock
     (1 pump = 1 s); optionally hard-kill a mid-stream replica once
     `kill_after_tokens` tokens are in flight."""
@@ -199,7 +199,7 @@ def run_fleet(cfg, trace, *, policy="prefix", num_replicas=2,
     engines = []
 
     def launch(index, incarnation):
-        eng = _make_engine(cfg)
+        eng = _make_engine(cfg, spec_k=spec_k)
         engines.append(eng)
         return sf.LocalReplica(eng)
 
@@ -308,6 +308,39 @@ def test_kill_midstream_cannot_reset_tpot_clock():
                          if req.t_first_token is not None
                          and len(req.delivered) > 1)
     assert reg.total(rt.ROUTER_TPOT_SECONDS) == finished_multi
+
+
+def test_spec_multi_token_pumps_keep_tpot_per_token():
+    """PR 20 regression pin (alongside the TPOT-clock pin above): with
+    speculative engines one pump can deliver SEVERAL tokens per request,
+    and the router's TPOT accounting must stay per-TOKEN — exactly one
+    observation per finished multi-token request, never one per pump —
+    while the streams stay bit-identical to the non-spec baseline
+    (greedy-exact acceptance)."""
+    cfg = fleet_decoder()
+    trace = shared_prefix_trace(n=6)
+    want = baseline_streams(cfg, trace)
+    router, reg, rec, engines, sup, survivors = run_fleet(
+        cfg, trace, spec_k=4)
+
+    assert len(router.finished) == len(trace)
+    for rid, req in router.finished.items():
+        assert req.delivered == want[rid], (
+            f"rid {rid} diverged under speculation: {req.delivered} != "
+            f"{want[rid]}")
+    # speculation actually landed multi-token steps somewhere
+    accepted = sum(
+        int(e.registry.get("spec_tokens_accepted_total").value)
+        for e in engines)
+    assert accepted > 0
+    finished_multi = sum(1 for req in router.finished.values()
+                         if req.t_first_token is not None
+                         and len(req.delivered) > 1)
+    assert reg.total(rt.ROUTER_TPOT_SECONDS) == finished_multi
+    for req in router.finished.values():
+        if req.t_first_token is not None:
+            assert req.t_submit <= req.t_first_token <= req.t_finish
+    assert all(d["leak_free"] for d in sup.drained.values())
 
 
 def test_prefix_routing_beats_random_on_shared_prefix_trace():

@@ -70,12 +70,15 @@ def _make_engine(cfg, serve, args, seed):
         block_size=args.block_size, num_blocks=args.blocks,
         prefill_chunk=args.prefill_chunk,
         prefix_reuse=not args.no_reuse,
+        spec_k=args.spec_k, paged_impl=args.paged_impl,
     )
 
 
 def _parity_check(cfg, serve, args):
-    """64-step greedy decode must be token-identical through the paged
-    and dense paths — the bench-side twin of the test-suite gate."""
+    """Greedy decode must be token-identical through every serve path —
+    the bench-side twin of the test-suite gates: 64 steps dense ==
+    paged-gather == paged-fused, and speculative == non-speculative on
+    both a short and a multi-chunk-long prompt."""
     import jax
 
     from distributed_tensorflow_tpu.models import transformer as tfm
@@ -83,20 +86,37 @@ def _parity_check(cfg, serve, args):
     model = tfm.Transformer(cfg)
     params, _ = tfm.make_init_fn(model, 8)(jax.random.PRNGKey(args.seed))
     prompt = [5, 17, 3, 99, 42, 7, 11]
+    long_prompt = [(i * 7 + 3) % cfg.vocab_size
+                   for i in range(3 * args.prefill_chunk + 5)]
     dense = serve.ServeEngine(cfg, params, num_slots=1, paged=False)
     want = list(dense.stream(prompt, max_new_tokens=64))
-    paged = serve.ServeEngine(
-        cfg, params, num_slots=1, paged=True,
-        block_size=args.block_size, prefill_chunk=args.prefill_chunk)
-    got = list(paged.stream(prompt, max_new_tokens=64))
-    assert got == want, (
-        f"paged/dense greedy divergence at step "
-        f"{next(i for i, (a, b) in enumerate(zip(got, want)) if a != b)}"
-    )
-    paged.drain()
-    assert paged.alloc.blocks_free == paged.cache.num_blocks, \
-        "parity engine leaked blocks"
-    print("parity-check: 64-step paged == dense", file=sys.stderr)
+
+    def paged_stream(p, **kw):
+        eng = serve.ServeEngine(
+            cfg, params, num_slots=1, paged=True,
+            block_size=args.block_size, prefill_chunk=args.prefill_chunk,
+            **kw)
+        got = list(eng.stream(p, max_new_tokens=64))
+        eng.drain()
+        assert eng.alloc.blocks_free == eng.cache.num_blocks, \
+            f"parity engine leaked blocks ({kw})"
+        return got
+
+    for impl in ("gather", "fused"):
+        got = paged_stream(prompt, paged_impl=impl)
+        assert got == want, (
+            f"paged[{impl}]/dense greedy divergence at step "
+            f"{next(i for i, (a, b) in enumerate(zip(got, want)) if a != b)}"
+        )
+    want_long = paged_stream(long_prompt)
+    for p, w in ((prompt, want), (long_prompt, want_long)):
+        got = paged_stream(p, spec_k=4)
+        assert got == w, (
+            f"spec/non-spec greedy divergence (P={len(p)}) at step "
+            f"{next(i for i, (a, b) in enumerate(zip(got, w)) if a != b)}"
+        )
+    print("parity-check: 64-step dense == paged[gather] == paged[fused]; "
+          "spec == non-spec (short + long)", file=sys.stderr)
 
 
 def _fleet_trace(cfg, args, rng):
@@ -311,6 +331,20 @@ def main(argv=None):
     ap.add_argument("--prefill-chunk", type=int, default=32)
     ap.add_argument("--no-reuse", action="store_true",
                     help="disable copy-on-write prefix reuse")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="draft tokens per speculative verify step "
+                         "(0 = plain one-token decode)")
+    ap.add_argument("--paged-impl", default=None,
+                    choices=("auto", "gather", "fused", "pallas"),
+                    help="paged-attention dispatch "
+                         "(ops.attention.paged_attention)")
+    ap.add_argument("--compare-baseline", action="store_true",
+                    help="also time the same workload through the "
+                         "gather-path non-speculative engine and report "
+                         "speedup_vs_gather_baseline")
+    ap.add_argument("--min-speedup", type=float, default=None,
+                    help="with --compare-baseline: fail unless "
+                         "speedup_vs_gather_baseline >= this")
     ap.add_argument("--parity-check", action="store_true",
                     help="gate 64-step greedy parity paged vs dense")
     ap.add_argument("--json", type=str, default=None,
@@ -328,6 +362,10 @@ def main(argv=None):
                          "ledgers (dtf-reqtrace-1) for the routed run "
                          "here, for tools/trace_view.py")
     args = ap.parse_args(argv)
+    if args.dense and args.spec_k:
+        ap.error("--spec-k requires the paged engine; drop --dense")
+    if args.min_speedup is not None and not args.compare_baseline:
+        ap.error("--min-speedup needs --compare-baseline")
     if args.fleet and args.dense:
         ap.error("--fleet drives paged replicas; drop --dense")
     if args.trace and not args.fleet:
@@ -375,9 +413,35 @@ def main(argv=None):
 
     # warmup on the SAME engine: jit tracing is cached per wrapper, so a
     # fresh ServeEngine would recompile inside the timed loop. The paged
-    # path compiles ONE chunk program + one decode program; the dense
-    # path needs every prefill bucket the stream will use. Warmup
-    # requests drain out of the stats entirely.
+    # path compiles one chunk/decode/verify program per block-table
+    # bucket (the engine trims the table to the widest live slot,
+    # power-of-two widths); the dense path needs every prefill bucket
+    # the stream will use. Warmup requests drain out of the stats
+    # entirely.
+    def _warm_paged(e):
+        # two identical full-block prompts back to back: the second
+        # matches the first's cached blocks and its capped last-position
+        # rewrite triggers a copy-on-write, so copy_block compiles
+        # during warmup too, not inside the timed loop
+        wp = [rng.randrange(cfg.vocab_size)
+              for _ in range(2 * args.block_size)]
+        for _ in range(2):
+            e.submit(wp, max_new_tokens=2)
+            e.run()
+        # touch every table bucket so no prefill/decode/verify program
+        # compiles inside the timed loop
+        L = 1
+        while True:
+            P = min(L * args.block_size - 2, cfg.max_len - 4)
+            e.submit([rng.randrange(cfg.vocab_size) for _ in range(P)],
+                     max_new_tokens=2)
+            e.run()
+            if P >= cfg.max_len - 4:
+                break
+            L *= 2
+        # keep measured reuse honest: drop what warmup cached
+        e.alloc.flush_prefix_cache()
+
     if args.dense:
         for b in sorted({serve.prefill_bucket(len(p)) for p in prompts}):
             eng.submit([rng.randrange(cfg.vocab_size) for _ in range(b)],
@@ -388,13 +452,7 @@ def main(argv=None):
         # matches the first's cached blocks and its capped last-position
         # rewrite triggers a copy-on-write, so copy_block compiles
         # during warmup too, not inside the timed loop
-        wp = [rng.randrange(cfg.vocab_size)
-              for _ in range(2 * args.block_size)]
-        for _ in range(2):
-            eng.submit(wp, max_new_tokens=2)
-            eng.run()
-        # keep measured reuse honest: drop what warmup cached
-        eng.alloc.flush_prefix_cache()
+        _warm_paged(eng)
     eng.registry.reset()  # drop warmup/compile observations
     # cow_copies lives on the allocator, not the registry: snapshot it
     # here so the report counts only the measured window, like the
@@ -429,6 +487,29 @@ def main(argv=None):
                 max_gap = max(max_gap, step_i - last_seen[uid])
             last_seen[uid] = step_i
     wall = time.perf_counter() - t0
+
+    # same-run baseline: the SAME workload through the PR-13
+    # gather-then-attend path with speculation off — the denominator of
+    # the perf-regression story, measured under identical conditions so
+    # host noise cancels instead of hiding in a stale reference number
+    baseline_tps = None
+    if args.compare_baseline and not args.dense:
+        beng = serve.ServeEngine.with_random_params(
+            cfg, seed=args.seed, num_slots=args.slots, paged=True,
+            block_size=args.block_size, num_blocks=args.blocks,
+            prefill_chunk=args.prefill_chunk,
+            prefix_reuse=not args.no_reuse, paged_impl="gather")
+        _warm_paged(beng)
+        beng.registry.reset()
+        for p, dl in zip(prompts, deadlines):
+            beng.submit(p, max_new_tokens=args.max_new, deadline_s=dl)
+        bt0 = time.perf_counter()
+        while beng.sched.has_work:
+            beng.step()
+        bwall = time.perf_counter() - bt0
+        btokens = int(beng.registry.get("serve_tokens_total").value)
+        beng.drain()
+        baseline_tps = round(btokens / bwall, 1) if bwall else None
 
     from distributed_tensorflow_tpu.obs import goodput
 
@@ -509,6 +590,22 @@ def main(argv=None):
                 reg.get("kv_block_evictions_total").value),
             "cow_copies": eng.alloc.cow_copies - cow_at_reset,
         })
+    if not args.dense:
+        result["paged_impl"] = args.paged_impl or "auto"
+    if args.spec_k and not args.dense:
+        result.update({
+            "spec_k": args.spec_k,
+            "spec_tokens_proposed": int(
+                reg.get("spec_tokens_proposed_total").value),
+            "spec_tokens_accepted": int(
+                reg.get("spec_tokens_accepted_total").value),
+            "spec_acceptance_rate": round(
+                reg.get("spec_acceptance_rate").value, 3),
+        })
+    if baseline_tps is not None:
+        result["baseline_gather_tokens_per_sec"] = baseline_tps
+        result["speedup_vs_gather_baseline"] = round(
+            result["tokens_per_sec"] / baseline_tps, 2)
     # Chaos epilogue (ISSUE 3 acceptance): exercise the timeout and
     # cancel eviction paths on the SAME engine and re-check the
     # histogram-counts == Σ serve_finished_total invariant with the new
@@ -561,6 +658,12 @@ def main(argv=None):
                 and args.blocks is None:
             print(f"FAIL: a resident decoder starved for "
                   f"{result['max_intertoken_steps']} steps", file=sys.stderr)
+            return 1
+    if args.min_speedup is not None:
+        sp = result.get("speedup_vs_gather_baseline")
+        if sp is None or sp < args.min_speedup:
+            print(f"FAIL: speedup_vs_gather_baseline={sp} < "
+                  f"{args.min_speedup}", file=sys.stderr)
             return 1
     return 0
 

@@ -76,13 +76,25 @@
 #      anchors and asserts the CROSS-WORKER causal stories, and
 #      fleet_top --once exercises the merged text view on the same
 #      artifacts
-#   7. tools/bench_serve.py  — paged-KV serve smoke (ISSUE 13): the
-#      mixed-length chaos preset on the tiny model, chaos epilogue
-#      included, gating (a) 64-step greedy parity of the paged path
-#      against the dense fallback (--parity-check), (b) leak-free
-#      shutdown (the block allocator back to all-free after drain),
-#      and (c) full-batch occupancy under backlog + the one-chunk
-#      starvation bound for resident decoders
+#   7. tools/bench_serve.py  — paged-KV serve smoke (ISSUE 13, spec
+#      decoding ISSUE 20): the mixed-length chaos preset on the tiny
+#      model with speculative decoding on (--spec-k 4), chaos epilogue
+#      included, gating (a) 64-step greedy parity of BOTH paged
+#      attention impls against the dense fallback plus the spec ==
+#      non-spec greedy stream pins, short and multi-chunk-long prompts
+#      (--parity-check), (b) leak-free shutdown (the block allocator
+#      back to all-free after drain, spec rollback included), (c)
+#      full-batch occupancy under backlog + the one-chunk starvation
+#      bound for resident decoders, and (d) the same-run speculation
+#      win: chaos throughput must beat the non-spec gather baseline
+#      measured in the same process (--min-speedup — the bar is LOW
+#      because the CI preset is tiny and noisy; the honest numbers
+#      live in PERF_NOTES.md)
+#   7d. tools/bench_trend.py — serve perf-regression sentinel
+#      (ISSUE 20): same freshest-pair trend as 4b, over the serve
+#      chaos bench — when a previous run left
+#      artifacts/serve_chaos_prev.json, the fresh run's tokens/sec
+#      must not collapse past the budget
 #   7b. tools/postmortem.py --merge — serve-fleet failover gate
 #      (ISSUE 16): chaos_smoke's serve-fleet round SIGKILLs one of two
 #      serve/replica.py subprocesses mid-stream and stages the
@@ -227,8 +239,23 @@ env JAX_PLATFORMS=cpu python tools/postmortem.py --merge \
   --expect 'fault_fired[fault=slow_control_plane],fleet_done'
 env JAX_PLATFORMS=cpu python tools/fleet_top.py --once \
   --fleet-dir "${DTF_FLEET_DUMPS:-artifacts/fleet_dumps}" >/dev/null
+# keep the previous serve bench around as the bench_trend baseline,
+# same freshest-pair scheme as the sweep sentinel above (ISSUE 20)
+if [ -f artifacts/serve_chaos.json ]; then
+  cp artifacts/serve_chaos.json artifacts/serve_chaos_prev.json
+fi
 env JAX_PLATFORMS=cpu python tools/bench_serve.py --preset chaos \
-  --requests 10 --slots 4 --max-new 8 --parity-check >/dev/null
+  --requests 10 --slots 4 --max-new 8 --parity-check \
+  --spec-k 4 --compare-baseline --min-speedup 1.1 \
+  --json artifacts/serve_chaos.json >/dev/null
+# serve perf-regression sentinel (ISSUE 20): chaos tok/s on shared CI
+# hosts is noisy, so the budget is generous — this catches collapses
+# (a rollback bug serializing the verify step), not percent-level drift
+if [ -f artifacts/serve_chaos_prev.json ]; then
+  env JAX_PLATFORMS=cpu python tools/bench_trend.py \
+    artifacts/serve_chaos_prev.json artifacts/serve_chaos.json \
+    --metric tokens_per_sec --max-regress-pct 60
+fi
 # serve fleet (ISSUE 16): re-merge the serve-fleet failover round's
 # per-process dumps (router/supervisor + surviving replicas, clocks
 # aligned on the serve_route dispatch/ACK handshake) and gate the
